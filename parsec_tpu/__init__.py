@@ -1,0 +1,53 @@
+"""parsec_tpu — a TPU-native task-dataflow runtime with the capabilities of
+PaRSEC (reference: /root/reference, ICL/UTK PaRSEC).
+
+PaRSEC executes DAGs of micro-tasks with labeled data-flow dependencies over
+distributed tiled data collections (reference: parsec/runtime.h:170-323,
+parsec/parsec.c). This package re-designs that capability set TPU-first:
+
+- The *runtime core* (taskpools, task classes, dependency tracking,
+  schedulers, termination detection) mirrors the reference's contracts
+  (parsec_internal.h:119-516) but is host-side Python + a native C++ core.
+- The *device layer* replaces the CUDA stream pipeline
+  (mca/device/cuda/device_cuda_module.c) with XLA execution: ready tasks of
+  the same task class are batched and run as one vmapped XLA call so the MXU
+  sees large, static-shaped matmuls instead of per-task kernel launches.
+- The *distributed layer* replaces MPI remote_deps (parsec/remote_dep.c)
+  with SPMD compiled execution over a jax.sharding.Mesh: owner-computes
+  placement on block-cyclic collections, with XLA collectives riding ICI.
+
+Public API (mirrors parsec_init / parsec_context_* from runtime.h):
+
+    import parsec_tpu as parsec
+    ctx = parsec.init(nb_cores=4)
+    tp  = parsec.dtd.Taskpool(ctx)   # or a PTG taskpool
+    ...
+    ctx.add_taskpool(tp); ctx.start(); ctx.wait()
+    parsec.fini(ctx)
+"""
+
+from .version import __version__
+from .utils import mca_param
+from .utils.debug import debug_verbose, set_verbosity
+from .core.context import Context, init, fini
+from .core.taskpool import Taskpool, TaskClass, Flow, FlowAccess, Task
+from .core.compound import compose
+from . import dsl
+from .dsl import dtd, ptg
+from . import data
+from . import device
+from . import sched
+from . import termdet
+from . import compiled
+from . import comm
+from . import profiling
+from . import ops
+
+__all__ = [
+    "__version__",
+    "init", "fini", "Context",
+    "Taskpool", "TaskClass", "Flow", "FlowAccess", "Task", "compose",
+    "dsl", "dtd", "ptg", "data", "device", "sched", "termdet",
+    "compiled", "comm", "profiling", "ops", "mca_param",
+    "debug_verbose", "set_verbosity",
+]

@@ -1,0 +1,9 @@
+"""Shipped task-graph algorithms (the DPLASMA-analog layer).
+
+PTG taskpools for dense tiled linear algebra plus DTD builders — the
+workloads the reference ecosystem runs on PaRSEC (dpotrf/dgemm-style) and
+the BASELINE.md benchmark configs.
+"""
+
+from .potrf import build_potrf
+from .gemm import build_gemm_ptg, insert_gemm_dtd

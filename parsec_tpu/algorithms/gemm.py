@@ -1,0 +1,79 @@
+"""Tiled GEMM: C ← α·A·B + β·C.
+
+Two builders, matching the reference's two front ends:
+- :func:`build_gemm_ptg` — PTG taskpool with a k-chain per C tile (the
+  dgemm JDF shape).
+- :func:`insert_gemm_dtd` — DTD insertion (the reference's
+  tests/dsl/dtd tiled-GEMM config from BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from ..dsl import dtd, ptg
+from ..data.matrix import TiledMatrix
+from ..ops.tile_kernels import gemm_tile
+
+
+def build_gemm_ptg(A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
+                   alpha: float = 1.0, beta: float = 1.0) -> ptg.Taskpool:
+    if A.nt != B.mt or A.mt != C.mt or B.nt != C.nt:
+        raise ValueError("tile-grid mismatch")
+    tp = ptg.Taskpool("gemm", A=A, B=B, C=C,
+                      MT=C.mt, NT=C.nt, KT=A.nt)
+
+    GEMM = tp.task_class(
+        "GEMM", params=("m", "n", "k"),
+        space=lambda g: ((m, n, k) for m in range(g.MT)
+                         for n in range(g.NT) for k in range(g.KT)),
+        affinity=lambda g, m, n, k: (g.C, (m, n)),
+        flows=[
+            ptg.FlowSpec(
+                "A", ptg.READ,
+                tile=lambda g, m, n, k: (g.A, (m, k)),
+                ins=[ptg.In(data=lambda g, m, n, k: (g.A, (m, k)))]),
+            ptg.FlowSpec(
+                "B", ptg.READ,
+                tile=lambda g, m, n, k: (g.B, (k, n)),
+                ins=[ptg.In(data=lambda g, m, n, k: (g.B, (k, n)))]),
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                tile=lambda g, m, n, k: (g.C, (m, n)),
+                ins=[ptg.In(data=lambda g, m, n, k: (g.C, (m, n)),
+                            guard=lambda g, m, n, k: k == 0),
+                     ptg.In(src=("GEMM",
+                                 lambda g, m, n, k: (m, n, k - 1), "C"),
+                            guard=lambda g, m, n, k: k > 0)],
+                outs=[ptg.Out(dst=("GEMM",
+                                   lambda g, m, n, k: (m, n, k + 1), "C"),
+                              guard=lambda g, m, n, k: k < g.KT - 1),
+                      ptg.Out(data=lambda g, m, n, k: (g.C, (m, n)),
+                              guard=lambda g, m, n, k: k == g.KT - 1)])])
+
+    @GEMM.body
+    def gemm_body(task, A_, B_, C_, _alpha=alpha, _beta=beta):
+        return gemm_tile(C_, A_, B_, alpha=_alpha, beta=_beta)
+
+    return tp
+
+
+def insert_gemm_dtd(tp: "dtd.Taskpool", A: TiledMatrix, B: TiledMatrix,
+                    C: TiledMatrix, alpha: float = 1.0,
+                    beta: float = 1.0) -> None:
+    """Insert the full tiled-GEMM DAG into a DTD taskpool (the
+    dtd_test-style driver loop, insert_function.c varargs shape)."""
+    def body(a, b, c):
+        return gemm_tile(c, a, b, alpha=alpha, beta=beta)
+
+    for m in range(C.mt):
+        for n in range(C.nt):
+            for k in range(A.nt):
+                tp.insert_task(
+                    body,
+                    dtd.TileArg(A, (m, k), dtd.INPUT),
+                    dtd.TileArg(B, (k, n), dtd.INPUT),
+                    dtd.TileArg(C, (m, n), dtd.INOUT, affinity=True),
+                    name=f"GEMM({m},{n},{k})")
+
+
+def gemm_flops(m: int, n: int, k: int) -> float:
+    return 2.0 * m * n * k

@@ -1,0 +1,152 @@
+"""Tiled Cholesky factorization (right-looking, lower) as a PTG taskpool.
+
+The DPLASMA dpotrf_L equivalent — the reference's headline workload class
+(BASELINE.md: "DPLASMA-style tiled Cholesky ≥65% of peak"). Task classes
+and dataflow mirror the classic dpotrf JDF:
+
+    POTRF(k):  T = chol(A[k,k] after k SYRK updates)
+    TRSM(m,k): C = A[m,k] · T^{-T}
+    SYRK(m,k): diag update A[m,m] -= C·Cᵀ            (k-th update)
+    GEMM(m,n,k): A[m,n] -= A[m,k]·A[n,k]ᵀ            (k-th update)
+
+Every flow carries its logical tile (FlowSpec.tile), so the taskpool runs
+on the host runtime AND on the compiled wavefront/SPMD executors.
+"""
+
+from __future__ import annotations
+
+from ..dsl import ptg
+from ..data.matrix import TiledMatrix
+from ..ops.tile_kernels import gemm_tile, potrf_tile, syrk_tile, trsm_tile
+
+
+def build_potrf(A: TiledMatrix) -> ptg.Taskpool:
+    """Build the POTRF taskpool over tiled matrix ``A`` (lower)."""
+    NT = A.nt
+    if A.mt != A.nt:
+        raise ValueError("POTRF needs a square tile grid")
+    tp = ptg.Taskpool("potrf", A=A, NT=NT)
+
+    POTRF = tp.task_class(
+        "POTRF", params=("k",),
+        space=lambda g: ((k,) for k in range(g.NT)),
+        affinity=lambda g, k: (g.A, (k, k)),
+        priority=lambda g, k: 3 * (g.NT - k) ** 2,
+        flows=[ptg.FlowSpec(
+            "T", ptg.RW,
+            tile=lambda g, k: (g.A, (k, k)),
+            ins=[ptg.In(data=lambda g, k: (g.A, (k, k)),
+                        guard=lambda g, k: k == 0),
+                 ptg.In(src=("SYRK", lambda g, k: (k, k - 1), "C"),
+                        guard=lambda g, k: k > 0)],
+            outs=[ptg.Out(dst=("TRSM",
+                               lambda g, k: [(m, k) for m in range(k + 1, g.NT)],
+                               "L")),
+                  ptg.Out(data=lambda g, k: (g.A, (k, k)))])])
+
+    TRSM = tp.task_class(
+        "TRSM", params=("m", "k"),
+        space=lambda g: ((m, k) for k in range(g.NT)
+                         for m in range(k + 1, g.NT)),
+        affinity=lambda g, m, k: (g.A, (m, k)),
+        priority=lambda g, m, k: 2 * (g.NT - k) ** 2 - m,
+        flows=[
+            ptg.FlowSpec(
+                "L", ptg.READ,
+                tile=lambda g, m, k: (g.A, (k, k)),
+                ins=[ptg.In(src=("POTRF", lambda g, m, k: (k,), "T"))]),
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                tile=lambda g, m, k: (g.A, (m, k)),
+                ins=[ptg.In(data=lambda g, m, k: (g.A, (m, k)),
+                            guard=lambda g, m, k: k == 0),
+                     ptg.In(src=("GEMM", lambda g, m, k: (m, k, k - 1), "C"),
+                            guard=lambda g, m, k: k > 0)],
+                outs=[
+                    ptg.Out(dst=("SYRK", lambda g, m, k: (m, k), "A")),
+                    # row operand of the GEMMs updating row m
+                    ptg.Out(dst=("GEMM",
+                                 lambda g, m, k: [(m, n, k)
+                                                  for n in range(k + 1, m)],
+                                 "A")),
+                    # transposed operand of the GEMMs updating column m
+                    ptg.Out(dst=("GEMM",
+                                 lambda g, m, k: [(i, m, k)
+                                                  for i in range(m + 1, g.NT)],
+                                 "B")),
+                    ptg.Out(data=lambda g, m, k: (g.A, (m, k)))])])
+
+    SYRK = tp.task_class(
+        "SYRK", params=("m", "k"),
+        space=lambda g: ((m, k) for m in range(1, g.NT)
+                         for k in range(m)),
+        affinity=lambda g, m, k: (g.A, (m, m)),
+        priority=lambda g, m, k: 2 * (g.NT - k) ** 2 - m,
+        flows=[
+            ptg.FlowSpec(
+                "A", ptg.READ,
+                tile=lambda g, m, k: (g.A, (m, k)),
+                ins=[ptg.In(src=("TRSM", lambda g, m, k: (m, k), "C"))]),
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                tile=lambda g, m, k: (g.A, (m, m)),
+                ins=[ptg.In(data=lambda g, m, k: (g.A, (m, m)),
+                            guard=lambda g, m, k: k == 0),
+                     ptg.In(src=("SYRK", lambda g, m, k: (m, k - 1), "C"),
+                            guard=lambda g, m, k: k > 0)],
+                outs=[ptg.Out(dst=("SYRK", lambda g, m, k: (m, k + 1), "C"),
+                              guard=lambda g, m, k: k < m - 1),
+                      ptg.Out(dst=("POTRF", lambda g, m, k: (m,), "T"),
+                              guard=lambda g, m, k: k == m - 1)])])
+
+    GEMM = tp.task_class(
+        "GEMM", params=("m", "n", "k"),
+        space=lambda g: ((m, n, k) for m in range(2, g.NT)
+                         for n in range(1, m) for k in range(n)),
+        affinity=lambda g, m, n, k: (g.A, (m, n)),
+        priority=lambda g, m, n, k: (g.NT - k) ** 2 - m - n,
+        flows=[
+            ptg.FlowSpec(
+                "A", ptg.READ,
+                tile=lambda g, m, n, k: (g.A, (m, k)),
+                ins=[ptg.In(src=("TRSM", lambda g, m, n, k: (m, k), "C"))]),
+            ptg.FlowSpec(
+                "B", ptg.READ,
+                tile=lambda g, m, n, k: (g.A, (n, k)),
+                ins=[ptg.In(src=("TRSM", lambda g, m, n, k: (n, k), "C"))]),
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                tile=lambda g, m, n, k: (g.A, (m, n)),
+                ins=[ptg.In(data=lambda g, m, n, k: (g.A, (m, n)),
+                            guard=lambda g, m, n, k: k == 0),
+                     ptg.In(src=("GEMM",
+                                 lambda g, m, n, k: (m, n, k - 1), "C"),
+                            guard=lambda g, m, n, k: k > 0)],
+                outs=[ptg.Out(dst=("GEMM",
+                                   lambda g, m, n, k: (m, n, k + 1), "C"),
+                              guard=lambda g, m, n, k: k < n - 1),
+                      ptg.Out(dst=("TRSM", lambda g, m, n, k: (m, n), "C"),
+                              guard=lambda g, m, n, k: k == n - 1)])])
+
+    @POTRF.body
+    def potrf_body(task, T):
+        return potrf_tile(T)
+
+    @TRSM.body
+    def trsm_body(task, L, C):
+        return trsm_tile(C, L)
+
+    @SYRK.body
+    def syrk_body(task, A_, C):
+        return syrk_tile(C, A_, alpha=-1.0, beta=1.0)
+
+    @GEMM.body
+    def gemm_body(task, A_, B_, C):
+        return gemm_tile(C, A_, B_, alpha=-1.0, beta=1.0, tb=True)
+
+    return tp
+
+
+def potrf_flops(n: int) -> float:
+    """Useful FLOPs of an n×n Cholesky (LAPACK count)."""
+    return n ** 3 / 3.0 + n ** 2 / 2.0 + n / 6.0

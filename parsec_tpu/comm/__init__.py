@@ -1,0 +1,18 @@
+"""Communication layer.
+
+Reference: the remote-dep protocol (parsec/remote_dep.c: activation
+fan-out over star/chain/binomial propagation trees, rendezvous one-sided
+transfers) over an abstract comm engine (parsec_comm_engine.h:161-183)
+whose reference implementation is MPI funnelled (parsec_mpi_funnelled.c).
+
+TPU mapping: the *data plane* (tile payloads) rides XLA collectives over
+ICI inside compiled SPMD programs (parsec_tpu.compiled.spmd) — no host
+bounce; the *control plane* (activations, termdet waves, user triggers) is
+the :class:`~parsec_tpu.comm.engine.CommEngine` contract implemented here
+by a local loopback engine (single process) and extensible to DCN/gRPC for
+cross-slice deployments.
+"""
+
+from .engine import CommEngine, AMTag
+from .local import LocalCommEngine
+from .collectives import bcast_tree_children, BcastTopology

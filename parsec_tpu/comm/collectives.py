@@ -1,0 +1,65 @@
+"""Collective activation-propagation topologies.
+
+Reference: remote_dep.c:334-372 — broadcasts of activations+data fan out
+down star / chain-pipeline / binomial trees, rebuilt identically at each
+node from the root's participant list (parsec_gather_collective_pattern
+remote_dep.c:382-413). DTD is restricted to star (remote_dep.c:543-551).
+
+These topology functions are shared by the control plane (loopback/DCN
+activations) and by the compiled SPMD path when it lowers a broadcast to
+``ppermute`` steps over the mesh.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import List, Sequence
+
+
+class BcastTopology(enum.Enum):
+    STAR = "star"
+    CHAIN = "chain"
+    BINOMIAL = "binomial"
+
+
+def bcast_tree_children(topology: BcastTopology, participants: Sequence[int],
+                        me: int) -> List[int]:
+    """Children of ``me`` in the broadcast tree over ``participants``
+    (participants[0] is the root). Every node computes the same tree from
+    the same list — the reference's identical-rebuild property."""
+    ranks = list(participants)
+    if me not in ranks:
+        return []
+    idx = ranks.index(me)
+    n = len(ranks)
+    if topology is BcastTopology.STAR:
+        return ranks[1:] if idx == 0 else []
+    if topology is BcastTopology.CHAIN:
+        return [ranks[idx + 1]] if idx + 1 < n else []
+    # binomial: children of idx are idx + 2^k while idx % 2^k == 0 pattern
+    children = []
+    k = 1
+    while True:
+        child = idx + k
+        if idx % (2 * k) != 0 or child >= n:
+            break
+        children.append(ranks[child])
+        k *= 2
+    # reversed so larger subtrees start first (latency hiding)
+    return list(reversed(children))
+
+
+def bcast_tree_parent(topology: BcastTopology, participants: Sequence[int],
+                      me: int) -> int:
+    ranks = list(participants)
+    idx = ranks.index(me)
+    if idx == 0:
+        return -1
+    if topology is BcastTopology.STAR:
+        return ranks[0]
+    if topology is BcastTopology.CHAIN:
+        return ranks[idx - 1]
+    k = 1
+    while idx % (2 * k) == 0:
+        k *= 2
+    return ranks[idx - k]

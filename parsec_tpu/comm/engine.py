@@ -1,0 +1,99 @@
+"""Abstract communication engine (parsec_comm_engine.h:161-183 analog).
+
+The reference engine contract: active-message tag registration/callbacks,
+memory register/retrieve, one-sided put/get with local+remote completion
+callbacks, pack/unpack, progress, sync. Tags below
+``PARSEC_CE_REMOTE_DEP_MAX_CTRL_TAG`` are reserved for the runtime
+(parsec_comm_engine.h:29-38); termdet modules own dedicated tags.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+
+class AMTag(enum.IntEnum):
+    """Reserved active-message tags (parsec_comm_engine.h:29-38 analog)."""
+    ACTIVATE = 0          # REMOTE_DEP_ACTIVATE_TAG
+    GET_DATA = 1          # REMOTE_DEP_GET_DATA_TAG
+    PUT_DATA = 2          # REMOTE_DEP_PUT_DATA_TAG
+    TERMDET_FOURCOUNTER = 3
+    TERMDET_USER_TRIGGER = 4
+    DTD_CONTROL = 5
+    FIRST_USER_TAG = 8
+
+MAX_REGISTERED_TAGS = 32     # PARSEC_MAX_REGISTERED_TAGS (parsec_comm_engine.h:24)
+
+
+class CommEngine:
+    """Engine contract. Rank-count/rank identity + AM + one-sided ops.
+
+    Implementations: :class:`~parsec_tpu.comm.local.LocalCommEngine`
+    (single-process loopback for tests and inline progress) and future
+    DCN transports. The compiled SPMD path bypasses this engine entirely —
+    tile payloads move as XLA collectives over ICI.
+    """
+
+    def __init__(self, rank: int = 0, nb_ranks: int = 1):
+        self.rank = rank
+        self.nb_ranks = nb_ranks
+        self._am_callbacks: Dict[int, Callable] = {}
+        self._enabled = False
+
+    # -- lifecycle --------------------------------------------------------
+    def enable(self) -> None:
+        self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    # -- active messages --------------------------------------------------
+    def tag_register(self, tag: int, cb: Callable[[int, Any], None]) -> None:
+        if len(self._am_callbacks) >= MAX_REGISTERED_TAGS:
+            raise RuntimeError("AM tag space exhausted")
+        self._am_callbacks[tag] = cb
+
+    def tag_unregister(self, tag: int) -> None:
+        self._am_callbacks.pop(tag, None)
+
+    def send_am(self, tag: int, dst_rank: int, msg: Any) -> None:
+        raise NotImplementedError
+
+    # -- one-sided --------------------------------------------------------
+    def mem_register(self, buffer: Any) -> Any:
+        """Returns an opaque memory handle exchangeable over AMs."""
+        raise NotImplementedError
+
+    def mem_unregister(self, handle: Any) -> None:
+        raise NotImplementedError
+
+    def put(self, local_handle: Any, remote_rank: int, remote_handle: Any,
+            on_local_done: Optional[Callable] = None,
+            on_remote_done_tag: Optional[int] = None) -> None:
+        raise NotImplementedError
+
+    def get(self, remote_rank: int, remote_handle: Any, local_handle: Any,
+            on_done: Optional[Callable] = None) -> None:
+        raise NotImplementedError
+
+    # -- progress ---------------------------------------------------------
+    def progress(self) -> int:
+        """Advance pending communications; returns #completions."""
+        return 0
+
+    def sync(self) -> None:
+        pass
+
+    # -- runtime services built on the engine -----------------------------
+    def remote_dep_activate(self, task, ref, target_rank: int) -> None:
+        """parsec_remote_dep_activate analog — forward one satisfied dep to
+        the rank owning the successor."""
+        raise NotImplementedError
+
+    def start_termdet_wave(self, monitor) -> None:
+        raise NotImplementedError
+
+    def broadcast_user_trigger(self, monitor) -> None:
+        raise NotImplementedError

@@ -1,0 +1,195 @@
+"""Single-process loopback comm engine.
+
+Mirrors the reference's inline-progress path: with one rank the comm
+engine runs inline on the calling thread (scheduling.c:555-563) and no
+messages leave the process. Used by tests and as the default when no
+fabric is configured. Multi-"rank" loopback (several Contexts in one
+process exchanging activations through shared queues) exercises the full
+remote-dep protocol without a network, the way the reference's tests run
+2-8 MPI ranks on one node (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from .engine import AMTag, CommEngine
+
+
+class _Fabric:
+    """Shared mailbox fabric connecting loopback ranks in one process."""
+
+    def __init__(self, nb_ranks: int):
+        self.nb_ranks = nb_ranks
+        self.queues: List[queue.Queue] = [queue.Queue() for _ in range(nb_ranks)]
+        self.engines: List[Optional["LocalCommEngine"]] = [None] * nb_ranks
+        self.mem: Dict[int, Any] = {}
+        self._mem_next = 0
+        self._lock = threading.Lock()
+
+    def register_mem(self, buf: Any) -> int:
+        with self._lock:
+            h = self._mem_next
+            self._mem_next += 1
+            self.mem[h] = buf
+            return h
+
+
+class LocalCommEngine(CommEngine):
+    def __init__(self, rank: int = 0, nb_ranks: int = 1,
+                 fabric: Optional[_Fabric] = None):
+        super().__init__(rank, nb_ranks)
+        self.fabric = fabric or _Fabric(nb_ranks)
+        self.fabric.engines[rank] = self
+        # taskpool name -> this rank's termdet monitor (the reference keys
+        # remote activity per taskpool id; waves are per-taskpool)
+        self._termdet_monitors: Dict[str, object] = {}
+        self._progress_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    @classmethod
+    def make_fabric(cls, nb_ranks: int) -> List["LocalCommEngine"]:
+        fab = _Fabric(nb_ranks)
+        return [cls(r, nb_ranks, fab) for r in range(nb_ranks)]
+
+    # -- lifecycle: dedicated progress thread (remote_dep_dequeue_main
+    # analog, remote_dep_mpi.c:461) ---------------------------------------
+    def enable(self) -> None:
+        super().enable()
+        if self.nb_ranks > 1 and self._progress_thread is None:
+            self._stop.clear()
+            t = threading.Thread(target=self._progress_main,
+                                 name=f"parsec-comm-{self.rank}", daemon=True)
+            self._progress_thread = t
+            t.start()
+
+    def disable(self) -> None:
+        super().disable()
+        self._stop.set()
+        if self._progress_thread is not None:
+            self._progress_thread.join(timeout=2.0)
+            self._progress_thread = None
+
+    def _progress_main(self) -> None:
+        while not self._stop.is_set():
+            self.progress(block_s=0.05)
+
+    # -- AMs --------------------------------------------------------------
+    def send_am(self, tag: int, dst_rank: int, msg: Any) -> None:
+        self.fabric.queues[dst_rank].put((tag, self.rank, msg))
+
+    def progress(self, block_s: float = 0.0) -> int:
+        n = 0
+        q = self.fabric.queues[self.rank]
+        while True:
+            try:
+                tag, src, msg = q.get(timeout=block_s) if block_s and n == 0 \
+                    else q.get_nowait()
+            except queue.Empty:
+                return n
+            cb = self._am_callbacks.get(tag)
+            if cb is not None:
+                cb(src, msg)
+            n += 1
+
+    # -- one-sided over the shared heap -----------------------------------
+    def mem_register(self, buffer: Any) -> int:
+        return self.fabric.register_mem(buffer)
+
+    def mem_unregister(self, handle: int) -> None:
+        self.fabric.mem.pop(handle, None)
+
+    def put(self, local_handle: int, remote_rank: int, remote_handle: int,
+            on_local_done: Optional[Callable] = None,
+            on_remote_done_tag: Optional[int] = None) -> None:
+        self.fabric.mem[remote_handle] = self.fabric.mem[local_handle]
+        if on_local_done is not None:
+            on_local_done()
+        if on_remote_done_tag is not None:
+            self.send_am(on_remote_done_tag, remote_rank, remote_handle)
+
+    def get(self, remote_rank: int, remote_handle: int, local_handle: int,
+            on_done: Optional[Callable] = None) -> None:
+        self.fabric.mem[local_handle] = self.fabric.mem[remote_handle]
+        if on_done is not None:
+            on_done()
+
+    # -- runtime services -------------------------------------------------
+    def remote_dep_activate(self, task, ref, target_rank: int) -> None:
+        """Loopback remote-dep: ship (class name, locals, flow, value) to
+        the owning rank's engine, which re-activates it there (the wire
+        protocol's eager path — remote_dep_wire_activate + inline payload,
+        remote_dep.h:41-48)."""
+        tp = task.taskpool
+        monitor = tp.monitor
+        monitor.outgoing_message_start(target_rank)
+        msg = {"taskpool": tp.name, "class": ref.task_class.name,
+               "locals": ref.locals, "flow": ref.flow_name,
+               "dep_index": ref.dep_index, "priority": ref.priority,
+               "value": ref.value}
+        self.send_am(AMTag.ACTIVATE, target_rank, msg)
+        monitor.outgoing_message_end(target_rank)
+
+    def install_activate_handler(self, context) -> None:
+        """Wire the ACTIVATE AM into a context: reconstruct the
+        SuccessorRef and count the dep on the local taskpool replica
+        (remote_dep_mpi_save_activate_cb analog)."""
+        from ..core.taskpool import SuccessorRef
+
+        def _on_activate(src_rank: int, msg: Dict) -> None:
+            tp = next((t for t in context._active_taskpools
+                       if t.name == msg["taskpool"]), None)
+            if tp is None:
+                return
+            tp.monitor.incoming_message_start(src_rank)
+            tc = tp.get_task_class(msg["class"])
+            ref = SuccessorRef(task_class=tc, locals=tuple(msg["locals"]),
+                               flow_name=msg["flow"], value=msg["value"],
+                               dep_index=msg["dep_index"],
+                               priority=msg["priority"])
+            new_task = tp.activate_dep(ref)
+            if new_task is not None:
+                context.schedule(None, [new_task])
+            tp.monitor.incoming_message_end(src_rank)
+
+        self.tag_register(AMTag.ACTIVATE, _on_activate)
+
+    # -- termdet services -------------------------------------------------
+    def register_termdet(self, name: str, monitor) -> None:
+        """Called by Context.add_taskpool: associates this rank's monitor
+        for taskpool ``name`` so waves/triggers can reach every replica."""
+        monitor._termdet_name = name
+        self._termdet_monitors[name] = monitor
+
+    def _peer_monitors(self, name: str):
+        return [(e, e._termdet_monitors.get(name))
+                for e in self.fabric.engines if e is not None]
+
+    def start_termdet_wave(self, monitor) -> None:
+        """Synchronous loopback wave: sum every rank's (sent, received,
+        idle) for the monitor's taskpool; a rank that has not registered
+        its replica yet counts as busy (the wave fails and is retried on a
+        later transition). A successful wave terminates ALL replicas."""
+        name = getattr(monitor, "_termdet_name", None)
+        peers = self._peer_monitors(name) if name is not None else []
+        monitors = [m for (_, m) in peers]
+        if name is None or any(m is None for m in monitors) \
+                or len(monitors) < self.nb_ranks:
+            monitor.wave_result(0, 1, False)     # unready fabric: fail wave
+            return
+        contributions = [m.local_wave_contribution() for m in monitors]
+        total_sent = sum(c[0] for c in contributions)
+        total_recv = sum(c[1] for c in contributions)
+        all_idle = all(c[2] for c in contributions)
+        for m in monitors:
+            m.wave_result(total_sent, total_recv, all_idle)
+
+    def broadcast_user_trigger(self, monitor) -> None:
+        name = getattr(monitor, "_termdet_name", None)
+        if name is None:
+            return
+        for e, peer in self._peer_monitors(name):
+            if peer is not None and peer is not monitor:
+                peer.trigger(propagate=False)

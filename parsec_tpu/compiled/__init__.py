@@ -1,0 +1,18 @@
+"""Compiled execution paths (the TPU performance layer).
+
+The reference dispatches every task individually through a scheduler +
+device stream pipeline. On TPU, per-task dispatch cannot feed the MXU —
+launch overhead dominates for tile-sized kernels. This package compiles a
+PTG taskpool's whole DAG into XLA programs instead:
+
+- :mod:`wavefront`: enumerate the (closed-form) task space, level it into
+  waves, batch same-class tasks per wave, and execute each (class, wave)
+  group as one vmapped XLA call gathering/scattering tiles from a stacked
+  HBM-resident tile store. Single-chip performance path.
+- :mod:`spmd`: the same wavefront plan sharded over a jax.sharding.Mesh —
+  owner-computes over block-cyclic collections with XLA collectives
+  carrying inter-rank dependencies over ICI (replaces remote_dep_mpi.c).
+"""
+
+from .wavefront import WavefrontPlan, plan_taskpool, WavefrontExecutor
+from . import spmd

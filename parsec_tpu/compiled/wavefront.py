@@ -1,0 +1,323 @@
+"""Wavefront compiler: PTG DAG → batched XLA execution.
+
+Why this exists: the reference keeps the MXU-equivalent (CUDA cores) busy
+by pipelining *individual* tile tasks through streams
+(device_cuda_module.c pipeline). On TPU, per-task dispatch of tile-sized
+kernels cannot reach a useful fraction of peak — launch + gap overheads
+dominate and XLA can't fuse across dispatches. The TPU-idiomatic execution
+of a task DAG is:
+
+1. enumerate the task space (closed-form, from the PTG description);
+2. level the DAG into *waves* (all tasks whose predecessors completed in
+   earlier waves) — host-side topological leveling;
+3. inside a wave, group tasks by task class and execute each group as ONE
+   vmapped XLA call: gather the group's input tiles from a stacked
+   HBM-resident store (one (ntiles, mb, nb) jax.Array per collection),
+   run the batched body (a single large batched matmul for GEMM-like
+   classes → MXU-friendly), scatter outputs back;
+4. the whole schedule is a pure function ``stores → stores``, so it can be
+   jitted end-to-end (one XLA program for the whole DAG) or dispatched
+   wave-by-wave with power-of-two batch bucketing to bound compilation.
+
+Store-based execution is valid when every intermediate tile version has
+its readers ordered (by wave level) before the next writer of that tile —
+true for accumulate-chain dense LA DAGs (POTRF/GEMM/QR). ``plan_taskpool``
+verifies this *hazard-freedom* property while planning and rejects DAGs
+that need value-passing (those run on the host runtime instead).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.task import DeviceType, FlowAccess, Task
+from ..core.taskpool import DataRef, SuccessorRef
+from ..dsl.ptg import PTGTaskClass, Taskpool as PTGTaskpool
+from ..utils.debug import debug_verbose
+
+
+@dataclass
+class WaveGroup:
+    """All tasks of one class inside one wave."""
+    tc: PTGTaskClass
+    level: int
+    tasks: List[Tuple[int, ...]]
+    # per non-CTL flow, (collection name, np.int32[B] tile-slot indices)
+    in_slots: List[Tuple[str, np.ndarray]] = field(default_factory=list)
+    out_slots: List[Tuple[str, np.ndarray]] = field(default_factory=list)
+
+
+@dataclass
+class WavefrontPlan:
+    taskpool: PTGTaskpool
+    waves: List[List[WaveGroup]]
+    collections: Dict[str, Any]              # name -> collection
+    slot_maps: Dict[str, Dict[Tuple, int]]   # name -> (tile key -> slot)
+    n_tasks: int = 0
+
+    @property
+    def n_waves(self) -> int:
+        return len(self.waves)
+
+
+def _flow_tile(tc: PTGTaskClass, fname: str, locals) -> Tuple[Any, Tuple]:
+    spec = tc.specs[fname]
+    if spec.tile is None:
+        raise ValueError(
+            f"compiled mode requires FlowSpec.tile on {tc.name}.{fname}")
+    dc, key = spec.tile(tc.tp.g, *locals)
+    return dc, tuple(key)
+
+
+def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
+    """Enumerate, level, group and hazard-check a PTG taskpool."""
+    # ---- enumerate tasks and assign ids
+    tasks: List[Tuple[PTGTaskClass, Tuple[int, ...]]] = []
+    tid: Dict[Tuple[str, Tuple], int] = {}
+    for tc in tp.task_classes:
+        for p in tc.enumerate_space():
+            tid[(tc.name, p)] = len(tasks)
+            tasks.append((tc, p))
+    n = len(tasks)
+
+    # ---- build successor edges via the closed-form iterators
+    succs: List[List[int]] = [[] for _ in range(n)]
+    edges: List[Tuple[int, int, str]] = []   # (producer, consumer, flow)
+    indeg = np.zeros(n, dtype=np.int64)
+    for i, (tc, p) in enumerate(tasks):
+        dry = Task(tp, tc, p)
+        for f in tc.flows:
+            dry.data[f.name] = 0
+            dry.output[f.name] = 0
+        for ref in tc.iterate_successors(dry):
+            if isinstance(ref, DataRef):
+                continue
+            j = tid[(ref.task_class.name, tuple(ref.locals))]
+            succs[i].append(j)
+            edges.append((i, j, ref.flow_name))
+            indeg[j] += 1
+
+    # ---- Kahn leveling
+    level = np.zeros(n, dtype=np.int64)
+    frontier = [i for i in range(n) if indeg[i] == 0]
+    seen = len(frontier)
+    while frontier:
+        nxt = []
+        for i in frontier:
+            for j in succs[i]:
+                level[j] = max(level[j], level[i] + 1)
+                indeg[j] -= 1
+                if indeg[j] == 0:
+                    nxt.append(j)
+                    seen += 1
+        frontier = nxt
+    if seen != n:
+        raise RuntimeError("PTG DAG has a cycle")
+
+    # ---- group into waves
+    n_waves = int(level.max()) + 1 if n else 0
+    waves: List[List[WaveGroup]] = [[] for _ in range(n_waves)]
+    groups: Dict[Tuple[int, str], WaveGroup] = {}
+    for i, (tc, p) in enumerate(tasks):
+        gkey = (int(level[i]), tc.name)
+        grp = groups.get(gkey)
+        if grp is None:
+            grp = WaveGroup(tc=tc, level=int(level[i]), tasks=[])
+            groups[gkey] = grp
+            waves[int(level[i])].append(grp)
+        grp.tasks.append(p)
+
+    # ---- collect collections + slot maps; hazard check
+    collections: Dict[str, Any] = {}
+    slot_maps: Dict[str, Dict[Tuple, int]] = {}
+
+    def _register(dc) -> str:
+        if dc.name not in collections:
+            collections[dc.name] = dc
+            slot_maps[dc.name] = dc.tile_index()
+        elif collections[dc.name] is not dc:
+            raise ValueError(f"two collections share the name {dc.name!r}")
+        return dc.name
+
+    for w, wave in enumerate(waves):
+        for grp in wave:
+            tc = grp.tc
+            in_fl = [f for f in tc.flows if not f.is_ctl
+                     and (f.access & FlowAccess.READ)]
+            out_fl = [f for f in tc.flows if not f.is_ctl
+                      and (f.access & FlowAccess.WRITE)]
+            ins: Dict[str, List[int]] = {f.name: [] for f in in_fl}
+            outs: Dict[str, List[int]] = {f.name: [] for f in out_fl}
+            in_names: Dict[str, str] = {}
+            out_names: Dict[str, str] = {}
+            for p in grp.tasks:
+                for f in in_fl:
+                    dc, key = _flow_tile(tc, f.name, p)
+                    name = _register(dc)
+                    in_names[f.name] = name
+                    ins[f.name].append(slot_maps[name][key])
+                for f in out_fl:
+                    dc, key = _flow_tile(tc, f.name, p)
+                    name = _register(dc)
+                    out_names[f.name] = name
+                    outs[f.name].append(slot_maps[name][key])
+            grp.in_slots = [(in_names[f.name],
+                             np.asarray(ins[f.name], dtype=np.int32))
+                            for f in in_fl]
+            grp.out_slots = [(out_names[f.name],
+                              np.asarray(outs[f.name], dtype=np.int32))
+                             for f in out_fl]
+
+    # ---- hazard checks for store-based execution
+    # (a) a tile must not be written twice in one wave (lost update);
+    # (b) for every dataflow edge P --tile T--> R, no OTHER task may write
+    #     T in a wave w with level(P) < w < level(R): the store would hand
+    #     R a newer version than the dataflow prescribes. Same-wave writes
+    #     (w == level(R)) are safe — the wave gathers before it scatters.
+    write_waves: Dict[Tuple[str, Tuple], List[int]] = {}
+    for w, wave in enumerate(waves):
+        for grp in wave:
+            for p in grp.tasks:
+                for f in grp.tc.flows:
+                    if f.is_ctl or not (f.access & FlowAccess.WRITE):
+                        continue
+                    dc, key = _flow_tile(grp.tc, f.name, p)
+                    tk = (dc.name, key)
+                    lst = write_waves.setdefault(tk, [])
+                    if w in lst:
+                        raise RuntimeError(
+                            f"tile {tk} written twice in wave {w}: DAG "
+                            f"under-constrained for store-based execution")
+                    lst.append(w)
+    for (i, j, fname) in edges:
+        tc_j, p_j = tasks[j]
+        f_j = tc_j.flow_by_name[fname]
+        if f_j.is_ctl:
+            continue
+        dc, key = _flow_tile(tc_j, fname, p_j)
+        lw, lr = int(level[i]), int(level[j])
+        for w in write_waves.get((dc.name, key), ()):
+            if lw < w < lr:
+                tc_i, p_i = tasks[i]
+                raise RuntimeError(
+                    f"WAR/versioning hazard on tile {(dc.name, key)}: "
+                    f"{tc_i.name}{p_i}@wave{lw} feeds {tc_j.name}{p_j}@"
+                    f"wave{lr} but the tile is rewritten in wave {w}; "
+                    f"use the host runtime for this DAG")
+
+    plan = WavefrontPlan(taskpool=tp, waves=waves, collections=collections,
+                         slot_maps=slot_maps, n_tasks=n)
+    debug_verbose(3, "wavefront", "planned %s: %d tasks, %d waves",
+                  tp.name, n, len(waves))
+    return plan
+
+
+class WavefrontExecutor:
+    """Executes a :class:`WavefrontPlan` on the TPU.
+
+    Two modes:
+    - :meth:`run_arrays` — pure function ``{name: stacked} → {name:
+      stacked}``; traceable, so wrapping it in ``jax.jit`` compiles the
+      ENTIRE DAG into one XLA program (used by bench + __graft_entry__).
+    - :meth:`run` — host-driven: converts collections to stacked stores,
+      applies ``run_arrays`` (optionally jitted), writes tiles back.
+
+    Batch padding: every group's gather/scatter indices are padded to the
+    next power of two; scatter padding lands in a dummy slot appended to
+    each store, so bucketized compilation reuses a handful of shapes per
+    class instead of one per wave.
+    """
+
+    def __init__(self, plan: WavefrontPlan, bucket: bool = True,
+                 device_type: DeviceType = DeviceType.TPU):
+        import jax
+        import jax.numpy as jnp
+        self.jax, self.jnp = jax, jnp
+        self.plan = plan
+        self.bucket = bucket
+        self.device_type = device_type
+        self._vmapped: Dict[str, Callable] = {}
+
+    # -- body lookup ------------------------------------------------------
+    def _body(self, tc: PTGTaskClass) -> Callable:
+        fn = self._vmapped.get(tc.name)
+        if fn is None:
+            chore = tc.chore_for(self.device_type) or \
+                tc.chore_for(DeviceType.CPU)
+            if chore is None:
+                raise ValueError(f"no body for {tc.name}")
+            body = chore.hook
+            fn = self.jax.vmap(lambda *tiles, _b=body: _b(None, *tiles))
+            self._vmapped[tc.name] = fn
+        return fn
+
+    @staticmethod
+    def _pad(idx: np.ndarray, size: int, fill: int) -> np.ndarray:
+        if len(idx) == size:
+            return idx
+        out = np.full(size, fill, dtype=np.int32)
+        out[:len(idx)] = idx
+        return out
+
+    # -- pure store-passing execution ------------------------------------
+    def run_arrays(self, stores: Dict[str, Any]) -> Dict[str, Any]:
+        """stores: name → (ntiles+1, mb, nb) array (last slot = dummy)."""
+        jnp = self.jnp
+        stores = dict(stores)
+        for wave in self.plan.waves:
+            # gather-before-scatter inside the wave: snapshot reads
+            snapshot = stores
+            updates: List[Tuple[str, Any, Any]] = []
+            for grp in wave:
+                B = len(grp.tasks)
+                Bp = 1 << (B - 1).bit_length() if self.bucket else B
+                inputs = []
+                for (name, idx) in grp.in_slots:
+                    gidx = self._pad(idx, Bp, 0)
+                    inputs.append(snapshot[name][gidx])
+                outs = self._body(grp.tc)(*inputs)
+                out_fl = [f for f in grp.tc.flows
+                          if not f.is_ctl and (f.access & FlowAccess.WRITE)]
+                if not isinstance(outs, (tuple, list)):
+                    outs = (outs,)
+                if len(outs) != len(out_fl):
+                    raise ValueError(
+                        f"{grp.tc.name}: body returned {len(outs)} outputs "
+                        f"for {len(out_fl)} write flows")
+                for (name, idx), val in zip(grp.out_slots, outs):
+                    dummy = stores[name].shape[0] - 1
+                    sidx = self._pad(idx, Bp, dummy)
+                    updates.append((name, sidx, val))
+            for name, sidx, val in updates:
+                stores[name] = stores[name].at[sidx].set(
+                    val.astype(stores[name].dtype))
+        return stores
+
+    # -- host-driven run --------------------------------------------------
+    def make_stores(self) -> Dict[str, Any]:
+        jnp = self.jnp
+        stores = {}
+        for name, dc in self.plan.collections.items():
+            arr, _ = dc.to_stacked()
+            dummy = jnp.zeros((1,) + arr.shape[1:], dtype=arr.dtype)
+            stores[name] = jnp.concatenate([arr, dummy], axis=0)
+        return stores
+
+    def write_back(self, stores: Dict[str, Any]) -> None:
+        for name, dc in self.plan.collections.items():
+            dc.from_stacked(stores[name][:-1], self.plan.slot_maps[name])
+
+    def run(self, jit: bool = True) -> float:
+        t0 = time.perf_counter()
+        stores = self.make_stores()
+        fn = self.jax.jit(self.run_arrays) if jit else self.run_arrays
+        out = fn(stores)
+        for v in out.values():
+            v.block_until_ready()
+        dt = time.perf_counter() - t0
+        self.write_back(out)
+        return dt

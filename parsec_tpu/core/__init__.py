@@ -1,0 +1,5 @@
+from .task import FlowAccess, Flow, Task, TaskStatus, Chore, DeviceType, HookReturn
+from .taskpool import Taskpool, TaskClass
+from .context import Context, init, fini
+from .compound import compose
+from . import datarepo

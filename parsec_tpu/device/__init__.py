@@ -1,0 +1,22 @@
+"""Device framework (reference parsec/mca/device/).
+
+The reference registers device modules (CPU, recursive, CUDA) with
+per-device statistics and GFLOPS weights used for load balancing
+(device.c:194-906, parsec_get_best_device device.c:79). Here:
+
+- :class:`CPUDevice` executes chores inline on the worker thread (numpy /
+  plain Python bodies).
+- :class:`TPUDevice` (device/tpu.py) executes chores through JAX: bodies
+  are jnp/pallas functions jitted per task class; XLA's async dispatch
+  plays the role of the reference's stream pipeline — the returned arrays
+  are futures, so successive tasks pipeline on-chip without host sync.
+- :class:`RecursiveDevice` runs a nested taskpool inside a task
+  (PARSEC_DEV_RECURSIVE, device.h:64).
+"""
+
+from .base import Device, Registry
+from .cpu import CPUDevice
+from .recursive import RecursiveDevice
+from ..core.task import DeviceType
+
+__all__ = ["Device", "Registry", "CPUDevice", "RecursiveDevice", "DeviceType"]

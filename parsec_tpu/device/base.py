@@ -1,0 +1,115 @@
+"""Device base class and registry (reference parsec/mca/device/device.c)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..core.task import Chore, DeviceType, HookReturn, Task
+from ..utils import mca_param
+from ..utils.debug import debug_verbose
+
+mca_param.register("device.tpu.enabled", True, help="register the TPU device")
+
+
+class Device:
+    """A device module (parsec_device_module_t analog)."""
+
+    device_type = DeviceType.NONE
+    name = "device"
+
+    def __init__(self) -> None:
+        self.index = -1
+        self.registry: Optional["Registry"] = None
+        # statistics (reference device.h:132-141 per-device counters)
+        self.stats = {"tasks": 0, "exec_s": 0.0,
+                      "bytes_in": 0, "bytes_out": 0}
+        # relative throughput weight for load balancing
+        # (reference: GFLOPS weights, device_cuda_module.c:53-117)
+        self.weight = 1.0
+        self.load = 0.0
+        self._lock = threading.Lock()
+
+    def attach(self, registry: "Registry", index: int) -> None:
+        self.registry = registry
+        self.index = index
+
+    def execute(self, es, task: Task, chore: Chore) -> HookReturn:
+        raise NotImplementedError
+
+    def _run_hook(self, task: Task, chore: Chore) -> HookReturn:
+        """Run the functional body and normalize outputs into
+        ``task.output`` keyed by output-flow name."""
+        t0 = time.perf_counter()
+        inputs = task.input_values()
+        result = chore.hook(task, *inputs)
+        out_flows = task.task_class.output_flows
+        if result is None:
+            outs = {}
+        elif isinstance(result, dict):
+            outs = result
+        elif isinstance(result, (tuple, list)):
+            if len(result) != len(out_flows):
+                raise ValueError(
+                    f"{task!r}: body returned {len(result)} values for "
+                    f"{len(out_flows)} output flows")
+            outs = {f.name: v for f, v in zip(out_flows, result)}
+        else:
+            if len(out_flows) != 1:
+                raise ValueError(
+                    f"{task!r}: single return value but {len(out_flows)} "
+                    f"output flows")
+            outs = {out_flows[0].name: result}
+        task.output.update(outs)
+        with self._lock:
+            self.stats["tasks"] += 1
+            self.stats["exec_s"] += time.perf_counter() - t0
+        return HookReturn.DONE
+
+    def dump_statistics(self) -> Dict:
+        return dict(self.stats, name=self.name, index=self.index)
+
+
+class Registry:
+    """Device registry (parsec_mca_device_* analog)."""
+
+    def __init__(self, context) -> None:
+        from .cpu import CPUDevice
+        from .recursive import RecursiveDevice
+        self.context = context
+        self.devices: List[Device] = []
+        self.add(CPUDevice())
+        self.add(RecursiveDevice())
+        if mca_param.get("device.tpu.enabled", True):
+            try:
+                from .tpu import TPUDevice
+                self.add(TPUDevice())
+            except Exception as exc:  # jax missing/broken → CPU-only context
+                debug_verbose(2, "device", "TPU device unavailable: %s", exc)
+
+    def add(self, dev: Device) -> Device:
+        dev.attach(self, len(self.devices))
+        self.devices.append(dev)
+        debug_verbose(4, "device", "registered device %d: %s",
+                      dev.index, dev.name)
+        return dev
+
+    def device_for(self, device_type: DeviceType, task: Task) -> Optional[Device]:
+        """parsec_get_best_device analog: among devices matching the chore's
+        type, pick the least (load / weight)."""
+        best, best_score = None, None
+        for dev in self.devices:
+            if not (dev.device_type & device_type):
+                continue
+            score = dev.load / dev.weight
+            if best_score is None or score < best_score:
+                best, best_score = dev, score
+        return best
+
+    def by_type(self, device_type: DeviceType) -> List[Device]:
+        return [d for d in self.devices if d.device_type & device_type]
+
+    def dump_statistics(self) -> List[Dict]:
+        """parsec_mca_device_dump_and_reset_statistics analog."""
+        return [d.dump_statistics() for d in self.devices]

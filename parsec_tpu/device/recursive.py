@@ -1,0 +1,37 @@
+"""Recursive device: run a nested taskpool inside a task.
+
+Reference: PARSEC_DEV_RECURSIVE (device.h:64) — a chore of type RECURSIVE
+builds a child taskpool (e.g. a finer-tiled factorization of one tile) and
+the task completes when the child terminates. The chore hook must return a
+``Taskpool``; the parent task's completion is deferred until the child
+taskpool's on_complete fires (HookReturn.ASYNC path).
+"""
+
+from __future__ import annotations
+
+from .base import Device
+from ..core.task import Chore, DeviceType, HookReturn, Task
+from ..core.taskpool import Taskpool
+
+
+class RecursiveDevice(Device):
+    device_type = DeviceType.RECURSIVE
+    name = "recursive"
+
+    def execute(self, es, task: Task, chore: Chore) -> HookReturn:
+        child = chore.hook(task, *task.input_values())
+        if not isinstance(child, Taskpool):
+            raise TypeError("recursive chore must return a Taskpool")
+        ctx = self.registry.context
+
+        def _child_done(tp, _task=task) -> None:
+            if tp.error is not None:
+                # child failed: propagate instead of completing the parent
+                # as a success with empty outputs
+                _task.taskpool.abort(tp.error)
+                return
+            ctx.complete_task(None, _task)
+
+        child.on_complete = _child_done
+        ctx.add_taskpool(child)
+        return HookReturn.ASYNC

@@ -1,0 +1,10 @@
+"""DSL layer: the two front ends that produce taskpools.
+
+Reference: PTG (compiled parameterized task graphs, the JDF language +
+parsec_ptgpp compiler, parsec/interfaces/ptg/) and DTD (dynamic task
+discovery, parsec/interfaces/dtd/insert_function.c). Both sit strictly
+above the core and only produce Taskpool/TaskClass structures.
+"""
+
+from . import dtd
+from . import ptg

@@ -1,0 +1,354 @@
+"""DTD: dynamic task discovery.
+
+Reference: parsec/interfaces/dtd/insert_function.c (3,612 LoC) — tasks are
+inserted at runtime with varargs flags (INPUT/OUTPUT/INOUT/VALUE/SCRATCH +
+AFFINITY/..., insert_function.h:60-78); task classes are created lazily per
+(function, argument-shape) (insert_function.c:1015); per-tile
+``last_writer``/``last_user`` tracking orders accesses
+(insert_function_internal.h:191-211, overlap_strategies.c); a sliding
+window throttles insertion (insert_function.h:131-142).
+
+TPU-first divergence: task bodies are functional (values in → new values
+out), so WAR hazards vanish — a reader snapshots the version current at
+*insert* time (program order), immutable arrays keep it valid, and a later
+writer simply produces a new version. Only RAW (value flows from the
+in-flight last writer) and WAW (writer chain) edges are materialized, which
+strictly increases available parallelism versus the reference's read-list
+serialization (overlap_strategies.c:38-120).
+
+Usage::
+
+    tp = dtd.Taskpool("gemm")
+    ctx.add_taskpool(tp)
+    tp.insert_task(body, dtd.TileArg(A, (i, k), dtd.INPUT),
+                         dtd.TileArg(C, (i, j), dtd.INOUT),
+                         dtd.ValueArg(alpha))
+    ...
+    tp.wait()
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.task import Chore, DeviceType, Flow, FlowAccess, Task
+from ..core.taskpool import DEPS_COUNTER, SuccessorRef, TaskClass
+from ..core.taskpool import Taskpool as CoreTaskpool
+from ..data.collection import DataCollection
+from ..utils import mca_param
+
+# access flags (insert_function.h:60-78 analog)
+INPUT = FlowAccess.READ
+OUTPUT = FlowAccess.WRITE
+INOUT = FlowAccess.RW
+
+_GOAL_UNSET = 1 << 40       # sentinel while an insert is still linking
+
+mca_param.register("dtd.window_size", 4096,
+                   help="max in-flight inserted tasks before the inserter throttles")
+mca_param.register("dtd.threshold_size", 2048,
+                   help="inserter resumes below this many in-flight tasks")
+
+
+@dataclass
+class TileArg:
+    """A data argument: tile ``key`` of ``collection`` with an access mode.
+    ``affinity=True`` marks the argument whose owner rank places the task
+    (PARSEC_AFFINITY analog)."""
+    collection: DataCollection
+    key: Tuple
+    access: FlowAccess
+    affinity: bool = False
+
+
+@dataclass
+class ValueArg:
+    """Pass-by-value argument (PARSEC_VALUE analog)."""
+    value: Any
+
+
+@dataclass
+class ScratchArg:
+    """Per-task scratch allocation (PARSEC_SCRATCH analog): the body
+    receives a fresh numpy buffer of ``shape``/``dtype``."""
+    shape: Tuple[int, ...]
+    dtype: Any = "float32"
+
+
+class _Tile:
+    """Per-(collection, key) tracking state (parsec_dtd_tile_t analog)."""
+
+    __slots__ = ("collection", "key", "lock", "last_writer", "last_writer_flow")
+
+    def __init__(self, collection: DataCollection, key):
+        self.collection = collection
+        self.key = key
+        self.lock = threading.Lock()
+        self.last_writer: Optional[Task] = None     # not-yet-complete writer
+        self.last_writer_flow: Optional[str] = None
+
+
+class _TileBank:
+    """parsec_dtd_tile_of analog: lazily materialized tracking tiles."""
+
+    def __init__(self) -> None:
+        self._tiles: Dict[Tuple[int, Any], _Tile] = {}
+        self._lock = threading.Lock()
+
+    def tile_of(self, dc: DataCollection, key) -> _Tile:
+        hkey = (dc.dc_id, tuple(key) if isinstance(key, (tuple, list)) else key)
+        with self._lock:
+            t = self._tiles.get(hkey)
+            if t is None:
+                t = _Tile(dc, hkey[1])
+                self._tiles[hkey] = t
+            return t
+
+    def all(self) -> List[_Tile]:
+        with self._lock:
+            return list(self._tiles.values())
+
+
+class Taskpool(CoreTaskpool):
+    """DTD taskpool (parsec_dtd_taskpool_new analog)."""
+
+    def __init__(self, name: str = "dtd"):
+        super().__init__(name=name)
+        self.tiles = _TileBank()
+        self._classes: Dict[Any, TaskClass] = {}
+        self._class_lock = threading.Lock()
+        self._goals: Dict[int, int] = {}
+        self._tasks_by_uid: Dict[int, Task] = {}
+        self._state_lock = threading.Lock()
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+        self._window = int(mca_param.get("dtd.window_size", 4096))
+        self._threshold = int(mca_param.get("dtd.threshold_size", 2048))
+        self._closed = False
+        # hold the taskpool open while the user is still inserting
+        # (reference: DTD keeps a pending action until taskpool_wait)
+        self.on_enqueue = lambda tp: tp.addto_runtime_actions(1)
+
+    def _on_terminated(self) -> None:
+        # release an inserter blocked in the sliding-window throttle (the
+        # pool may have aborted while insert_task was waiting for drain)
+        with self._inflight_cv:
+            self._closed = self._closed or (self.error is not None)
+            self._inflight_cv.notify_all()
+        super()._on_terminated()
+
+    # ------------------------------------------------------------- classes
+    def _task_class_for(self, fn: Callable, shape: Tuple,
+                        device: DeviceType) -> TaskClass:
+        """Lazily create a task class per (fn, arg shape)
+        (insert_function.c:1015 analog)."""
+        key = (fn, shape, device)
+        with self._class_lock:
+            tc = self._classes.get(key)
+            if tc is not None:
+                return tc
+            flows = [Flow(f"f{i}", access if access else FlowAccess.READ)
+                     for i, (kind, access) in enumerate(shape)
+                     if kind == "tile"]
+            tc = TaskClass(getattr(fn, "__name__", "dtd_task"),
+                           len(self.task_classes), params=("uid",),
+                           flows=flows, deps_mode=DEPS_COUNTER)
+            tc.deps_goal = lambda locals: self._goals.get(locals[0], _GOAL_UNSET)
+            tc.iterate_successors = self._iterate_successors
+
+            def _hook(task: Task, *flow_vals, _fn=fn):
+                args: List[Any] = []
+                it = iter(flow_vals)
+                for (kind, payload) in task.dsl["argspec"]:
+                    if kind == "tile":
+                        args.append(next(it))
+                    elif kind == "value":
+                        args.append(payload)
+                    else:  # scratch
+                        args.append(np.zeros(payload[0], dtype=payload[1]))
+                return _fn(*args)
+
+            tc.add_chore(Chore(device, _hook, batchable=False))
+            self.add_task_class(tc)
+            self._classes[key] = tc
+            return tc
+
+    # ------------------------------------------------------------- insert
+    def insert_task(self, fn: Callable, *args, priority: int = 0,
+                    device: DeviceType = DeviceType.ALL,
+                    name: Optional[str] = None) -> Task:
+        """parsec_dtd_insert_task analog (insert_function.c:3488)."""
+        if self.error is not None:
+            raise RuntimeError(
+                f"taskpool {self.name} aborted: {self.error}") from self.error
+        if self._closed:
+            raise RuntimeError("taskpool already drained by wait()")
+        if self.context is None:
+            raise RuntimeError("add_taskpool(tp) before insert_task")
+        if not self.context._started:
+            # reference: the context must be started before DTD insertion
+            # (insert_function.c checks the same and the sliding window
+            # would deadlock otherwise)
+            self.context.start()
+        shape = tuple(
+            ("tile", a.access) if isinstance(a, TileArg)
+            else ("value", None) if isinstance(a, ValueArg)
+            else ("scratch", None)
+            for a in args)
+        tc = self._task_class_for(fn, shape, device)
+
+        task = Task(self, tc, (0,), priority=priority)
+        task.locals = (task.uid,)
+        task.dsl.update(argspec=[], out_tiles=[], succ=[], done=False,
+                        lock=threading.Lock(), affinity=None)
+
+        # register before linking so a racing writer completion can route
+        # activations to this task
+        with self._state_lock:
+            self._goals[task.uid] = _GOAL_UNSET
+            self._tasks_by_uid[task.uid] = task
+        with self._inflight_cv:
+            self._inflight += 1
+        self.addto_nb_tasks(1)
+
+        goal = 0
+        flow_i = 0
+        for a in args:
+            if isinstance(a, ValueArg):
+                task.dsl["argspec"].append(("value", a.value))
+                continue
+            if isinstance(a, ScratchArg):
+                task.dsl["argspec"].append(("scratch", (a.shape, a.dtype)))
+                continue
+            tile = self.tiles.tile_of(a.collection, a.key)
+            fname = f"f{flow_i}"
+            flow_i += 1
+            task.dsl["argspec"].append(("tile", None))
+            if a.affinity:
+                task.dsl["affinity"] = (a.collection, a.key)
+            with tile.lock:
+                writer = tile.last_writer
+            linked = False
+            if writer is not None:
+                with writer.dsl["lock"]:
+                    if not writer.dsl["done"]:
+                        ref = SuccessorRef(task_class=tc, locals=task.locals,
+                                           flow_name=fname, value=None,
+                                           priority=priority)
+                        ref.src_flow = tile.last_writer_flow
+                        writer.dsl["succ"].append(ref)
+                        goal += 1
+                        linked = True
+            if not linked:
+                # no in-flight writer: snapshot the program-order value now
+                # (immutable arrays make the snapshot stay valid)
+                task.data[fname] = a.collection.data_of(a.key)
+            if a.access & FlowAccess.WRITE:
+                with tile.lock:
+                    tile.last_writer = task
+                    tile.last_writer_flow = fname
+                task.dsl["out_tiles"].append((tile, fname))
+
+        # finalize the goal; racing activations may already have counted
+        with self._state_lock:
+            self._goals[task.uid] = goal
+        if goal == 0:
+            self.context.schedule(None, [task])
+        else:
+            ent = self.pending.finalize(tc.make_key(task.locals), goal,
+                                        DEPS_COUNTER)
+            if ent is not None:
+                task.data.update(ent["data"])
+                task.priority = max(task.priority, ent["priority"])
+                self.context.schedule(None, [task])
+
+        # sliding window: throttle the inserting thread
+        with self._inflight_cv:
+            if self._inflight >= self._window:
+                while self._inflight > self._threshold and not self._closed:
+                    self._inflight_cv.wait(timeout=0.05)
+        return task
+
+    # ----------------------------------------------------- class callbacks
+    def _iterate_successors(self, task: Task):
+        # 1) write produced versions back and retire the writer slot, so
+        #    late-inserted readers snapshot the new value
+        for tile, fname in task.dsl["out_tiles"]:
+            if fname in task.output:
+                tile.collection.write_tile(tile.key, task.output[fname])
+            with tile.lock:
+                if tile.last_writer is task:
+                    tile.last_writer = None
+                    tile.last_writer_flow = None
+        # 2) only then mark done and deliver the linked successors
+        with task.dsl["lock"]:
+            task.dsl["done"] = True
+            succ = list(task.dsl["succ"])
+            task.dsl["succ"].clear()
+        refs: List[SuccessorRef] = []
+        for ref in succ:
+            src_flow = getattr(ref, "src_flow", None)
+            if src_flow is not None and src_flow in task.output:
+                ref.value = task.output[src_flow]
+            elif src_flow is not None:
+                ref.value = task.data.get(src_flow)
+            refs.append(ref)
+        with self._state_lock:
+            self._goals.pop(task.uid, None)
+            self._tasks_by_uid.pop(task.uid, None)
+        with self._inflight_cv:
+            self._inflight -= 1
+            self._inflight_cv.notify_all()
+        return refs
+
+    # -------------------------------------------------------------- drain
+    def activate_dep(self, ref: SuccessorRef) -> Optional[Task]:
+        """DTD successors already exist at activation time — count down on
+        the pre-built task instead of constructing a new one."""
+        uid = ref.locals[0]
+        with self._state_lock:
+            goal = self._goals.get(uid, _GOAL_UNSET)
+            task = self._tasks_by_uid.get(uid)
+        ent = self.pending.update(ref.task_class.make_key(ref.locals),
+                                  ref.flow_name, ref.value, ref.dep_index,
+                                  goal, DEPS_COUNTER, ref.priority)
+        if ent is None:
+            return None
+        if task is None:
+            raise RuntimeError(f"DTD successor uid={uid} vanished")
+        task.data.update(ent["data"])
+        task.priority = max(task.priority, ent["priority"])
+        return task
+
+    def wait(self, context=None) -> None:
+        """parsec_dtd_taskpool_wait analog: drain all inserted tasks."""
+        self._closed = True
+        with self._inflight_cv:
+            self._inflight_cv.notify_all()
+        self.addto_runtime_actions(-1)
+        self.wait_completed()
+
+    def flush(self, collection: Optional[DataCollection] = None,
+              timeout: float = 60.0) -> None:
+        """parsec_dtd_data_flush analog: wait until no in-flight writer
+        remains for the collection's tiles (produced versions are written
+        back at completion, so afterwards ``data_of`` is current)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            busy = False
+            for tile in self.tiles.all():
+                if collection is not None and tile.collection is not collection:
+                    continue
+                with tile.lock:
+                    if tile.last_writer is not None:
+                        busy = True
+                        break
+            if not busy:
+                return
+            time.sleep(0.001)
+        raise TimeoutError("DTD flush timed out")

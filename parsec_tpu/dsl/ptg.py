@@ -1,0 +1,331 @@
+"""PTG: parameterized task graphs (the JDF-language equivalent).
+
+Reference: the JDF language + parsec_ptgpp source-to-source compiler
+(parsec/interfaces/ptg/ptg-compiler/: parsec.l, parsec.y, jdf2c.c 8,636
+LoC). A JDF task class declares parameters with ranges, a partitioning
+predicate (``: A(k, k)``), per-flow guarded dependencies
+(``RW T <- (k == 0) ? A(k, k) : T SYRK(k-1, k)``; ``-> T TRSM(k+1..NT, k)``)
+and per-device bodies. The generated C gives PTG its key property:
+**O(1) distributed dependency discovery** — each rank evaluates, from
+closed-form expressions, which tasks exist, who their successors are, and
+which are remote, with no global graph materialization.
+
+Here the same structure is expressed directly in Python: guards, parameter
+ranges and dependency targets are closures over the taskpool globals, so
+discovery stays closed-form (no graph is ever materialized). Both sides of
+each edge are declared (``ins`` on the consumer, ``outs`` on the producer)
+exactly as in JDF; :func:`check_taskpool` cross-validates the two views the
+way the reference's iterators_checker PINS module does at runtime.
+
+Dependency counting uses the mask strategy with one bit per consumer flow
+(a JDF flow has exactly one active input dependency per task instance, so
+flow-granular bits are sufficient and duplicate activations are caught —
+reference mask mode, parsec.c:1601).
+
+Example (tiled Cholesky's POTRF class)::
+
+    tp = ptg.Taskpool("potrf", NT=4, A=A)
+    POTRF = tp.task_class(
+        "POTRF", params=("k",),
+        space=lambda g: ((k,) for k in range(g.NT)),
+        affinity=lambda g, k: (g.A, (k, k)),
+        flows=[
+          ptg.FlowSpec("T", ptg.RW,
+            ins=[ptg.In(data=lambda g, k: (g.A, (k, k)),
+                        guard=lambda g, k: k == 0),
+                 ptg.In(src=("SYRK", lambda g, k: (k - 1, k), "T"),
+                        guard=lambda g, k: k > 0)],
+            outs=[ptg.Out(dst=("TRSM",
+                               lambda g, k: [(m, k) for m in range(k + 1, g.NT)],
+                               "A"),),
+                  ptg.Out(data=lambda g, k: (g.A, (k, k)))]),
+        ])
+    @POTRF.body
+    def potrf_body(task, T):
+        return cholesky_tile(T)
+"""
+
+from __future__ import annotations
+
+import types
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+from ..core.task import Chore, DeviceType, Flow, FlowAccess, Task
+from ..core.taskpool import DEPS_MASK, DataRef, SuccessorRef, TaskClass
+from ..core.taskpool import Taskpool as CoreTaskpool
+
+READ = FlowAccess.READ
+WRITE = FlowAccess.WRITE
+RW = FlowAccess.RW
+CTL = FlowAccess.CTL
+
+
+@dataclass
+class In:
+    """Consumer-side dependency of a flow (JDF ``<-``).
+
+    Exactly one of:
+    - ``src=(class_name, params_fn, flow_name)``: value produced by another
+      task (``<- T SYRK(k-1, k)``)
+    - ``data=lambda g, *p: (collection, key)``: read from a collection
+      (``<- A(k, k)``)
+    - ``new=lambda g, *p: value``: materialize a fresh value (JDF ``NEW``)
+    ``guard`` selects whether this dep is active for a task instance; the
+    guards of a flow's ins must be disjoint (one active input per flow).
+    """
+    src: Optional[Tuple[str, Callable, str]] = None
+    data: Optional[Callable] = None
+    new: Optional[Callable] = None
+    guard: Optional[Callable] = None
+
+    def active(self, g, params) -> bool:
+        return self.guard is None or bool(self.guard(g, *params))
+
+
+@dataclass
+class Out:
+    """Producer-side dependency of a flow (JDF ``->``).
+
+    Exactly one of:
+    - ``dst=(class_name, params_fn, flow_name)``: feed another task;
+      ``params_fn`` may return one tuple or a list of tuples (ranged deps,
+      ``-> T TRSM(k+1..NT-1, k)``)
+    - ``data=lambda g, *p: (collection, key)``: terminal write-back
+    """
+    dst: Optional[Tuple[str, Callable, str]] = None
+    data: Optional[Callable] = None
+    guard: Optional[Callable] = None
+
+    def active(self, g, params) -> bool:
+        return self.guard is None or bool(self.guard(g, *params))
+
+
+@dataclass
+class FlowSpec:
+    """One flow of a task class.
+
+    ``tile``: optional ``lambda g, *p: (collection, key)`` naming the
+    logical tile this flow reads/writes. Not needed by the host runtime
+    (values travel with activations) but required by the compiled
+    wavefront/SPMD executors, which gather/scatter tiles from stacked
+    HBM stores instead of chasing values (JDF's data-placement info).
+    """
+    name: str
+    access: FlowAccess
+    ins: List[In] = field(default_factory=list)
+    outs: List[Out] = field(default_factory=list)
+    tile: Optional[Callable] = None
+
+
+class PTGTaskClass(TaskClass):
+    """Task class built from closed-form flow specs."""
+
+    def __init__(self, tp: "Taskpool", name: str, tc_id: int,
+                 params: Sequence[str], specs: List[FlowSpec],
+                 space: Callable, affinity: Optional[Callable],
+                 priority: Optional[Callable]):
+        flows = [Flow(s.name, s.access) for s in specs]
+        super().__init__(name, tc_id, params, flows, deps_mode=DEPS_MASK)
+        self.tp = tp
+        self.specs = {s.name: s for s in specs}
+        self.spec_list = specs
+        self.space = space
+        self.affinity = affinity
+        if priority is not None:
+            self.priority_fn = lambda locals: priority(tp.g, *locals)
+        self.iterate_successors = self._iterate_successors
+        self.deps_goal = self._deps_goal
+        self.data_lookup = self._data_lookup
+
+    # -- body decorators --------------------------------------------------
+    def body(self, fn: Callable = None, device: DeviceType = DeviceType.ALL,
+             evaluate: Optional[Callable] = None, batchable: bool = True):
+        """Attach an incarnation (JDF ``BODY [type=...] ... END``)."""
+        def deco(f):
+            self.add_chore(Chore(device, f, evaluate=evaluate,
+                                 batchable=batchable))
+            return f
+        return deco(fn) if fn is not None else deco
+
+    def body_cpu(self, fn=None, **kw):
+        return self.body(fn, device=DeviceType.CPU, **kw)
+
+    def body_tpu(self, fn=None, **kw):
+        return self.body(fn, device=DeviceType.TPU, **kw)
+
+    # -- closed-form vtable ----------------------------------------------
+    def _active_in(self, g, spec: FlowSpec, params) -> Optional[In]:
+        active = [d for d in spec.ins if d.active(g, params)]
+        if len(active) > 1:
+            raise RuntimeError(
+                f"{self.name}{tuple(params)}: flow {spec.name} has "
+                f"{len(active)} active input deps (guards must be disjoint)")
+        return active[0] if active else None
+
+    def _deps_goal(self, locals) -> int:
+        """Mask of flow bits fed by *task* sources (collection reads and
+        NEW are resolved locally at prepare_input, not counted)."""
+        g = self.tp.g
+        mask = 0
+        for f in self.flows:
+            dep = self._active_in(g, self.specs[f.name], locals)
+            if dep is not None and dep.src is not None:
+                mask |= 1 << f.index
+        return mask
+
+    def _data_lookup(self, task: Task) -> None:
+        """Resolve collection-sourced and NEW inputs (generated
+        data_lookup / jdf_generate_code_data_lookup analog)."""
+        g = self.tp.g
+        for f in self.flows:
+            if f.name in task.data:
+                continue
+            dep = self._active_in(g, self.specs[f.name], task.locals)
+            if dep is None:
+                continue
+            if dep.data is not None:
+                dc, key = dep.data(g, *task.locals)
+                task.data[f.name] = dc.data_of(key)
+            elif dep.new is not None:
+                task.data[f.name] = dep.new(g, *task.locals)
+
+    def _iterate_successors(self, task: Task):
+        """Producer-side expansion (generated iterate_successors analog,
+        jdf2c.c; consumed by parsec_release_dep_fct parsec.c:1783)."""
+        g = self.tp.g
+        for f in self.flows:
+            spec = self.specs[f.name]
+            value = None
+            if not f.is_ctl:
+                value = task.output.get(f.name, task.data.get(f.name))
+            for dep in spec.outs:
+                if not dep.active(g, task.locals):
+                    continue
+                if dep.data is not None:
+                    dc, key = dep.data(g, *task.locals)
+                    yield DataRef(collection=dc, key=key, value=value)
+                    continue
+                cls_name, params_fn, dst_flow = dep.dst
+                dst_tc = self.tp.task_class_by_name(cls_name)
+                targets = params_fn(g, *task.locals)
+                if isinstance(targets, tuple):
+                    targets = [targets]
+                dst_bit_flow = dst_tc.flow_by_name[dst_flow]
+                for tgt in targets:
+                    tgt = tuple(tgt) if isinstance(tgt, (tuple, list)) else (tgt,)
+                    yield SuccessorRef(
+                        task_class=dst_tc, locals=tgt, flow_name=dst_flow,
+                        value=None if dst_bit_flow.is_ctl else value,
+                        dep_index=dst_bit_flow.index,
+                        priority=dst_tc.priority_fn(tgt))
+
+    # -- distribution -----------------------------------------------------
+    def affinity_rank(self, locals) -> int:
+        if self.affinity is None:
+            return 0
+        dc, key = self.affinity(self.tp.g, *locals)
+        return dc.rank_of(key)
+
+    def enumerate_space(self) -> Iterable[Tuple[int, ...]]:
+        for p in self.space(self.tp.g):
+            yield tuple(p) if isinstance(p, (tuple, list)) else (p,)
+
+    def nb_local_tasks(self, my_rank: int = 0, nb_ranks: int = 1) -> int:
+        """Closed-form local-task count (generated nb_local_tasks analog)."""
+        n = 0
+        for p in self.enumerate_space():
+            if nb_ranks == 1 or self.affinity_rank(p) == my_rank:
+                n += 1
+        return n
+
+
+class Taskpool(CoreTaskpool):
+    """PTG taskpool: globals namespace + task classes
+    (the ``__parsec_<name>_internal_taskpool_t`` analog)."""
+
+    def __init__(self, name: str = "ptg", **globals_kw):
+        super().__init__(name=name)
+        self.g = types.SimpleNamespace(**globals_kw)
+        self.startup_hook = self._startup
+
+    def task_class_by_name(self, name: str) -> PTGTaskClass:
+        return self._tc_by_name[name]
+
+    def task_class(self, name: str, params: Sequence[str],
+                   space: Callable, flows: List[FlowSpec],
+                   affinity: Optional[Callable] = None,
+                   priority: Optional[Callable] = None) -> PTGTaskClass:
+        tc = PTGTaskClass(self, name, len(self.task_classes), params,
+                          flows, space, affinity, priority)
+        self.add_task_class(tc)
+        return tc
+
+    # -- startup (jdf_generate_startup_tasks analog) ----------------------
+    def _startup(self, tp) -> List[Task]:
+        ctx = self.context
+        my_rank = ctx.my_rank if ctx is not None else 0
+        nb_ranks = ctx.nb_ranks if ctx is not None else 1
+        total = 0
+        ready: List[Task] = []
+        for tc in self.task_classes:
+            for p in tc.enumerate_space():
+                if nb_ranks > 1 and tc.affinity_rank(p) != my_rank:
+                    continue
+                total += 1
+                if tc.deps_goal(p) == 0:
+                    t = Task(self, tc, p, priority=tc.priority_fn(p))
+                    ready.append(t)
+        self.set_nb_tasks(total)
+        return ready
+
+
+def check_taskpool(tp: Taskpool, nb_ranks: int = 1) -> None:
+    """Cross-validate producer (outs) and consumer (ins) dep declarations
+    by enumerating the whole space — the iterators_checker PINS module
+    equivalent (mca/pins/iterators_checker), used by tests.
+
+    Verifies: every SuccessorRef lands on an existing task instance and a
+    flow whose active In names the producer back; every task's goal mask is
+    covered by exactly the refs aimed at it.
+    """
+    g = tp.g
+    exists: Dict[str, set] = {tc.name: set(tc.enumerate_space())
+                              for tc in tp.task_classes}
+    incoming: Dict[Tuple[str, Tuple], int] = {}
+    for tc in tp.task_classes:
+        for p in tc.enumerate_space():
+            task = Task(tp, tc, p)
+            for f in tc.flows:
+                task.data[f.name] = 0
+                task.output[f.name] = 0
+            for ref in tc.iterate_successors(task):
+                if isinstance(ref, DataRef):
+                    continue
+                if ref.locals not in exists[ref.task_class.name]:
+                    raise AssertionError(
+                        f"{tc.name}{p} -> {ref.task_class.name}{ref.locals}: "
+                        f"target task does not exist")
+                spec = ref.task_class.specs[ref.flow_name]
+                dep = ref.task_class._active_in(g, spec, ref.locals)
+                if dep is None or dep.src is None:
+                    raise AssertionError(
+                        f"{tc.name}{p} -> {ref.task_class.name}{ref.locals}."
+                        f"{ref.flow_name}: consumer declares no task input")
+                src_cls, src_params_fn, src_flow = dep.src
+                sp = src_params_fn(g, *ref.locals)
+                sp = tuple(sp) if isinstance(sp, (tuple, list)) else (sp,)
+                if src_cls != tc.name or tuple(sp) != tuple(p):
+                    raise AssertionError(
+                        f"{ref.task_class.name}{ref.locals}.{ref.flow_name} "
+                        f"expects {src_cls}{sp}, got {tc.name}{p}")
+                k = (ref.task_class.name, ref.locals)
+                incoming[k] = incoming.get(k, 0) | (1 << ref.dep_index)
+    for tc in tp.task_classes:
+        for p in tc.enumerate_space():
+            goal = tc.deps_goal(p)
+            got = incoming.get((tc.name, p), 0)
+            if got != goal:
+                raise AssertionError(
+                    f"{tc.name}{p}: goal mask {goal:b} but incoming deps "
+                    f"{got:b}")

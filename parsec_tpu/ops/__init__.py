@@ -1,0 +1,9 @@
+"""Tile kernels for dense linear algebra and ML blocks.
+
+The FLOP-carrying bodies used by the shipped taskpools (GEMM, POTRF,
+TRSM, SYRK, QR kernels, transformer blocks). jnp implementations let XLA
+fuse and tile for the MXU; pallas variants cover what XLA won't fuse.
+"""
+
+from .tile_kernels import (gemm_tile, syrk_tile, trsm_tile, potrf_tile,
+                           add_tile, scale_tile)

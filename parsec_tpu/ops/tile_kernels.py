@@ -1,0 +1,64 @@
+"""Tile-level kernels (jnp; MXU-friendly shapes).
+
+These are the FLOP-carrying bodies of the shipped linear-algebra
+taskpools — the role CUDA kernels in user .jdf BODY sections play in the
+reference (e.g. DPLASMA's dpotrf/dgemm tiles). All operate on full
+(mb × nb) tiles; ``preferred_element_type=float32`` keeps MXU accumulation
+in f32 even for bf16 tiles.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import mca_param
+
+# On TPU, f32 matmuls default to bf16 MXU passes (~1e-2 relative error).
+# "highest" runs the 6-pass f32 emulation — DPLASMA-grade accuracy at a
+# throughput cost; "default" is the TPU-native speed setting.
+mca_param.register("ops.matmul_precision", "default",
+                   help="MXU precision for tile matmuls: default|high|highest")
+
+
+def _prec():
+    p = str(mca_param.get("ops.matmul_precision", "default"))
+    return None if p == "default" else p
+
+
+def gemm_tile(C, A, B, alpha=1.0, beta=1.0, ta=False, tb=False):
+    """C ← α·op(A)·op(B) + β·C (tile GEMM)."""
+    opA = A.T if ta else A
+    opB = B.T if tb else B
+    acc = jnp.matmul(opA, opB, preferred_element_type=jnp.float32,
+                     precision=_prec())
+    return (alpha * acc + beta * C).astype(C.dtype)
+
+
+def syrk_tile(C, A, alpha=-1.0, beta=1.0):
+    """C ← α·A·Aᵀ + β·C (symmetric rank-k update, lower)."""
+    acc = jnp.matmul(A, A.T, preferred_element_type=jnp.float32,
+                     precision=_prec())
+    return (alpha * acc + beta * C).astype(C.dtype)
+
+
+def trsm_tile(B, L):
+    """B ← B·L⁻ᵀ — right-solve with the lower-triangular factor L of the
+    panel tile (the dpotrf TRSM update: A[m,k] = A[m,k] L[k,k]^-T)."""
+    x = jax.scipy.linalg.solve_triangular(
+        L.astype(jnp.float32), B.astype(jnp.float32).T,
+        lower=True, trans=0)
+    return x.T.astype(B.dtype)
+
+
+def potrf_tile(A):
+    """A ← chol(A) lower (diagonal-tile Cholesky)."""
+    return jnp.linalg.cholesky(A.astype(jnp.float32)).astype(A.dtype)
+
+
+def add_tile(A, B):
+    return A + B
+
+
+def scale_tile(A, alpha):
+    return alpha * A

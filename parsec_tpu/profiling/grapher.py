@@ -1,0 +1,49 @@
+"""DOT grapher: capture the executed DAG.
+
+Reference: parsec/parsec_prof_grapher.c (266 LoC), enabled by the --dot
+flag (parsec.c:589-607) — emits one .dot file per rank with a node per
+executed task and an edge per satisfied dependency.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class Grapher:
+    def __init__(self) -> None:
+        self._nodes: Dict[str, Dict] = {}
+        self._edges: List[Tuple[str, str, str]] = []
+        self._lock = threading.Lock()
+
+    def install(self, context) -> "Grapher":
+        context.grapher = self
+        return self
+
+    def task_executed(self, task) -> None:
+        with self._lock:
+            self._nodes[repr(task)] = {"class": task.task_class.name}
+
+    def dep_edge(self, src_task, dst_repr: str, flow: str) -> None:
+        with self._lock:
+            self._edges.append((repr(src_task), dst_repr, flow))
+
+    def to_dot(self) -> str:
+        palette = ["#66c2a5", "#fc8d62", "#8da0cb", "#e78ac3", "#a6d854",
+                   "#ffd92f", "#e5c494", "#b3b3b3"]
+        classes = sorted({n["class"] for n in self._nodes.values()})
+        color = {c: palette[i % len(palette)] for i, c in enumerate(classes)}
+        lines = ["digraph G {", "  node [style=filled];"]
+        with self._lock:
+            for name, attr in self._nodes.items():
+                lines.append(
+                    f'  "{name}" [fillcolor="{color[attr["class"]]}"];')
+            for src, dst, flow in self._edges:
+                lines.append(f'  "{src}" -> "{dst}" [label="{flow}"];')
+        lines.append("}")
+        return "\n".join(lines)
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as fh:
+            fh.write(self.to_dot())

@@ -1,0 +1,93 @@
+"""Event tracing.
+
+Reference: parsec/profiling.c (PBT binary traces — per-stream buffers,
+dictionary of paired begin/end keys with typed info payloads,
+profiling.h:44-80) + tools/profiling/python/pbt2ptt.pyx (conversion to
+pandas HDF5 tables).
+
+Here events are recorded in per-stream in-memory buffers with the same
+dictionary structure and exported directly to pandas (``to_pandas``) or
+JSON — the offline converter collapses into the runtime since the host side
+is already Python.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+from .pins import PinsEvent
+
+
+class Trace:
+    """In-memory trace with a key dictionary (parsec_profiling API analog:
+    dictionary entries = add_dictionary_keyword, events = trace_flags)."""
+
+    def __init__(self) -> None:
+        self._dict: Dict[str, Dict[str, Any]] = {}
+        self._events: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self.t0 = time.perf_counter()
+
+    # -- dictionary (profiling.h:44-80 analog) ----------------------------
+    def add_keyword(self, name: str, attributes: str = "",
+                    info_schema: Optional[Dict[str, str]] = None) -> str:
+        self._dict[name] = {"attributes": attributes,
+                            "info": info_schema or {}}
+        return name
+
+    # -- event recording --------------------------------------------------
+    def event(self, key: str, phase: str, stream_id: int = -1,
+              object_id: Any = None, info: Optional[Dict] = None) -> None:
+        ev = {"key": key, "phase": phase, "t": time.perf_counter() - self.t0,
+              "stream": stream_id, "object": object_id, "info": info or {}}
+        with self._lock:
+            self._events.append(ev)
+
+    def begin(self, key: str, **kw) -> None:
+        self.event(key, "begin", **kw)
+
+    def end(self, key: str, **kw) -> None:
+        self.event(key, "end", **kw)
+
+    # hooks wired by install()
+    def task_begin(self, es, task) -> None:
+        self.event("task", "begin",
+                   stream_id=es.th_id if es is not None else -1,
+                   object_id=repr(task))
+
+    def task_complete(self, task) -> None:
+        self.event("task", "end", object_id=repr(task),
+                   info={"class": task.task_class.name,
+                         "locals": list(task.locals)})
+
+    def install(self, context) -> "Trace":
+        """Subscribe to the context's PINS chains (task_profiler module
+        analog, mca/pins/task_profiler)."""
+        self.add_keyword("task", info_schema={"class": "str", "locals": "list"})
+        context.trace = self
+        context.pins.register(PinsEvent.EXEC_BEGIN, self.task_begin)
+        return self
+
+    # -- export -----------------------------------------------------------
+    def to_records(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def to_pandas(self):
+        import pandas as pd
+        return pd.DataFrame(self.to_records())
+
+    def dump_json(self, path: str) -> None:
+        with open(path, "w") as fh:
+            json.dump({"dictionary": self._dict,
+                       "events": self.to_records()}, fh)
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = defaultdict(int)
+        for ev in self.to_records():
+            out[f"{ev['key']}:{ev['phase']}"] += 1
+        return dict(out)

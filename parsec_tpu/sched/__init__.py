@@ -1,0 +1,53 @@
+"""Scheduler framework (reference parsec/mca/sched/, 11 modules).
+
+Common interface (sched.h:183-353): ``install(context)``,
+``flow_init(es)`` (per-stream structures), ``schedule(es, tasks, distance)``,
+``select(es) -> task``, ``remove()``. The ``distance`` hint orders how soon
+tasks should run; schedulers that ignore it can livelock (sched.h:243-250).
+
+Work stealing respects virtual processes: an execution stream only steals
+inside its VP (reference vpmap, parsec.c:336-382).
+
+Selected MCA-style by the ``sched`` param (scheduling.c:246-272 analog).
+"""
+
+from .base import Scheduler
+from .local_queues import LFQScheduler, LLScheduler, LLPScheduler, \
+    PBQScheduler, LTQScheduler, LHQScheduler
+from .global_queues import APScheduler, IPScheduler, GDScheduler, \
+    SPQScheduler, RNDScheduler
+from ..utils import mca_param
+
+_MODULES = {
+    "lfq": LFQScheduler,   # local flat queues + hierarchical steal
+    "lhq": LHQScheduler,   # local hierarchical queues
+    "ltq": LTQScheduler,   # local tree queues
+    "ll": LLScheduler,     # per-thread lock-free LIFO + steal
+    "llp": LLPScheduler,   # per-thread priority-sorted LIFO
+    "ap": APScheduler,     # single global priority list
+    "ip": IPScheduler,     # inverse priorities
+    "gd": GDScheduler,     # single global dequeue
+    "pbq": PBQScheduler,   # priority-based local flat queues
+    "spq": SPQScheduler,   # simple priority queue by (distance, priority)
+    "rnd": RNDScheduler,   # random placement (stress/debug)
+}
+
+mca_param.register("sched", "lfq",
+                   help=f"scheduler module ({', '.join(sorted(_MODULES))})")
+
+
+def new_scheduler(name=None) -> Scheduler:
+    name = name or mca_param.get("sched", "lfq")
+    try:
+        cls = _MODULES[name]
+    except KeyError:
+        raise ValueError(f"unknown scheduler {name!r}; have {sorted(_MODULES)}")
+    return cls()
+
+
+def available() -> list:
+    return sorted(_MODULES)
+
+
+def register_module(name: str, cls) -> None:
+    _MODULES[name] = cls

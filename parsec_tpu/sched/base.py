@@ -1,0 +1,50 @@
+"""Scheduler base class (reference sched.h:183-353)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..core.task import Task
+
+
+class Scheduler:
+    """Base scheduler module.
+
+    Lifecycle: ``install(context)`` once, then ``flow_init(es)`` per
+    execution stream, then concurrent ``schedule``/``select`` calls from
+    worker threads, finally ``remove(context)``.
+    """
+
+    name = "base"
+
+    def install(self, context) -> None:
+        self.context = context
+
+    def flow_init(self, es) -> None:
+        """Allocate per-execution-stream structures (sched.h flow_init)."""
+
+    def schedule(self, es, tasks: Sequence[Task], distance: int = 0) -> None:
+        """Insert a ring of ready tasks, `distance` hinting how soon they
+        should run (0 = immediately / front of queue)."""
+        raise NotImplementedError
+
+    def select(self, es) -> Optional[Task]:
+        """Pick the next task for this stream, or None if starved."""
+        raise NotImplementedError
+
+    def remove(self, context) -> None:
+        pass
+
+    # observability (reference PAPI-SDE pending-task gauges)
+    def pending_tasks(self) -> int:
+        return -1
+
+
+def vp_peers(es) -> List:
+    """Execution streams in the same virtual process as ``es``, steal order:
+    self first, then co-VP streams by increasing distance (reference
+    sched_local_queues_utils.h hierarchical steal simplified to ring order
+    inside the VP)."""
+    streams = [s for s in es.context.streams if s.vp_id == es.vp_id]
+    streams.sort(key=lambda s: (s.th_id - es.th_id) % max(len(streams), 1))
+    return streams

@@ -1,0 +1,124 @@
+"""Global-queue schedulers.
+
+Reference modules (parsec/mca/sched/):
+- ``ap``: single global list ordered by absolute priority (sched/ap, 259).
+- ``ip``: inverse priorities — LIFO-ish global order (sched/ip, 258).
+- ``gd``: single global dequeue, FIFO (sched/gd, 314).
+- ``spq``: simple priority queue sorted by (distance, priority); the
+  documented walkthrough scheduler (sched.h:100-170; sched/spq, 347).
+- ``rnd``: random placement for stress/debug (sched/rnd, 271).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import random
+import threading
+from collections import deque
+from typing import Optional, Sequence
+
+from .base import Scheduler
+from ..core.task import Task
+
+_tie = itertools.count()
+
+
+class _HeapScheduler(Scheduler):
+    def install(self, context) -> None:
+        super().install(context)
+        self.heap = []
+        self.lock = threading.Lock()
+
+    def _key(self, task: Task, distance: int):
+        raise NotImplementedError
+
+    def schedule(self, es, tasks: Sequence[Task], distance: int = 0) -> None:
+        with self.lock:
+            for t in tasks:
+                heapq.heappush(self.heap, (self._key(t, distance), next(_tie), t))
+
+    def select(self, es) -> Optional[Task]:
+        with self.lock:
+            if not self.heap:
+                return None
+            return heapq.heappop(self.heap)[2]
+
+    def pending_tasks(self) -> int:
+        return len(self.heap)
+
+
+class APScheduler(_HeapScheduler):
+    """Absolute priorities: highest priority first."""
+    name = "ap"
+
+    def _key(self, task: Task, distance: int):
+        return -task.priority
+
+
+class IPScheduler(_HeapScheduler):
+    """Inverse priorities: lowest priority first (LIFO-ish drain order)."""
+    name = "ip"
+
+    def _key(self, task: Task, distance: int):
+        return task.priority
+
+
+class SPQScheduler(_HeapScheduler):
+    """Sorted by (distance, -priority): tasks hinted to run sooner win, then
+    priority breaks ties (sched.h:100-170)."""
+    name = "spq"
+
+    def _key(self, task: Task, distance: int):
+        return (distance, -task.priority)
+
+
+class GDScheduler(Scheduler):
+    """Single global dequeue: distance 0 pushes to the front, others to the
+    back; select pops the front."""
+    name = "gd"
+
+    def install(self, context) -> None:
+        super().install(context)
+        self.dq = deque()
+        self.lock = threading.Lock()
+
+    def schedule(self, es, tasks: Sequence[Task], distance: int = 0) -> None:
+        with self.lock:
+            if distance <= 0:
+                self.dq.extendleft(reversed(tasks))
+            else:
+                self.dq.extend(tasks)
+
+    def select(self, es) -> Optional[Task]:
+        with self.lock:
+            return self.dq.popleft() if self.dq else None
+
+    def pending_tasks(self) -> int:
+        return len(self.dq)
+
+
+class RNDScheduler(Scheduler):
+    """Random selection — scheduling-order stress tests (sched/rnd)."""
+    name = "rnd"
+
+    def install(self, context) -> None:
+        super().install(context)
+        self.tasks = []
+        self.lock = threading.Lock()
+        self.rng = random.Random(0xC0FFEE)
+
+    def schedule(self, es, tasks: Sequence[Task], distance: int = 0) -> None:
+        with self.lock:
+            self.tasks.extend(tasks)
+
+    def select(self, es) -> Optional[Task]:
+        with self.lock:
+            if not self.tasks:
+                return None
+            i = self.rng.randrange(len(self.tasks))
+            self.tasks[i], self.tasks[-1] = self.tasks[-1], self.tasks[i]
+            return self.tasks.pop()
+
+    def pending_tasks(self) -> int:
+        return len(self.tasks)

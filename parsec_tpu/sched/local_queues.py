@@ -1,0 +1,199 @@
+"""Local-queue schedulers with work stealing.
+
+Reference modules (parsec/mca/sched/):
+- ``lfq``: local flat queues, hierarchical steal core→socket→node, bounded
+  per-thread buffer with overflow to a system dequeue (sched/lfq, 365 LoC,
+  sched_local_queues_utils.h).
+- ``ll``: per-thread lock-free LIFO, steal from others (sched/ll, 406).
+- ``llp``: per-thread LIFO kept priority-sorted (sched/llp, 790).
+- ``pbq``: priority-based local flat queues (sched/pbq, 357).
+- ``ltq``: local tree queues — tree-shaped steal order (sched/ltq, 448).
+- ``lhq``: local hierarchical queues — one queue per topology level
+  (sched/lhq, 386).
+
+All steal only inside the stream's virtual process (vpmap scoping,
+parsec.c:336-382). The Python implementations share a per-stream
+deque-with-lock structure; the native C++ core supplies the lock-free
+versions when loaded.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from collections import deque
+from typing import Optional, Sequence
+
+from .base import Scheduler, vp_peers
+from ..core.task import Task
+
+
+class _LocalDeque:
+    __slots__ = ("dq", "lock")
+
+    def __init__(self) -> None:
+        self.dq = deque()
+        self.lock = threading.Lock()
+
+    def push_front(self, items) -> None:
+        with self.lock:
+            self.dq.extendleft(reversed(items))
+
+    def push_back(self, items) -> None:
+        with self.lock:
+            self.dq.extend(items)
+
+    def pop_front(self) -> Optional[Task]:
+        with self.lock:
+            return self.dq.popleft() if self.dq else None
+
+    def pop_back(self) -> Optional[Task]:
+        with self.lock:
+            return self.dq.pop() if self.dq else None
+
+    def __len__(self) -> int:
+        return len(self.dq)
+
+
+class _LocalQueueScheduler(Scheduler):
+    """Shared skeleton: per-stream deque; select = local pop, else steal
+    from VP peers, else system overflow queue."""
+
+    local_bound = 0          # >0: bounded local buffer, overflow to system
+
+    def install(self, context) -> None:
+        super().install(context)
+        self.system = _LocalDeque()       # overflow / no-stream pushes
+
+    def flow_init(self, es) -> None:
+        es.sched_obj = _LocalDeque()
+
+    def _push_local(self, q: _LocalDeque, tasks, distance: int) -> None:
+        if distance <= 0:
+            q.push_front(tasks)
+        else:
+            q.push_back(tasks)
+
+    def schedule(self, es, tasks: Sequence[Task], distance: int = 0) -> None:
+        if es is None or getattr(es, "sched_obj", None) is None:
+            self.system.push_back(tasks)
+            return
+        q = es.sched_obj
+        if self.local_bound and len(q) + len(tasks) > self.local_bound:
+            fit = max(0, self.local_bound - len(q))
+            self._push_local(q, tasks[:fit], distance)
+            self.system.push_back(tasks[fit:])
+        else:
+            self._push_local(q, tasks, distance)
+
+    def _pop_local(self, q: _LocalDeque) -> Optional[Task]:
+        return q.pop_front()
+
+    def _steal(self, q: _LocalDeque) -> Optional[Task]:
+        return q.pop_back()
+
+    def select(self, es) -> Optional[Task]:
+        t = self._pop_local(es.sched_obj)
+        if t is not None:
+            return t
+        for peer in self._steal_order(es):
+            if peer is es:
+                continue
+            t = self._steal(peer.sched_obj)
+            if t is not None:
+                return t
+        return self.system.pop_front()
+
+    def _steal_order(self, es):
+        return vp_peers(es)
+
+    def pending_tasks(self) -> int:
+        n = len(self.system)
+        for s in self.context.streams:
+            q = getattr(s, "sched_obj", None)
+            if q is not None:
+                n += len(q)
+        return n
+
+
+class LFQScheduler(_LocalQueueScheduler):
+    """Local flat queues, bounded buffer, hierarchical steal."""
+    name = "lfq"
+    local_bound = 64          # reference hbbuffer is bounded per-thread
+
+
+class LLScheduler(_LocalQueueScheduler):
+    """Per-thread LIFO: local pushes/pops at the front (LIFO), steals from
+    the back."""
+    name = "ll"
+
+    def _push_local(self, q, tasks, distance: int) -> None:
+        q.push_front(tasks)
+
+
+class PBQScheduler(_LocalQueueScheduler):
+    """Priority-based local flat queues: local ring kept priority-ordered."""
+    name = "pbq"
+
+    def _push_local(self, q, tasks, distance: int) -> None:
+        with q.lock:
+            q.dq.extend(tasks)
+            q.dq = deque(sorted(q.dq, key=lambda t: -t.priority))
+
+
+class LLPScheduler(PBQScheduler):
+    """Per-thread LIFO sorted by priority (reference detaches, merges and
+    reattaches the chain on insert — here a sort under the stream lock)."""
+    name = "llp"
+
+
+class LTQScheduler(_LocalQueueScheduler):
+    """Local tree queues: steal order walks the VP as a binary tree rooted
+    at the stealing stream (children 2i+1/2i+2), approximating the
+    reference's tree-shaped steal topology."""
+    name = "ltq"
+
+    def _steal_order(self, es):
+        peers = sorted((s for s in es.context.streams if s.vp_id == es.vp_id),
+                       key=lambda s: s.th_id)
+        n = len(peers)
+        me = next(i for i, s in enumerate(peers) if s is es)
+        order, frontier = [], [me]
+        seen = set()
+        while frontier:
+            i = frontier.pop(0)
+            if i in seen or i >= n:
+                continue
+            seen.add(i)
+            order.append(peers[i])
+            frontier.extend(((2 * i + 1) % n, (2 * i + 2) % n))
+            if len(seen) == n:
+                break
+        for i in range(n):
+            if i not in seen:
+                order.append(peers[i])
+        return order
+
+
+class LHQScheduler(_LocalQueueScheduler):
+    """Local hierarchical queues: one queue per topology level. Without
+    hwloc, levels are (self, pair, quad, ... VP); steal walks levels
+    outward — realized as pair-first steal order."""
+    name = "lhq"
+
+    def _steal_order(self, es):
+        peers = sorted((s for s in es.context.streams if s.vp_id == es.vp_id),
+                       key=lambda s: s.th_id)
+        me = next(i for i, s in enumerate(peers) if s is es)
+        order = []
+        span = 2
+        while span <= max(len(peers), 2):
+            base = (me // span) * span
+            for i in range(base, min(base + span, len(peers))):
+                if peers[i] not in order:
+                    order.append(peers[i])
+            span *= 2
+        for p in peers:
+            if p not in order:
+                order.append(p)
+        return order

@@ -1,0 +1,42 @@
+"""Termination-detection framework (reference parsec/mca/termdet/).
+
+A termdet *monitor* is wired into every taskpool (parsec_internal.h:145) and
+drives the state machine NOT_READY → BUSY → IDLE → TERMINATED
+(termdet.h:27-120). Modules:
+
+- ``local``: counts local tasks + pending runtime actions; terminated when
+  both hit zero (termdet/local, 369 LoC).
+- ``fourcounter``: distributed four-counter wave algorithm for DAGs whose
+  task count cannot be precomputed (termdet/fourcounter, 887 LoC).
+- ``user_trigger``: the user explicitly signals termination.
+
+Selection is MCA-style by name (param ``termdet``).
+"""
+
+from .base import TermdetMonitor, TermdetState
+from .local import LocalTermdet
+from .fourcounter import FourCounterTermdet
+from .user_trigger import UserTriggerTermdet
+from ..utils import mca_param
+
+_MODULES = {
+    "local": LocalTermdet,
+    "fourcounter": FourCounterTermdet,
+    "user_trigger": UserTriggerTermdet,
+}
+
+mca_param.register("termdet", "local",
+                   help="termination detection module (local, fourcounter, user_trigger)")
+
+
+def new_monitor(name=None, **kwargs) -> TermdetMonitor:
+    name = name or mca_param.get("termdet", "local")
+    try:
+        cls = _MODULES[name]
+    except KeyError:
+        raise ValueError(f"unknown termdet module {name!r}; have {sorted(_MODULES)}")
+    return cls(**kwargs)
+
+
+def register_module(name: str, cls) -> None:
+    _MODULES[name] = cls

@@ -1,0 +1,126 @@
+"""Termdet monitor interface (reference termdet.h:27-120)."""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Callable, Optional
+
+
+class TermdetState(enum.IntEnum):
+    NOT_READY = 0    # taskpool still being constructed; cannot terminate
+    BUSY = 1         # tasks or runtime actions outstanding
+    IDLE = 2         # locally quiet; distributed modules may still wait
+    TERMINATED = 3
+
+
+class TermdetMonitor:
+    """Base monitor: counts tasks and pending runtime actions.
+
+    ``nb_tasks`` mirrors taskpool->nb_tasks, ``runtime_actions`` mirrors
+    taskpool->nb_pending_actions (parsec_internal.h:123-143). The taskpool
+    is NOT_READY until ``ready()`` (reference: the DSL calls set_nb_tasks /
+    starts enqueue), then BUSY until both counters reach zero.
+    """
+
+    def __init__(self, comm=None) -> None:
+        self.comm = comm            # comm engine (None = single rank)
+        self._lock = threading.Lock()
+        self._nb_tasks = 0
+        self._runtime_actions = 0
+        self._state = TermdetState.NOT_READY
+        self._on_terminated: Optional[Callable[[], None]] = None
+
+    # -- wiring -----------------------------------------------------------
+    def monitor(self, on_terminated: Callable[[], None]) -> None:
+        self._on_terminated = on_terminated
+
+    # -- counters ---------------------------------------------------------
+    @property
+    def nb_tasks(self) -> int:
+        return self._nb_tasks
+
+    @property
+    def state(self) -> TermdetState:
+        return self._state
+
+    def set_nb_tasks(self, n: int) -> None:
+        with self._lock:
+            self._nb_tasks = n
+            if self._state == TermdetState.NOT_READY:
+                self._state = TermdetState.BUSY
+            fire = self._maybe_idle_locked()
+        if fire:
+            self._fire()
+        self._post_transition()
+
+    def addto_nb_tasks(self, d: int) -> None:
+        with self._lock:
+            self._nb_tasks += d
+            if self._state == TermdetState.NOT_READY:
+                self._state = TermdetState.BUSY
+            if self._nb_tasks < 0:
+                raise RuntimeError("nb_tasks went negative")
+            fire = self._maybe_idle_locked()
+        if fire:
+            self._fire()
+        self._post_transition()
+
+    def addto_runtime_actions(self, d: int) -> None:
+        with self._lock:
+            self._runtime_actions += d
+            if self._runtime_actions < 0:
+                raise RuntimeError("runtime_actions went negative")
+            fire = self._maybe_idle_locked()
+        if fire:
+            self._fire()
+        self._post_transition()
+
+    def ready(self) -> None:
+        """Transition NOT_READY → BUSY (taskpool fully constructed)."""
+        with self._lock:
+            if self._state == TermdetState.NOT_READY:
+                self._state = TermdetState.BUSY
+            fire = self._maybe_idle_locked()
+        if fire:
+            self._fire()
+        self._post_transition()
+
+    def _post_transition(self) -> None:
+        """Hook invoked after every counter mutation, OUTSIDE the monitor
+        lock — distributed modules launch their waves here (launching from
+        inside the lock would deadlock when the comm engine delivers the
+        wave result synchronously, e.g. the loopback engine)."""
+
+    # -- module-specific idle → terminated policy -------------------------
+    def _maybe_idle_locked(self) -> bool:
+        """Called with lock held when counters change; returns True when the
+        TERMINATED transition fired (callback invoked by caller outside the
+        lock)."""
+        if (self._state == TermdetState.BUSY
+                and self._nb_tasks == 0 and self._runtime_actions == 0):
+            self._state = TermdetState.IDLE
+            return self._idle_to_terminated_locked()
+        return False
+
+    def _idle_to_terminated_locked(self) -> bool:
+        """Default (local) policy: IDLE is final → TERMINATED immediately."""
+        self._state = TermdetState.TERMINATED
+        return True
+
+    def _fire(self) -> None:
+        if self._on_terminated is not None:
+            self._on_terminated()
+
+    # -- comm hooks (reference: message start/end, remote_dep.c:578) ------
+    def outgoing_message_start(self, dst_rank: int, nbytes: int = 0) -> None:
+        pass
+
+    def outgoing_message_end(self, dst_rank: int) -> None:
+        pass
+
+    def incoming_message_start(self, src_rank: int, nbytes: int = 0) -> None:
+        pass
+
+    def incoming_message_end(self, src_rank: int) -> None:
+        pass

@@ -1,0 +1,85 @@
+"""Four-counter distributed termination detection
+(reference parsec/mca/termdet/fourcounter, 887 LoC).
+
+The reference runs a wave algorithm over its own AM tag
+(PARSEC_TERMDET_FOURCOUNTER_MSG_TAG, parsec_comm_engine.h:35) tracking four
+counters: messages sent/received and tasks created/completed. A taskpool
+terminates when a wave observes every rank idle and sent == received
+globally.
+
+Here the wave rides the comm engine's control channel. Waves are requested
+when a rank's monitor goes IDLE and launched from the post-transition hook
+(outside the monitor lock — the loopback engine delivers results
+synchronously). A failed wave is not retried in a spin: the next counter
+transition on any rank (e.g. the last in-flight message delivering)
+triggers a fresh wave, and the engine delivers a successful wave's result
+to every rank's monitor. Single-process contexts degenerate to the local
+policy (rank count 1).
+"""
+
+from __future__ import annotations
+
+import threading
+from .base import TermdetMonitor, TermdetState
+
+
+class FourCounterTermdet(TermdetMonitor):
+    def __init__(self, comm=None) -> None:
+        super().__init__(comm=comm)
+        self._sent = 0
+        self._received = 0
+        self._wave_lock = threading.Lock()
+        self._wave_requested = False
+
+    # -- comm hooks -------------------------------------------------------
+    def outgoing_message_start(self, dst_rank: int, nbytes: int = 0) -> None:
+        with self._wave_lock:
+            self._sent += 1
+        # a message in flight is a pending runtime action: the taskpool may
+        # not appear idle while data it produced is still undelivered
+        self.addto_runtime_actions(1)
+
+    def outgoing_message_end(self, dst_rank: int) -> None:
+        self.addto_runtime_actions(-1)
+
+    def incoming_message_start(self, src_rank: int, nbytes: int = 0) -> None:
+        with self._wave_lock:
+            self._received += 1
+        self.addto_runtime_actions(1)
+
+    def incoming_message_end(self, src_rank: int) -> None:
+        self.addto_runtime_actions(-1)
+
+    # -- wave -------------------------------------------------------------
+    def _idle_to_terminated_locked(self) -> bool:
+        nranks = self.comm.nb_ranks if self.comm is not None else 1
+        if nranks <= 1:
+            self._state = TermdetState.TERMINATED
+            return True
+        # request a wave; launched by _post_transition outside the lock
+        self._wave_requested = True
+        return False
+
+    def _post_transition(self) -> None:
+        with self._wave_lock:
+            req, self._wave_requested = self._wave_requested, False
+        if req and self.comm is not None:
+            self.comm.start_termdet_wave(self)
+
+    def local_wave_contribution(self):
+        # _state read without the monitor lock: a stale BUSY only fails the
+        # wave (retried on the next transition), never falsely terminates
+        idle = self._state in (TermdetState.IDLE, TermdetState.TERMINATED)
+        with self._wave_lock:
+            return (self._sent, self._received, idle)
+
+    def wave_result(self, total_sent: int, total_received: int,
+                    all_idle: bool) -> None:
+        fire = False
+        with self._lock:
+            if all_idle and total_sent == total_received \
+                    and self._state == TermdetState.IDLE:
+                self._state = TermdetState.TERMINATED
+                fire = True
+        if fire:
+            self._fire()

@@ -1,0 +1,34 @@
+"""User-triggered termination (reference parsec/mca/termdet/user_trigger).
+
+The taskpool terminates only when the user calls :meth:`trigger`, regardless
+of the task counters — for open-ended DAGs where the runtime cannot know the
+end (reference: own AM tag at parsec_comm_engine.h:36 propagates the trigger
+to all ranks; here the comm engine's control broadcast does the same).
+"""
+
+from .base import TermdetMonitor, TermdetState
+
+
+class UserTriggerTermdet(TermdetMonitor):
+    def __init__(self, comm=None) -> None:
+        super().__init__(comm=comm)
+        self._triggered = False
+
+    def _idle_to_terminated_locked(self) -> bool:
+        if self._triggered:
+            self._state = TermdetState.TERMINATED
+            return True
+        return False    # stay IDLE until the user triggers
+
+    def trigger(self, propagate: bool = True) -> None:
+        fire = False
+        with self._lock:
+            self._triggered = True
+            if self._state in (TermdetState.IDLE, TermdetState.BUSY) \
+                    and self._nb_tasks == 0 and self._runtime_actions == 0:
+                self._state = TermdetState.TERMINATED
+                fire = True
+        if propagate and self.comm is not None and self.comm.nb_ranks > 1:
+            self.comm.broadcast_user_trigger(self)
+        if fire:
+            self._fire()

@@ -1,0 +1,2 @@
+from . import mca_param
+from . import debug

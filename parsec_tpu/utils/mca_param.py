@@ -1,0 +1,167 @@
+"""MCA-style runtime parameter system.
+
+Mirrors the reference's Modular Component Architecture parameter registry
+(parsec/utils/mca_param.c, ~2000 LoC): parameters are registered by
+(framework, component, name), and values are resolved with priority
+
+    explicit set()  >  environment PARSEC_MCA_<name>  >  config file  >
+    registered default
+
+Config files: ``~/.parsec/mca-params.conf`` and ``$PARSEC_MCA_PARAM_FILES``
+(``key = value`` lines, ``#`` comments), matching the reference's file
+search (mca_param.c file parsing).
+
+The reference dumps all parameters on --help (parsec.c:903-918); here
+:func:`dump` returns the same information programmatically.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+ENV_PREFIX = "PARSEC_MCA_"
+
+
+@dataclass
+class _Param:
+    name: str                      # full dotted name, e.g. "sched.lfq.steal_depth"
+    default: Any
+    type: type
+    help: str = ""
+    read_only: bool = False
+    # explicit runtime override (set()); highest priority
+    override: Any = None
+    has_override: bool = False
+
+    def resolve(self, file_values: Dict[str, str]) -> Any:
+        if self.has_override:
+            return self.override
+        env_key = ENV_PREFIX + self.name.replace(".", "_")
+        if env_key in os.environ:
+            return _coerce(os.environ[env_key], self.type)
+        if self.name in file_values:
+            return _coerce(file_values[self.name], self.type)
+        return self.default
+
+
+def _coerce(value: str, typ: type) -> Any:
+    if typ is bool:
+        return str(value).strip().lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(str(value).strip(), 0)
+    if typ is float:
+        return float(value)
+    return value
+
+
+class ParamRegistry:
+    def __init__(self) -> None:
+        self._params: Dict[str, _Param] = {}
+        self._file_values: Dict[str, str] = {}
+        self._files_loaded = False
+        self._lock = threading.Lock()
+
+    # -- file layer -------------------------------------------------------
+    def _load_files(self) -> None:
+        if self._files_loaded:
+            return
+        self._files_loaded = True
+        paths: List[str] = []
+        home = os.path.expanduser("~/.parsec/mca-params.conf")
+        paths.append(home)
+        extra = os.environ.get("PARSEC_MCA_PARAM_FILES", "")
+        paths.extend(p for p in extra.split(os.pathsep) if p)
+        for path in paths:
+            try:
+                with open(path) as fh:
+                    for line in fh:
+                        line = line.split("#", 1)[0].strip()
+                        if not line or "=" not in line:
+                            continue
+                        key, val = line.split("=", 1)
+                        self._file_values[key.strip()] = val.strip()
+            except OSError:
+                continue
+
+    # -- registration / access -------------------------------------------
+    def register(self, name: str, default: Any, help: str = "",
+                 type: Optional[type] = None, read_only: bool = False) -> None:
+        with self._lock:
+            if name in self._params:
+                return
+            typ = type if type is not None else (default.__class__ if default is not None else str)
+            self._params[name] = _Param(name=name, default=default, type=typ,
+                                        help=help, read_only=read_only)
+
+    def get(self, name: str, default: Any = None) -> Any:
+        self._load_files()
+        with self._lock:
+            p = self._params.get(name)
+            if p is None:
+                # unregistered lookups still honor env/file so components can
+                # probe without registering first
+                env_key = ENV_PREFIX + name.replace(".", "_")
+                if env_key in os.environ:
+                    raw = os.environ[env_key]
+                    return _coerce(raw, default.__class__) if default is not None else raw
+                if name in self._file_values:
+                    raw = self._file_values[name]
+                    return _coerce(raw, default.__class__) if default is not None else raw
+                return default
+            return p.resolve(self._file_values)
+
+    def set(self, name: str, value: Any) -> None:
+        with self._lock:
+            p = self._params.get(name)
+            if p is None:
+                p = _Param(name=name, default=None, type=value.__class__)
+                self._params[name] = p
+            if p.read_only:
+                raise ValueError(f"MCA param {name} is read-only")
+            p.override = value
+            p.has_override = True
+
+    def unset(self, name: str) -> None:
+        with self._lock:
+            p = self._params.get(name)
+            if p is not None:
+                p.override, p.has_override = None, False
+
+    def dump(self) -> List[Dict[str, Any]]:
+        """All registered params with current values (parsec --help analog)."""
+        self._load_files()
+        with self._lock:
+            return [
+                {"name": p.name, "value": p.resolve(self._file_values),
+                 "default": p.default, "help": p.help}
+                for p in sorted(self._params.values(), key=lambda p: p.name)
+            ]
+
+
+_registry = ParamRegistry()
+
+register = _registry.register
+get = _registry.get
+set = _registry.set
+unset = _registry.unset
+dump = _registry.dump
+
+
+def parse_cli(argv: List[str]) -> List[str]:
+    """Consume ``--mca key value`` pairs from argv (parsec.c:411-463 analog).
+
+    Returns argv with the consumed arguments removed.
+    """
+    out: List[str] = []
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--mca" and i + 2 < len(argv):
+            _registry.set(argv[i + 1], argv[i + 2])
+            i += 3
+        else:
+            out.append(argv[i])
+            i += 1
+    return out

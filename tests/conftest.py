@@ -1,0 +1,46 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+Multi-chip hardware is not available in CI; sharding/SPMD tests run on a
+virtual 8-device CPU mesh (the same validation the driver's
+dryrun_multichip performs). Must run before jax is first imported.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# PARSEC_TEST_TPU=1 opts in to running the suite against the real chip.
+# The env var JAX_PLATFORMS is overridden by the axon plugin, so force the
+# platform through the config API before any backend initialization.
+if not os.environ.get("PARSEC_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def ctx():
+    """A small runtime context, torn down after the test."""
+    import parsec_tpu as parsec
+    c = parsec.init(nb_cores=4)
+    c.start()
+    yield c
+    parsec.fini(c)
+
+
+def spd_matrix(rng, n, dtype=np.float32):
+    """Random symmetric positive-definite matrix."""
+    M = rng.standard_normal((n, n)).astype(np.float64)
+    A = M @ M.T + n * np.eye(n)
+    return A.astype(dtype)

@@ -1,0 +1,181 @@
+"""Core runtime tests: termdet, datarepo, schedulers, hand-written task
+classes through the full select→execute→release loop (reference
+tests/runtime + tests/class analog)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import parsec_tpu as parsec
+from parsec_tpu.core.task import Chore, DeviceType, Flow, FlowAccess, Task
+from parsec_tpu.core.taskpool import (DEPS_COUNTER, SuccessorRef, TaskClass,
+                                      Taskpool)
+from parsec_tpu.core.datarepo import DataRepo
+from parsec_tpu.termdet import LocalTermdet, UserTriggerTermdet
+
+
+# ---------------------------------------------------------------- termdet
+def test_local_termdet_counts():
+    done = []
+    m = LocalTermdet()
+    m.monitor(lambda: done.append(1))
+    m.set_nb_tasks(2)
+    assert not done
+    m.addto_nb_tasks(-1)
+    m.addto_nb_tasks(-1)
+    assert done == [1]
+
+
+def test_local_termdet_runtime_actions_defer():
+    done = []
+    m = LocalTermdet()
+    m.monitor(lambda: done.append(1))
+    m.addto_runtime_actions(1)
+    m.set_nb_tasks(0)
+    assert not done          # pending action holds termination
+    m.addto_runtime_actions(-1)
+    assert done == [1]
+
+
+def test_user_trigger_termdet():
+    done = []
+    m = UserTriggerTermdet()
+    m.monitor(lambda: done.append(1))
+    m.set_nb_tasks(0)
+    assert not done          # idle but not triggered
+    m.trigger()
+    assert done == [1]
+
+
+# ---------------------------------------------------------------- datarepo
+def test_datarepo_usage_protocol():
+    repo = DataRepo(nb_flows=2)
+    ent = repo.lookup_or_create("k1")
+    ent.set(0, "v0")
+    repo.entry_addto_usage_limit("k1", 2)   # 2 consumers, drops retain
+    assert len(repo) == 1
+    repo.entry_used_once("k1")
+    assert len(repo) == 1
+    repo.entry_used_once("k1")
+    assert len(repo) == 0                   # freed after both consumers
+
+
+# ------------------------------------------------- hand-written task class
+def _chain_taskpool(n, results):
+    """A chain DAG T(0) -> T(1) -> ... -> T(n-1) accumulating +1
+    (Ex02_Chain / tests/runtime/multichain analog) built directly against
+    the core TaskClass vtable — what generated PTG code produces."""
+    tp = Taskpool("chain")
+    tc = TaskClass("T", 0, params=("i",),
+                   flows=[Flow("X", FlowAccess.RW)], deps_mode=DEPS_COUNTER)
+
+    def hook(task, x):
+        return x + 1
+
+    tc.add_chore(Chore(DeviceType.CPU, hook))
+    tc.deps_goal = lambda locals: 0 if locals[0] == 0 else 1
+
+    def iterate_successors(task):
+        i = task.locals[0]
+        if i + 1 < n:
+            yield SuccessorRef(task_class=tc, locals=(i + 1,),
+                               flow_name="X", value=task.output["X"])
+        else:
+            results.append(task.output["X"])
+    tc.iterate_successors = iterate_successors
+    tp.add_task_class(tc)
+
+    def startup(tp_):
+        tp_.set_nb_tasks(n)
+        t0 = Task(tp_, tc, (0,))
+        t0.data["X"] = 0
+        return [t0]
+    tp.startup_hook = startup
+    return tp
+
+
+def test_chain_dag_executes(ctx):
+    results = []
+    tp = _chain_taskpool(25, results)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    assert results == [25]
+
+
+@pytest.mark.parametrize("sched", ["lfq", "ll", "llp", "ap", "ip", "gd",
+                                   "pbq", "spq", "rnd", "ltq", "lhq"])
+def test_all_schedulers_run_chain(sched):
+    c = parsec.init(nb_cores=3, scheduler=sched)
+    try:
+        results = []
+        tp = _chain_taskpool(10, results)
+        c.add_taskpool(tp)
+        assert c.wait(timeout=30)
+        assert results == [10]
+    finally:
+        parsec.fini(c)
+
+
+def test_compound_taskpools_sequence(ctx):
+    """parsec_compose analog (tests/api/compose.c)."""
+    order = []
+    r1, r2 = [], []
+    tp1 = _chain_taskpool(3, r1)
+    tp2 = _chain_taskpool(4, r2)
+    tp1.on_complete = lambda tp: order.append("tp1")
+    tp2.on_complete = lambda tp: order.append("tp2")
+    comp = parsec.compose(tp1, tp2)
+    ctx.add_taskpool(comp)
+    assert ctx.wait(timeout=30)
+    assert order == ["tp1", "tp2"]
+    assert r1 == [3] and r2 == [4]
+
+
+def test_fork_join_diamond(ctx):
+    """Diamond: A -> (B, C) -> D (dep counting with two inputs)."""
+    tp = Taskpool("diamond")
+    out = {}
+    tcA = TaskClass("A", 0, (), [Flow("X", FlowAccess.WRITE)])
+    tcB = TaskClass("B", 1, (), [Flow("X", FlowAccess.RW)])
+    tcC = TaskClass("C", 2, (), [Flow("X", FlowAccess.RW)])
+    tcD = TaskClass("D", 3, (),
+                    [Flow("L", FlowAccess.READ), Flow("R", FlowAccess.READ)])
+    # WRITE-only flows still occupy a body-input slot (value None)
+    tcA.add_chore(Chore(DeviceType.CPU, lambda t, x: 1))
+    tcB.add_chore(Chore(DeviceType.CPU, lambda t, x: x + 10))
+    tcC.add_chore(Chore(DeviceType.CPU, lambda t, x: x + 100))
+    def d_hook(t, l, r):
+        out["sum"] = l + r        # no output flows → return None
+    tcD.add_chore(Chore(DeviceType.CPU, d_hook))
+    tcA.deps_goal = lambda l: 0
+    tcB.deps_goal = tcC.deps_goal = lambda l: 1
+    tcD.deps_goal = lambda l: 2
+    tcA.iterate_successors = lambda task: [
+        SuccessorRef(tcB, (), "X", task.output["X"]),
+        SuccessorRef(tcC, (), "X", task.output["X"])]
+    tcB.iterate_successors = lambda task: [
+        SuccessorRef(tcD, (), "L", task.output["X"])]
+    tcC.iterate_successors = lambda task: [
+        SuccessorRef(tcD, (), "R", task.output["X"])]
+    tcD.iterate_successors = lambda task: []
+    for tc in (tcA, tcB, tcC, tcD):
+        tp.add_task_class(tc)
+
+    def startup(tp_):
+        tp_.set_nb_tasks(4)
+        return [Task(tp_, tcA, ())]
+    tp.startup_hook = startup
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    assert out["sum"] == (1 + 10) + (1 + 100)
+
+
+def test_device_stats_collected(ctx):
+    results = []
+    tp = _chain_taskpool(5, results)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    stats = ctx.devices.dump_statistics()
+    assert sum(s["tasks"] for s in stats) == 5

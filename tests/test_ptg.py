@@ -1,0 +1,128 @@
+"""PTG DSL tests: closed-form dep iteration, the iterators-checker
+cross-validation, chain/stencil-style graphs, POTRF on the host runtime
+(reference tests/dsl/ptg analog)."""
+
+import numpy as np
+import pytest
+
+import parsec_tpu as parsec
+from parsec_tpu.dsl import ptg
+from parsec_tpu.data import LocalCollection, TiledMatrix
+from parsec_tpu.algorithms.potrf import build_potrf
+from parsec_tpu.algorithms.gemm import build_gemm_ptg
+from conftest import spd_matrix
+
+
+def _chain_tp(n, store):
+    """Ex02_Chain JDF analog: T(i) passes X to T(i+1)."""
+    tp = ptg.Taskpool("chain", N=n, S=store)
+    T = tp.task_class(
+        "T", params=("i",),
+        space=lambda g: ((i,) for i in range(g.N)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            tile=lambda g, i: (g.S, ("x",)),
+            ins=[ptg.In(data=lambda g, i: (g.S, ("x",)),
+                        guard=lambda g, i: i == 0),
+                 ptg.In(src=("T", lambda g, i: (i - 1,), "X"),
+                        guard=lambda g, i: i > 0)],
+            outs=[ptg.Out(dst=("T", lambda g, i: (i + 1,), "X"),
+                          guard=lambda g, i: i < g.N - 1),
+                  ptg.Out(data=lambda g, i: (g.S, ("x",)),
+                          guard=lambda g, i: i == g.N - 1)])])
+
+    @T.body
+    def body(task, x):
+        return x + 1
+    return tp
+
+
+def test_ptg_chain(ctx):
+    store = LocalCollection("S", {("x",): 0})
+    tp = _chain_tp(20, store)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    assert store.data_of(("x",)) == 20
+
+
+def test_ptg_checker_accepts_chain():
+    store = LocalCollection("S", {("x",): 0})
+    ptg.check_taskpool(_chain_tp(10, store))
+
+
+def test_ptg_checker_accepts_potrf():
+    A = TiledMatrix(8 * 16, 8 * 16, 16, 16, name="A")
+    ptg.check_taskpool(build_potrf(A))
+
+
+def test_ptg_checker_rejects_bad_target():
+    """A dep aiming at a non-existent task instance must be caught
+    (ptgpp compile-failure tests analog, tests/CMakeLists.txt:13-36)."""
+    store = LocalCollection("S", {("x",): 0})
+    tp = ptg.Taskpool("bad", N=3, S=store)
+    tp.task_class(
+        "T", params=("i",),
+        space=lambda g: ((i,) for i in range(g.N)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, i: (g.S, ("x",)),
+                        guard=lambda g, i: i == 0),
+                 ptg.In(src=("T", lambda g, i: (i - 1,), "X"),
+                        guard=lambda g, i: i > 0)],
+            # bug: feeds T(N) which does not exist
+            outs=[ptg.Out(dst=("T", lambda g, i: (i + 1,), "X"))])])
+    with pytest.raises(AssertionError):
+        ptg.check_taskpool(tp)
+
+
+def test_ptg_guard_disjointness_enforced(ctx):
+    store = LocalCollection("S", {("x",): 0})
+    tp = ptg.Taskpool("amb", S=store)
+    tc = tp.task_class(
+        "T", params=("i",),
+        space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "X", ptg.READ,
+            ins=[ptg.In(data=lambda g, i: (g.S, ("x",))),
+                 ptg.In(data=lambda g, i: (g.S, ("x",)))])])
+    with pytest.raises(RuntimeError):
+        tc._active_in(tp.g, tc.specs["X"], (0,))
+
+
+def test_ptg_gemm_matches_numpy(ctx, rng):
+    m = n = k = 48
+    mb = 16
+    Ah = rng.standard_normal((m, k)).astype(np.float32)
+    Bh = rng.standard_normal((k, n)).astype(np.float32)
+    Ch = rng.standard_normal((m, n)).astype(np.float32)
+    A = TiledMatrix.from_array(Ah, mb, mb, name="A")
+    B = TiledMatrix.from_array(Bh, mb, mb, name="B")
+    C = TiledMatrix.from_array(Ch.copy(), mb, mb, name="C")
+    tp = build_gemm_ptg(A, B, C)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=60)
+    np.testing.assert_allclose(C.to_array(), Ah @ Bh + Ch,
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_ptg_potrf_host_runtime_matches_numpy(ctx, rng):
+    n, nb = 64, 16
+    Ah = spd_matrix(rng, n)
+    A = TiledMatrix.from_array(Ah.copy(), nb, nb, name="A")
+    tp = build_potrf(A)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=120)
+    L = np.tril(A.to_array())
+    np.testing.assert_allclose(L @ L.T, Ah, rtol=2e-2, atol=2e-2)
+
+
+def test_ptg_nb_local_tasks_closed_form():
+    A = TiledMatrix(4 * 8, 4 * 8, 8, 8, name="A")
+    tp = build_potrf(A)
+    counts = {tc.name: tc.nb_local_tasks() for tc in tp.task_classes}
+    NT = 4
+    assert counts["POTRF"] == NT
+    assert counts["TRSM"] == NT * (NT - 1) // 2
+    assert counts["SYRK"] == NT * (NT - 1) // 2
+    assert counts["GEMM"] == sum(n for m in range(2, NT)
+                                 for n in range(1, m))

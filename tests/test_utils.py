@@ -1,0 +1,61 @@
+"""Foundation tests: MCA params, debug streams (reference tests/class analog)."""
+
+import os
+
+import pytest
+
+from parsec_tpu.utils import debug, mca_param
+
+
+def test_mca_register_get_default():
+    mca_param.register("test.alpha", 7, help="x")
+    assert mca_param.get("test.alpha") == 7
+
+
+def test_mca_env_override(monkeypatch):
+    mca_param.register("test.beta", 1)
+    monkeypatch.setenv("PARSEC_MCA_test_beta", "42")
+    assert mca_param.get("test.beta") == 42
+
+
+def test_mca_set_beats_env(monkeypatch):
+    mca_param.register("test.gamma", 1)
+    monkeypatch.setenv("PARSEC_MCA_test_gamma", "5")
+    mca_param.set("test.gamma", 9)
+    try:
+        assert mca_param.get("test.gamma") == 9
+    finally:
+        mca_param.unset("test.gamma")
+    assert mca_param.get("test.gamma") == 5
+
+
+def test_mca_bool_coercion(monkeypatch):
+    mca_param.register("test.flag", True, type=bool)
+    monkeypatch.setenv("PARSEC_MCA_test_flag", "off")
+    assert mca_param.get("test.flag") is False
+
+
+def test_mca_cli_parse():
+    rest = mca_param.parse_cli(["prog", "--mca", "test.cli", "3", "tail"])
+    try:
+        assert rest == ["prog", "tail"]
+        assert mca_param.get("test.cli") == "3"
+    finally:
+        mca_param.unset("test.cli")
+
+
+def test_mca_dump_contains_registered():
+    mca_param.register("test.dumped", 11, help="dump me")
+    names = [p["name"] for p in mca_param.dump()]
+    assert "test.dumped" in names
+
+
+def test_debug_history_ring():
+    debug.history_clear()
+    debug.debug_verbose(99, "test", "quiet message %d", 1)
+    assert "quiet message 1" in debug.history_dump()
+
+
+def test_debug_fatal_raises():
+    with pytest.raises(RuntimeError):
+        debug.fatal("test", "boom")
