@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Driver benchmark: tiled POTRF (DPLASMA-style) GFLOP/s on one chip.
+
+Matches BASELINE.md's target metric: "tiled POTRF/GEMM GFLOP/s per chip,
+>=65% of chip peak". Since the reference publishes no absolute numbers
+(BASELINE.md: "published: {}"), the baseline denominator is measured on
+the same chip: peak-proxy GEMM throughput (one large square matmul at the
+same dtype). vs_baseline = potrf_gflops / (0.65 * peak_proxy_gflops) —
+i.e. >= 1.0 means the north-star 65%-of-peak target is met.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "GFLOP/s", "vs_baseline": N, ...}
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# The axon TPU plugin overrides the JAX_PLATFORMS env var, so honor an
+# explicit platform request through the config API (PARSEC_BENCH_PLATFORM=cpu
+# for local smoke runs; default = whatever the driver provides, i.e. TPU).
+_plat = os.environ.get("PARSEC_BENCH_PLATFORM")
+if _plat:
+    import jax
+    jax.config.update("jax_platforms", _plat)
+
+
+def _spd_host(n, rng):
+    """Diagonally-dominant SPD matrix in O(n^2) host work (a dense
+    M @ M.T at bench sizes would cost minutes of host time)."""
+    import numpy as np
+    R = rng.standard_normal((n, n)).astype(np.float32)
+    A = 0.5 * (R + R.T)
+    A[np.diag_indices(n)] += 2.0 * n
+    return A
+
+
+def _measure_peak_gemm(jnp, jax, n=4096, dtype="float32", iters=8):
+    """Large square matmul GFLOP/s — the chip-peak proxy at this dtype."""
+    a = jnp.ones((n, n), dtype=dtype)
+    b = jnp.ones((n, n), dtype=dtype)
+    f = jax.jit(lambda x, y: x @ y)
+    f(a, b).block_until_ready()                      # compile
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = f(a, b)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+    return 2.0 * n ** 3 / dt / 1e9
+
+
+def main():
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from parsec_tpu.algorithms.potrf import build_potrf, potrf_flops
+    from parsec_tpu.compiled.wavefront import WavefrontExecutor, plan_taskpool
+    from parsec_tpu.data.matrix import TiledMatrix
+
+    backend = jax.default_backend()
+    # Chip-sized problem on TPU; small on the CPU fallback path.
+    if backend == "tpu":
+        N, NB = 16384, 1024
+    else:
+        N, NB = 1024, 128
+
+    rng = np.random.default_rng(0)
+    A_host = _spd_host(N, rng)
+    A = TiledMatrix.from_array(A_host, NB, NB, name="A")
+
+    tp = build_potrf(A)
+    plan = plan_taskpool(tp)
+    ex = WavefrontExecutor(plan)
+
+    stores = ex.make_stores()
+    fn = ex.jitted
+    t0 = time.perf_counter()
+    out = fn(stores)
+    for v in out.values():
+        v.block_until_ready()
+    compile_s = time.perf_counter() - t0
+
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(stores)
+        for v in out.values():
+            v.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+
+    gflops = potrf_flops(N) / dt / 1e9
+
+    # Correctness: L L^T == A on the leading tile block (full check on CPU).
+    ex.write_back(out)
+    L = np.tril(A.to_array().astype(np.float64))
+    if backend == "tpu":
+        k = min(4 * NB, N)
+        err = np.linalg.norm(L[:k, :k] @ L[:k, :k].T - A_host[:k, :k]) / \
+            np.linalg.norm(A_host[:k, :k])
+    else:
+        err = np.linalg.norm(L @ L.T - A_host) / np.linalg.norm(A_host)
+
+    peak_proxy = _measure_peak_gemm(jnp, jax, dtype="float32")
+    target = 0.65 * peak_proxy
+
+    print(json.dumps({
+        "metric": "tiled_potrf_gflops_per_chip",
+        "value": round(gflops, 2),
+        "unit": "GFLOP/s",
+        "vs_baseline": round(gflops / target, 4) if target > 0 else 0.0,
+        "detail": {
+            "backend": backend, "n": N, "tile": NB,
+            "n_tasks": plan.n_tasks, "n_waves": plan.n_waves,
+            "peak_proxy_gemm_gflops": round(peak_proxy, 2),
+            "target_gflops_65pct_peak": round(target, 2),
+            "compile_s": round(compile_s, 2),
+            "run_s": round(dt, 4),
+            "rel_residual_check": float(f"{err:.3e}"),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

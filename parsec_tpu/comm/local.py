@@ -46,6 +46,10 @@ class LocalCommEngine(CommEngine):
         # taskpool name -> this rank's termdet monitor (the reference keys
         # remote activity per taskpool id; waves are per-taskpool)
         self._termdet_monitors: Dict[str, object] = {}
+        # activations for taskpools this rank has not registered yet, parked
+        # until add_taskpool (reference: unknown-taskpool noobj fifo,
+        # remote_dep_mpi.c:1857-1869) — dropping them would lose the dep
+        self._parked: Dict[str, List[tuple]] = {}
         self._progress_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -139,10 +143,15 @@ class LocalCommEngine(CommEngine):
         from ..core.taskpool import SuccessorRef
 
         def _on_activate(src_rank: int, msg: Dict) -> None:
-            tp = next((t for t in context._active_taskpools
-                       if t.name == msg["taskpool"]), None)
-            if tp is None:
-                return
+            with context._lock:
+                tp = next((t for t in context._active_taskpools
+                           if t.name == msg["taskpool"]), None)
+                if tp is None:
+                    # taskpool not registered here yet: park the activation
+                    # (drained by register_termdet when add_taskpool runs)
+                    self._parked.setdefault(msg["taskpool"], []).append(
+                        (src_rank, msg))
+                    return
             tp.monitor.incoming_message_start(src_rank)
             tc = tp.get_task_class(msg["class"])
             ref = SuccessorRef(task_class=tc, locals=tuple(msg["locals"]),
@@ -155,6 +164,14 @@ class LocalCommEngine(CommEngine):
             tp.monitor.incoming_message_end(src_rank)
 
         self.tag_register(AMTag.ACTIVATE, _on_activate)
+
+    def taskpool_registered(self, tp) -> None:
+        """Called by Context.add_taskpool once ``tp`` is visible in
+        _active_taskpools: re-deliver activations that arrived early."""
+        parked = self._parked.pop(tp.name, [])
+        cb = self._am_callbacks.get(AMTag.ACTIVATE)
+        for (src_rank, msg) in parked:
+            cb(src_rank, msg)
 
     # -- termdet services -------------------------------------------------
     def register_termdet(self, name: str, monitor) -> None:

@@ -241,6 +241,9 @@ class WavefrontExecutor:
         self.bucket = bucket
         self.device_type = device_type
         self._vmapped: Dict[str, Callable] = {}
+        # jit once: a fresh jax.jit wrapper per run() would recompile the
+        # whole-DAG program on every call (jit caches by function object)
+        self.jitted = self.jax.jit(self.run_arrays)
 
     # -- body lookup ------------------------------------------------------
     def _body(self, tc: PTGTaskClass) -> Callable:
@@ -314,7 +317,7 @@ class WavefrontExecutor:
     def run(self, jit: bool = True) -> float:
         t0 = time.perf_counter()
         stores = self.make_stores()
-        fn = self.jax.jit(self.run_arrays) if jit else self.run_arrays
+        fn = self.jitted if jit else self.run_arrays
         out = fn(stores)
         for v in out.values():
             v.block_until_ready()

@@ -37,6 +37,11 @@ class CompoundTaskpool(Taskpool):
         def _chain(tp, _prev=prev_cb):
             if _prev is not None:
                 _prev(tp)
+            if tp.error is not None:
+                # aborted member: don't run later stages on failed data —
+                # propagate the abort to the compound (parsec_abort analog)
+                self.abort(tp.error)
+                return
             self._start_next()
 
         member.on_complete = _chain

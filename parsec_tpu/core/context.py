@@ -42,7 +42,7 @@ class ExecutionStream:
     """Per-worker execution stream (reference parsec_execution_stream_t)."""
 
     __slots__ = ("context", "th_id", "vp_id", "sched_obj", "next_task",
-                 "thread", "stats")
+                 "thread", "stats", "_vp_peers", "_steal_order")
 
     def __init__(self, context: "Context", th_id: int, vp_id: int):
         self.context = context
@@ -52,6 +52,8 @@ class ExecutionStream:
         self.next_task: Optional[Task] = None   # priority bypass slot
         self.thread: Optional[threading.Thread] = None
         self.stats = {"executed": 0, "selected": 0, "starved": 0}
+        self._vp_peers = None        # cached steal orders (sched/base.py)
+        self._steal_order = None
 
 
 def _parse_vpmap(nb_cores: int) -> List[int]:
@@ -127,6 +129,8 @@ class Context:
         tp.context = self
         with self._lock:
             self._active_taskpools.append(tp)
+        if self.comm is not None and hasattr(self.comm, "taskpool_registered"):
+            self.comm.taskpool_registered(tp)   # drain parked activations
         if tp.on_enqueue is not None:
             tp.on_enqueue(tp)
         self.pins.taskpool_init(tp)

@@ -42,30 +42,34 @@ class Device:
         """Run the functional body and normalize outputs into
         ``task.output`` keyed by output-flow name."""
         t0 = time.perf_counter()
-        inputs = task.input_values()
-        result = chore.hook(task, *inputs)
-        out_flows = task.task_class.output_flows
-        if result is None:
-            outs = {}
-        elif isinstance(result, dict):
-            outs = result
-        elif isinstance(result, (tuple, list)):
-            if len(result) != len(out_flows):
-                raise ValueError(
-                    f"{task!r}: body returned {len(result)} values for "
-                    f"{len(out_flows)} output flows")
-            outs = {f.name: v for f, v in zip(out_flows, result)}
-        else:
-            if len(out_flows) != 1:
-                raise ValueError(
-                    f"{task!r}: single return value but {len(out_flows)} "
-                    f"output flows")
-            outs = {out_flows[0].name: result}
-        task.output.update(outs)
-        with self._lock:
-            self.stats["tasks"] += 1
-            self.stats["exec_s"] += time.perf_counter() - t0
-        return HookReturn.DONE
+        try:
+            inputs = task.input_values()
+            result = chore.hook(task, *inputs)
+            out_flows = task.task_class.output_flows
+            if result is None:
+                outs = {}
+            elif isinstance(result, dict):
+                outs = result
+            elif isinstance(result, (tuple, list)):
+                if len(result) != len(out_flows):
+                    raise ValueError(
+                        f"{task!r}: body returned {len(result)} values for "
+                        f"{len(out_flows)} output flows")
+                outs = {f.name: v for f, v in zip(out_flows, result)}
+            else:
+                if len(out_flows) != 1:
+                    raise ValueError(
+                        f"{task!r}: single return value but {len(out_flows)} "
+                        f"output flows")
+                outs = {out_flows[0].name: result}
+            task.output.update(outs)
+            with self._lock:
+                self.stats["tasks"] += 1
+                self.stats["exec_s"] += time.perf_counter() - t0
+            return HookReturn.DONE
+        finally:
+            with self._lock:
+                self.load = max(0.0, self.load - 1.0)
 
     def dump_statistics(self) -> Dict:
         return dict(self.stats, name=self.name, index=self.index)
@@ -97,15 +101,26 @@ class Registry:
 
     def device_for(self, device_type: DeviceType, task: Task) -> Optional[Device]:
         """parsec_get_best_device analog: among devices matching the chore's
-        type, pick the least (load / weight)."""
+        type, pick the least (load / weight); ties go to the heavier device
+        (idle accelerator beats idle CPU). The recursive pseudo-device is
+        never auto-selected — only chores that name it explicitly use it
+        (reference: PARSEC_DEV_RECURSIVE is special-cased in the core, not
+        part of load balancing)."""
         best, best_score = None, None
         for dev in self.devices:
             if not (dev.device_type & device_type):
                 continue
+            if dev.device_type == DeviceType.RECURSIVE and \
+                    device_type != DeviceType.RECURSIVE:
+                continue
             score = dev.load / dev.weight
-            if best_score is None or score < best_score:
+            if best_score is None or score < best_score or \
+                    (score == best_score and dev.weight > best.weight):
                 best, best_score = dev, score
-        return best
+        if best is not None:
+            with best._lock:
+                best.load += 1.0       # in-flight work unit; released by
+        return best                    # _task_done after the body runs
 
     def by_type(self, device_type: DeviceType) -> List[Device]:
         return [d for d in self.devices if d.device_type & device_type]

@@ -19,9 +19,13 @@ class RecursiveDevice(Device):
     name = "recursive"
 
     def execute(self, es, task: Task, chore: Chore) -> HookReturn:
-        child = chore.hook(task, *task.input_values())
-        if not isinstance(child, Taskpool):
-            raise TypeError("recursive chore must return a Taskpool")
+        try:
+            child = chore.hook(task, *task.input_values())
+            if not isinstance(child, Taskpool):
+                raise TypeError("recursive chore must return a Taskpool")
+        finally:
+            with self._lock:
+                self.load = max(0.0, self.load - 1.0)
         ctx = self.registry.context
 
         def _child_done(tp, _task=task) -> None:

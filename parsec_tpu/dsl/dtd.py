@@ -160,6 +160,7 @@ class Taskpool(CoreTaskpool):
                            flows=flows, deps_mode=DEPS_COUNTER)
             tc.deps_goal = lambda locals: self._goals.get(locals[0], _GOAL_UNSET)
             tc.iterate_successors = self._iterate_successors
+            tc.data_lookup = self._data_lookup
 
             def _hook(task: Task, *flow_vals, _fn=fn):
                 args: List[Any] = []
@@ -205,7 +206,7 @@ class Taskpool(CoreTaskpool):
         task = Task(self, tc, (0,), priority=priority)
         task.locals = (task.uid,)
         task.dsl.update(argspec=[], out_tiles=[], succ=[], done=False,
-                        lock=threading.Lock(), affinity=None)
+                        lock=threading.Lock(), affinity=None, aliases={})
 
         # register before linking so a racing writer completion can route
         # activations to this task
@@ -218,6 +219,7 @@ class Taskpool(CoreTaskpool):
 
         goal = 0
         flow_i = 0
+        seen_tiles: Dict[Any, str] = {}   # tile → primary flow of THIS task
         for a in args:
             if isinstance(a, ValueArg):
                 task.dsl["argspec"].append(("value", a.value))
@@ -231,23 +233,33 @@ class Taskpool(CoreTaskpool):
             task.dsl["argspec"].append(("tile", None))
             if a.affinity:
                 task.dsl["affinity"] = (a.collection, a.key)
-            with tile.lock:
-                writer = tile.last_writer
-            linked = False
-            if writer is not None:
-                with writer.dsl["lock"]:
-                    if not writer.dsl["done"]:
-                        ref = SuccessorRef(task_class=tc, locals=task.locals,
-                                           flow_name=fname, value=None,
-                                           priority=priority)
-                        ref.src_flow = tile.last_writer_flow
-                        writer.dsl["succ"].append(ref)
-                        goal += 1
-                        linked = True
-            if not linked:
-                # no in-flight writer: snapshot the program-order value now
-                # (immutable arrays make the snapshot stay valid)
-                task.data[fname] = a.collection.data_of(a.key)
+            primary = seen_tiles.get(tile)
+            if primary is not None:
+                # same tile passed twice in one insert: alias the flow to
+                # the first occurrence instead of linking the task as its
+                # own predecessor (which would deadlock); resolved by
+                # _data_lookup just before execution
+                task.dsl["aliases"][fname] = primary
+            else:
+                seen_tiles[tile] = fname
+                with tile.lock:
+                    writer = tile.last_writer
+                linked = False
+                if writer is not None:
+                    with writer.dsl["lock"]:
+                        if not writer.dsl["done"]:
+                            ref = SuccessorRef(task_class=tc,
+                                               locals=task.locals,
+                                               flow_name=fname, value=None,
+                                               priority=priority)
+                            ref.src_flow = tile.last_writer_flow
+                            writer.dsl["succ"].append(ref)
+                            goal += 1
+                            linked = True
+                if not linked:
+                    # no in-flight writer: snapshot the program-order value
+                    # now (immutable arrays make the snapshot stay valid)
+                    task.data[fname] = a.collection.data_of(a.key)
             if a.access & FlowAccess.WRITE:
                 with tile.lock:
                     tile.last_writer = task
@@ -275,6 +287,13 @@ class Taskpool(CoreTaskpool):
         return task
 
     # ----------------------------------------------------- class callbacks
+    def _data_lookup(self, task: Task) -> None:
+        """prepare_input analog: resolve aliased flows (same tile passed
+        twice in one insert) from their primary flow's delivered value."""
+        for alias, primary in task.dsl.get("aliases", {}).items():
+            if alias not in task.data:
+                task.data[alias] = task.data.get(primary)
+
     def _iterate_successors(self, task: Task):
         # 1) write produced versions back and retire the writer slot, so
         #    late-inserted readers snapshot the new value
@@ -326,11 +345,15 @@ class Taskpool(CoreTaskpool):
         return task
 
     def wait(self, context=None) -> None:
-        """parsec_dtd_taskpool_wait analog: drain all inserted tasks."""
-        self._closed = True
+        """parsec_dtd_taskpool_wait analog: drain all inserted tasks.
+        Idempotent — only the first call releases the enqueue-time runtime
+        action; later calls just join."""
         with self._inflight_cv:
+            first = not self._closed
+            self._closed = True
             self._inflight_cv.notify_all()
-        self.addto_runtime_actions(-1)
+        if first:
+            self.addto_runtime_actions(-1)
         self.wait_completed()
 
     def flush(self, collection: Optional[DataCollection] = None,

@@ -44,7 +44,13 @@ def vp_peers(es) -> List:
     """Execution streams in the same virtual process as ``es``, steal order:
     self first, then co-VP streams by increasing distance (reference
     sched_local_queues_utils.h hierarchical steal simplified to ring order
-    inside the VP)."""
+    inside the VP). Streams and VP assignment are fixed at context
+    construction, so the order is computed once and cached on the stream —
+    select() sits on the worker hot path."""
+    cached = getattr(es, "_vp_peers", None)
+    if cached is not None:
+        return cached
     streams = [s for s in es.context.streams if s.vp_id == es.vp_id]
     streams.sort(key=lambda s: (s.th_id - es.th_id) % max(len(streams), 1))
+    es._vp_peers = streams
     return streams

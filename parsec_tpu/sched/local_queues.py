@@ -67,6 +67,7 @@ class _LocalQueueScheduler(Scheduler):
 
     def flow_init(self, es) -> None:
         es.sched_obj = _LocalDeque()
+        es._steal_order = None      # invalidate on (re)install
 
     def _push_local(self, q: _LocalDeque, tasks, distance: int) -> None:
         if distance <= 0:
@@ -96,7 +97,11 @@ class _LocalQueueScheduler(Scheduler):
         t = self._pop_local(es.sched_obj)
         if t is not None:
             return t
-        for peer in self._steal_order(es):
+        # steal order is topology-fixed: computed once, cached on the stream
+        order = es._steal_order
+        if order is None:
+            order = es._steal_order = self._steal_order(es)
+        for peer in order:
             if peer is es:
                 continue
             t = self._steal(peer.sched_obj)

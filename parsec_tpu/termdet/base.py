@@ -47,8 +47,7 @@ class TermdetMonitor:
     def set_nb_tasks(self, n: int) -> None:
         with self._lock:
             self._nb_tasks = n
-            if self._state == TermdetState.NOT_READY:
-                self._state = TermdetState.BUSY
+            self._rearm_locked()
             fire = self._maybe_idle_locked()
         if fire:
             self._fire()
@@ -57,8 +56,7 @@ class TermdetMonitor:
     def addto_nb_tasks(self, d: int) -> None:
         with self._lock:
             self._nb_tasks += d
-            if self._state == TermdetState.NOT_READY:
-                self._state = TermdetState.BUSY
+            self._rearm_locked()
             if self._nb_tasks < 0:
                 raise RuntimeError("nb_tasks went negative")
             fire = self._maybe_idle_locked()
@@ -69,12 +67,25 @@ class TermdetMonitor:
     def addto_runtime_actions(self, d: int) -> None:
         with self._lock:
             self._runtime_actions += d
+            self._rearm_locked()
             if self._runtime_actions < 0:
                 raise RuntimeError("runtime_actions went negative")
             fire = self._maybe_idle_locked()
         if fire:
             self._fire()
         self._post_transition()
+
+    def _rearm_locked(self) -> None:
+        """NOT_READY→BUSY on first counter activity, and IDLE→BUSY when new
+        work appears after a quiet period (reference termdet.h state
+        machine: IDLE is not final for modules that wait on remote
+        confirmation — a late local task or message must re-arm the
+        monitor or termination is missed forever)."""
+        if self._state == TermdetState.NOT_READY:
+            self._state = TermdetState.BUSY
+        elif self._state == TermdetState.IDLE and \
+                (self._nb_tasks > 0 or self._runtime_actions > 0):
+            self._state = TermdetState.BUSY
 
     def ready(self) -> None:
         """Transition NOT_READY → BUSY (taskpool fully constructed)."""

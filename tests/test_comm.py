@@ -118,3 +118,37 @@ def test_fourcounter_single_rank_degenerates_to_local():
     m.set_nb_tasks(1)
     m.addto_nb_tasks(-1)
     assert done == [1]
+
+
+def test_early_activation_parks_until_taskpool_registered():
+    """An ACTIVATE arriving before the receiving rank registers the
+    taskpool must be parked and re-delivered, not dropped (reference
+    unknown-taskpool fifo, remote_dep_mpi.c:1857-1869)."""
+    import time
+
+    N = 4
+    engines = LocalCommEngine.make_fabric(2)
+    ctxs, tps, stores = [], [], []
+    for r in range(2):
+        ctx = parsec.init(nb_cores=2, comm=engines[r])
+        store = _AlternatingStore("S", r, 2)
+        store.write_tile((0,), 0)
+        tp = _chain_tp(N, store)
+        tp.monitor = FourCounterTermdet(comm=engines[r])
+        ctxs.append(ctx)
+        tps.append(tp)
+        stores.append(store)
+    try:
+        # rank 0 starts and runs its first task BEFORE rank 1 registers:
+        # the activation for T(1) lands on rank 1 with no taskpool there
+        ctxs[0].add_taskpool(tps[0])
+        ctxs[0].start()
+        time.sleep(0.5)
+        ctxs[1].add_taskpool(tps[1])
+        ctxs[1].start()
+        for ctx in ctxs:
+            assert ctx.wait(timeout=60), "parked activation was lost"
+        assert stores[(N - 1) % 2].data_of((N - 1,)) == N
+    finally:
+        for ctx in ctxs:
+            parsec.fini(ctx)

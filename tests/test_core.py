@@ -179,3 +179,46 @@ def test_device_stats_collected(ctx):
     assert ctx.wait(timeout=30)
     stats = ctx.devices.dump_statistics()
     assert sum(s["tasks"] for s in stats) == 5
+
+
+def test_compound_stops_after_member_abort(ctx):
+    """A failing member must abort the compound; later members must NOT
+    run on failed data (compound.c analog + parsec_abort semantics)."""
+    from parsec_tpu.dsl import ptg
+
+    ran = []
+
+    def make(name, fail=False):
+        tp = ptg.Taskpool(name, N=1)
+        T = tp.task_class(
+            "T", params=("k",), space=lambda g: ((0,),),
+            flows=[ptg.FlowSpec("X", ptg.CTL)])
+
+        @T.body
+        def body(task, _name=name, _fail=fail):
+            if _fail:
+                raise ValueError("member failed")
+            ran.append(_name)
+        return tp
+
+    comp = parsec.compose(make("a", fail=True), make("b"))
+    ctx.add_taskpool(comp)
+    with pytest.raises(RuntimeError, match="member failed"):
+        ctx.wait()
+    assert "b" not in ran
+
+
+def test_user_trigger_rearms_after_idle():
+    """Monitor must re-arm IDLE→BUSY when tasks appear after a quiet
+    period, so a trigger placed while busy still terminates."""
+    from parsec_tpu.termdet.user_trigger import UserTriggerTermdet
+
+    fired = []
+    m = UserTriggerTermdet()
+    m.monitor(lambda: fired.append(1))
+    m.ready()                       # quiet: goes IDLE, not triggered
+    m.addto_nb_tasks(1)             # new work arrives → must re-arm BUSY
+    m.trigger()                     # trigger while busy: no fire yet
+    assert not fired
+    m.addto_nb_tasks(-1)            # drains → IDLE → triggered → TERMINATED
+    assert fired == [1]

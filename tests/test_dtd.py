@@ -108,3 +108,32 @@ def test_dtd_tiled_gemm_matches_numpy(ctx, rng):
     tp.wait()
     np.testing.assert_allclose(C.to_array(), Ah @ Bh + Ch,
                                rtol=1e-3, atol=1e-3)
+
+
+def test_dtd_same_tile_twice_in_one_insert(ctx):
+    """Passing the same tile as two arguments must not self-link (which
+    would deadlock); the second flow aliases the first."""
+    store = LocalCollection("s", {("x",): 5})
+    tp = dtd.Taskpool("dup")
+    ctx.add_taskpool(tp)
+    tp.insert_task(lambda x: x + 1, dtd.TileArg(store, ("x",), dtd.INOUT))
+    got = []
+
+    def body(a, b):
+        got.append((a, b))
+        return a + b
+    tp.insert_task(body, dtd.TileArg(store, ("x",), dtd.INOUT),
+                   dtd.TileArg(store, ("x",), dtd.INPUT))
+    tp.wait()
+    assert got == [(6, 6)]
+    assert store.data_of(("x",)) == 12
+
+
+def test_dtd_wait_twice_is_idempotent(ctx):
+    store = LocalCollection("s", {("x",): 0})
+    tp = dtd.Taskpool("w2")
+    ctx.add_taskpool(tp)
+    tp.insert_task(lambda x: x + 1, dtd.TileArg(store, ("x",), dtd.INOUT))
+    tp.wait()
+    tp.wait()          # second wait must join, not crash the counters
+    assert store.data_of(("x",)) == 1
