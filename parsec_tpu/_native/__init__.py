@@ -1,0 +1,118 @@
+"""Native C++ core loader.
+
+Builds ``core.cpp`` into a shared library with g++ on first use (cached
+next to the source, keyed by source mtime) and exposes it through ctypes.
+The Python runtime falls back to its pure-Python implementations when the
+toolchain is unavailable (``load() -> None``), so the package works
+everywhere; on a real deployment the native engine carries the
+dependency-tracking and static-DAG execution hot paths, mirroring the
+reference where those layers are native C (parsec/parsec.c,
+parsec/scheduling.c, parsec/class/*).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "core.cpp")
+_SO = os.path.join(_HERE, "libparsec_core.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+BODY_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_uint32, ctypes.c_int32)
+
+
+def _build() -> bool:
+    if os.path.exists(_SO) and \
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return True
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", _SO + ".tmp", _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(_SO + ".tmp", _SO)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u64, u32, i32, p = (ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int32,
+                        ctypes.c_void_p)
+    lib.pdep_new.restype = p
+    lib.pdep_free.argtypes = [p]
+    lib.pdep_size.argtypes = [p]
+    lib.pdep_size.restype = u64
+    lib.pdep_update.argtypes = [p, u64, u64, u32, ctypes.c_int, i32,
+                                ctypes.POINTER(i32)]
+    lib.pdep_update.restype = ctypes.c_int
+    lib.pdep_finalize.argtypes = [p, u64, u64, ctypes.c_int,
+                                  ctypes.POINTER(i32)]
+    lib.pdep_finalize.restype = ctypes.c_int
+    lib.plevel_kahn.argtypes = [u64, u64, ctypes.POINTER(u32),
+                                ctypes.POINTER(u32), ctypes.POINTER(i32)]
+    lib.plevel_kahn.restype = ctypes.c_int
+    lib.pgraph_new.argtypes = [u32, ctypes.POINTER(i32), ctypes.POINTER(i32),
+                               u64, ctypes.POINTER(u32), ctypes.POINTER(u32),
+                               BODY_FN, ctypes.c_int]
+    lib.pgraph_new.restype = p
+    lib.pgraph_free.argtypes = [p]
+    lib.pgraph_run.argtypes = [p]
+    lib.pgraph_run.restype = ctypes.c_int
+    lib.pgraph_remaining.argtypes = [p]
+    lib.pgraph_remaining.restype = u32
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, or None when it cannot be built/loaded."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("PARSEC_NO_NATIVE"):
+            return None
+        if not _build():
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(_SO))
+        except OSError:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def kahn_levels(n: int, edges) -> "Optional[list]":
+    """Batch-level a DAG natively; edges = iterable of (src, dst).
+    Returns per-task levels, or None if native is unavailable.
+    Raises RuntimeError on a cycle."""
+    import numpy as np
+    lib = load()
+    if lib is None:
+        return None
+    src = np.fromiter((e[0] for e in edges), dtype=np.uint32,
+                      count=len(edges))
+    dst = np.fromiter((e[1] for e in edges), dtype=np.uint32,
+                      count=len(edges))
+    out = np.zeros(n, dtype=np.int32)
+    rc = lib.plevel_kahn(
+        n, len(edges),
+        src.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        dst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc == -1:
+        raise RuntimeError("DAG has a cycle")
+    if rc != 0:
+        raise RuntimeError(f"plevel_kahn failed: {rc}")
+    return out.tolist()

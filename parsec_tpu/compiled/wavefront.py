@@ -101,22 +101,33 @@ def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
             edges.append((i, j, ref.flow_name))
             indeg[j] += 1
 
-    # ---- Kahn leveling
-    level = np.zeros(n, dtype=np.int64)
-    frontier = [i for i in range(n) if indeg[i] == 0]
-    seen = len(frontier)
-    while frontier:
-        nxt = []
-        for i in frontier:
-            for j in succs[i]:
-                level[j] = max(level[j], level[i] + 1)
-                indeg[j] -= 1
-                if indeg[j] == 0:
-                    nxt.append(j)
-                    seen += 1
-        frontier = nxt
-    if seen != n:
-        raise RuntimeError("PTG DAG has a cycle")
+    # ---- Kahn leveling (batched in the C++ core when available)
+    from .. import _native
+    native_levels = None
+    if n and _native.available():
+        try:
+            native_levels = _native.kahn_levels(
+                n, [(i, j) for (i, j, _f) in edges])
+        except RuntimeError as exc:
+            raise RuntimeError(f"PTG DAG has a cycle: {exc}") from exc
+    if native_levels is not None:
+        level = np.asarray(native_levels, dtype=np.int64)
+    else:
+        level = np.zeros(n, dtype=np.int64)
+        frontier = [i for i in range(n) if indeg[i] == 0]
+        seen = len(frontier)
+        while frontier:
+            nxt = []
+            for i in frontier:
+                for j in succs[i]:
+                    level[j] = max(level[j], level[i] + 1)
+                    indeg[j] -= 1
+                    if indeg[j] == 0:
+                        nxt.append(j)
+                        seen += 1
+            frontier = nxt
+        if seen != n:
+            raise RuntimeError("PTG DAG has a cycle")
 
     # ---- group into waves
     n_waves = int(level.max()) + 1 if n else 0

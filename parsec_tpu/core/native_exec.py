@@ -1,0 +1,158 @@
+"""Native static-DAG executor.
+
+Runs a PTG taskpool through the C++ engine (``parsec_tpu/_native``):
+dependency countdown, priority work-stealing queues and worker threads
+live in C++ (the role parsec/scheduling.c + mca/sched play in the
+reference, which are native C); Python is entered only to run task
+bodies. Bodies that call numpy/JAX release the GIL during their heavy
+work, so the C++ workers genuinely overlap.
+
+Value passing: each edge carries the producer flow's output to the
+consumer flow (the release-deps data attachment, parsec.c:1694-1780);
+collection-sourced inputs resolve through the class's data_lookup.
+Producer outputs are refcounted per consumer and dropped as soon as the
+last consumer ran.
+
+Use when the DAG is statically enumerable (always true for PTG). The
+dynamic paths (DTD insertion, multi-rank) use the host runtime; the
+compiled wavefront path replaces both when the whole DAG can become one
+XLA program.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .task import DeviceType, Task
+from .taskpool import DataRef
+from .. import _native
+
+
+class NativeDAGExecutor:
+    """Execute a PTG taskpool on the C++ engine."""
+
+    def __init__(self, tp, nworkers: int = 4,
+                 device_type: DeviceType = DeviceType.CPU):
+        lib = _native.load()
+        if lib is None:
+            raise RuntimeError("native core unavailable (no g++?)")
+        self.lib = lib
+        self.tp = tp
+        self.nworkers = max(1, nworkers)
+        self.device_type = device_type
+
+        # ---- enumerate the task space
+        self.tasks: List[Tuple[object, Tuple[int, ...]]] = []
+        tid: Dict[Tuple[str, Tuple], int] = {}
+        for tc in tp.task_classes:
+            for p in tc.enumerate_space():
+                tid[(tc.name, p)] = len(self.tasks)
+                self.tasks.append((tc, p))
+        n = len(self.tasks)
+
+        # ---- dry-run successor iterators to build the edge list
+        # edge: (src_tid, dst_tid, src_flow, dst_flow)
+        self.in_edges: List[List[Tuple[int, str, str]]] = [[] for _ in range(n)]
+        esrc, edst = [], []
+        self.nconsumers = np.zeros(n, dtype=np.int64)
+        for i, (tc, p) in enumerate(self.tasks):
+            dry = Task(tp, tc, p)
+            for f in tc.flows:
+                dry.data[f.name] = 0
+                dry.output[f.name] = 0
+            for ref in tc.iterate_successors(dry):
+                if isinstance(ref, DataRef):
+                    continue
+                j = tid[(ref.task_class.name, tuple(ref.locals))]
+                esrc.append(i)
+                edst.append(j)
+                self.in_edges[j].append((i, ref.src_flow, ref.flow_name))
+                self.nconsumers[i] += 1
+
+        ndeps = np.array([len(e) for e in self.in_edges], dtype=np.int32)
+        prio = np.array([tc.priority_fn(p) for tc, p in self.tasks],
+                        dtype=np.int32)
+        esrc = np.asarray(esrc, dtype=np.uint32)
+        edst = np.asarray(edst, dtype=np.uint32)
+
+        self._outputs: List[Optional[dict]] = [None] * n
+        self._pending_consumers = self.nconsumers.copy()
+        self._refcount_lock = threading.Lock()
+        self._error: Optional[BaseException] = None
+
+        self._body_cb = _native.BODY_FN(self._run_body)   # keep alive
+        self._g = lib.pgraph_new(
+            n, ndeps.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            prio.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            len(esrc),
+            esrc.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            edst.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            self._body_cb, self.nworkers)
+        if not self._g:
+            raise MemoryError("pgraph_new failed")
+        self.n_tasks = n
+
+    # ------------------------------------------------------------------
+    def _run_body(self, tid: int, worker: int) -> int:
+        try:
+            tc, p = self.tasks[tid]
+            task = Task(self.tp, tc, p)
+            for (i, src_flow, dst_flow) in self.in_edges[tid]:
+                out = self._outputs[i]
+                task.data[dst_flow] = None if out is None \
+                    else out.get(src_flow)
+            lookup = getattr(tc, "data_lookup", None)
+            if lookup is not None:
+                lookup(task)
+            chore = tc.chore_for(self.device_type) or \
+                tc.chore_for(DeviceType.ALL) or \
+                (tc.incarnations[0] if tc.incarnations else None)
+            if chore is None:
+                raise RuntimeError(f"no body for {tc.name}")
+            result = chore.hook(task, *task.input_values())
+            out_flows = tc.output_flows
+            if result is None:
+                outs = {}
+            elif isinstance(result, dict):
+                outs = result
+            elif isinstance(result, (tuple, list)):
+                outs = {f.name: v for f, v in zip(out_flows, result)}
+            else:
+                outs = {out_flows[0].name: result}
+            task.output.update(outs)
+            # terminal collection write-backs; successor activation is
+            # native (the engine counts down deps from the edge list)
+            for ref in tc.iterate_successors(task):
+                if isinstance(ref, DataRef):
+                    ref.collection.write_tile(ref.key, ref.value)
+            if self.nconsumers[tid]:
+                self._outputs[tid] = {f.name: task.output.get(
+                    f.name, task.data.get(f.name)) for f in tc.flows}
+            # drop predecessor outputs once their last consumer ran
+            with self._refcount_lock:
+                for (i, _sf, _df) in self.in_edges[tid]:
+                    self._pending_consumers[i] -= 1
+                    if self._pending_consumers[i] == 0:
+                        self._outputs[i] = None
+            return 0
+        except BaseException as exc:  # noqa: BLE001 — crossing the C ABI
+            self._error = exc
+            return 1
+
+    def run(self) -> None:
+        rc = self.lib.pgraph_run(self._g)
+        if rc == 1 and self._error is not None:
+            raise RuntimeError(
+                f"task body failed: {self._error}") from self._error
+        if rc != 0:
+            raise RuntimeError(f"native DAG execution failed (rc={rc})")
+
+    def __del__(self):
+        g = getattr(self, "_g", None)
+        if g:
+            self.lib.pgraph_free(g)
+            self._g = None

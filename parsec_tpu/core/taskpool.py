@@ -31,6 +31,11 @@ from ..utils.debug import debug_verbose
 DEPS_COUNTER = "counter"    # parsec_update_deps_with_counter (parsec.c:1554)
 DEPS_MASK = "mask"          # parsec_update_deps_with_mask (parsec.c:1601)
 
+from ..utils import mca_param as _mca_param
+_mca_param.register(
+    "runtime.native_deps", True,
+    help="use the C++ dependency table when the native core is available")
+
 
 @dataclass
 class SuccessorRef:
@@ -45,6 +50,7 @@ class SuccessorRef:
     value: Any = None                # payload (None for CTL deps)
     dep_index: int = 0               # input-dep bit for mask mode
     priority: int = 0
+    src_flow: Optional[str] = None   # producer's flow (planners/native exec)
 
 
 @dataclass
@@ -122,6 +128,13 @@ class _PendingDeps:
     Reference: parsec_hash_find_deps (parsec.c:1525) + update functions.
     Striped locks stand in for the reference's bucket-locked hash table
     (class/parsec_hash_table.c).
+
+    When the native core is available (parsec_tpu/_native), the
+    counter/mask accounting runs in the C++ dependency table (pdep_*) on
+    64-bit task keys — the same key model the reference uses
+    (parsec_key_t) — while input values stay Python-side under the stripe
+    locks. Each provider writes its value *before* counting, so whichever
+    provider completes the goal observes every value (mutex ordering).
     """
 
     _NSTRIPES = 64
@@ -129,15 +142,60 @@ class _PendingDeps:
     def __init__(self) -> None:
         self._entries: Dict[Any, Dict[str, Any]] = {}
         self._locks = [threading.Lock() for _ in range(self._NSTRIPES)]
-        self._global = threading.Lock()
+        self._native = None
+        self._native_lib = None
+        from ..utils import mca_param
+        if mca_param.get("runtime.native_deps", True):
+            from .. import _native
+            lib = _native.load()
+            if lib is not None:
+                self._native_lib = lib
+                self._native = lib.pdep_new()
+
+    def __del__(self):
+        if getattr(self, "_native", None):
+            self._native_lib.pdep_free(self._native)
+            self._native = None
 
     def _lock_for(self, key) -> threading.Lock:
         return self._locks[hash(key) % self._NSTRIPES]
+
+    @staticmethod
+    def _key64(key) -> int:
+        return hash(key) & 0xFFFFFFFFFFFFFFFF
+
+    def _pop_data(self, key, priority: int) -> Dict[str, Any]:
+        with self._lock_for(key):
+            ent = self._entries.pop(key, None)
+        if ent is None:
+            ent = {"data": {}, "priority": priority}
+        ent["priority"] = max(ent["priority"], priority)
+        return ent
 
     def update(self, key, flow_name: str, value: Any, dep_index: int,
                goal: int, mode: str, priority: int) -> Optional[Dict[str, Any]]:
         """Record one satisfied dep; return the entry if the goal is reached
         (caller then constructs and schedules the task)."""
+        if self._native is not None:
+            import ctypes
+            if value is not None:
+                with self._lock_for(key):
+                    ent = self._entries.get(key)
+                    if ent is None:
+                        ent = {"data": {}, "priority": priority}
+                        self._entries[key] = ent
+                    ent["data"][flow_name] = value
+            prio_out = ctypes.c_int32(priority)
+            rc = self._native_lib.pdep_update(
+                self._native, self._key64(key), goal, dep_index,
+                1 if mode == DEPS_MASK else 0, priority,
+                ctypes.byref(prio_out))
+            if rc == -1:
+                raise RuntimeError(
+                    f"dependency bit {dep_index} satisfied twice for {key}")
+            if rc == 1:
+                return self._pop_data(key, prio_out.value)
+            return None
         with self._lock_for(key):
             ent = self._entries.get(key)
             if ent is None:
@@ -165,6 +223,15 @@ class _PendingDeps:
         """For DSLs whose goal is only known after linking (DTD): check
         whether the already-accumulated count/mask meets the final goal;
         if so pop and return the entry."""
+        if self._native is not None:
+            import ctypes
+            prio_out = ctypes.c_int32(0)
+            rc = self._native_lib.pdep_finalize(
+                self._native, self._key64(key), goal,
+                1 if mode == DEPS_MASK else 0, ctypes.byref(prio_out))
+            if rc == 1:
+                return self._pop_data(key, prio_out.value)
+            return None
         with self._lock_for(key):
             ent = self._entries.get(key)
             if ent is None:
@@ -177,6 +244,8 @@ class _PendingDeps:
             return None
 
     def __len__(self) -> int:
+        if self._native is not None:
+            return int(self._native_lib.pdep_size(self._native))
         return len(self._entries)
 
 

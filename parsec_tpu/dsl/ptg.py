@@ -218,7 +218,8 @@ class PTGTaskClass(TaskClass):
                         task_class=dst_tc, locals=tgt, flow_name=dst_flow,
                         value=None if dst_bit_flow.is_ctl else value,
                         dep_index=dst_bit_flow.index,
-                        priority=dst_tc.priority_fn(tgt))
+                        priority=dst_tc.priority_fn(tgt),
+                        src_flow=f.name)
 
     # -- distribution -----------------------------------------------------
     def affinity_rank(self, locals) -> int:
