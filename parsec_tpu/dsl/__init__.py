@@ -7,4 +7,5 @@ above the core and only produce Taskpool/TaskClass structures.
 """
 
 from . import dtd
+from . import jdf
 from . import ptg

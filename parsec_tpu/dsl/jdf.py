@@ -1,0 +1,1018 @@
+"""JDF: the textual parameterized-task-graph language + compiler.
+
+Reference: the JDF language and the ``parsec_ptgpp`` source-to-source
+compiler (parsec/interfaces/ptg/ptg-compiler/: lexer parsec.l, grammar
+parsec.y, AST jdf.h:117-365, sanity checks jdf.c, code generator jdf2c.c
+8,636 LoC). The reference compiles ``.jdf`` → C implementing the task-class
+vtable. Here the same language (Python expressions instead of inline C)
+compiles directly to :mod:`parsec_tpu.dsl.ptg` closures — the "generated
+code" is a set of lambdas over the taskpool globals, preserving PTG's key
+property of closed-form O(1) dependency discovery.
+
+Language surface (mirrors the reference; Python expressions)::
+
+    extern "python" %{
+    from parsec_tpu.ops.tile_kernels import potrf_tile
+    %}
+
+    NT  [ type = int ]
+    A   [ type = tiled_matrix ]
+
+    POTRF(k)                      // task class: name(parameters)
+      k = 0 .. NT-1               // parameter range (inclusive, JDF-style)
+      h = k + 1                   // derived local
+      : A(k, k)                   // partitioning / affinity predicate
+      RW T <- (k == 0) ? A(k, k) : C SYRK(k, k-1)
+           -> L TRSM(k+1 .. NT-1, k)
+           -> A(k, k)
+      ; (NT - k) ** 2             // priority expression
+    BODY [ type = tpu ]
+      T = potrf_tile(T)
+    END
+
+Dependency targets: ``FLOW Class(args)`` (task dep), ``Collection(args)``
+(memory dep), ``NULL`` (no dep), ``NEW(expr)`` (fresh value). ``->`` args
+may contain inclusive ranges ``lo .. hi [.. step]`` (Cartesian product).
+Guards are ``(expr) ?`` with an optional ``:`` else-branch. Bodies are
+Python: flow names are bound to input values; after execution the WRITE
+flow names are read back as the outputs. Properties ``[ k = v ... ]`` are
+retained on globals, task classes, deps and bodies (e.g. the reference's
+``type_remote`` reshape hints ride along for the reshape engine).
+"""
+
+from __future__ import annotations
+
+import itertools
+import re
+import textwrap
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..core.task import DeviceType
+from . import ptg
+
+# Structural limits (reference parsec_config_bottom.h:159-163)
+MAX_LOCAL_COUNT = 20
+MAX_PARAM_COUNT = 20
+MAX_DEP_IN_COUNT = 10
+MAX_DEP_OUT_COUNT = 10
+
+
+class JDFSyntaxError(SyntaxError):
+    """Lex/parse error with source position."""
+
+    def __init__(self, msg: str, line: int, col: int = 0):
+        super().__init__(f"JDF:{line}:{col}: {msg}")
+        self.line = line
+        self.col = col
+
+
+class JDFSemanticError(ValueError):
+    """Post-parse sanity-check failure (reference jdf_sanity_checks)."""
+
+
+# --------------------------------------------------------------------- lexer
+
+_TOKEN_RE = re.compile(r"""
+    (?P<WS>[ \t\r]+)
+  | (?P<COMMENT>//[^\n]*|\#[^\n]*)
+  | (?P<CCOMMENT>/\*.*?\*/)
+  | (?P<NL>\n)
+  | (?P<VERBATIM>%\{.*?%\})
+  | (?P<RANGE>\.\.)
+  | (?P<ARROW_IN><-)
+  | (?P<ARROW_OUT>->)
+  | (?P<NUMBER>\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)
+  | (?P<STRING>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
+  | (?P<IDENT>[A-Za-z_][A-Za-z_0-9]*)
+  | (?P<OP>\*\*|==|!=|<=|>=|//|&&|\|\||[-+*/%%<>=?:,()\[\]{}.!&|^~@;])
+""", re.VERBOSE | re.DOTALL)
+
+
+@dataclass
+class Tok:
+    kind: str
+    text: str
+    line: int
+    col: int
+    pos: int          # char offset of token start in source
+
+
+def tokenize(source: str) -> List[Tok]:
+    toks: List[Tok] = []
+    i, line, bol = 0, 1, 0
+    n = len(source)
+    while i < n:
+        m = _TOKEN_RE.match(source, i)
+        if m is None:
+            raise JDFSyntaxError(f"unexpected character {source[i]!r}",
+                                 line, i - bol + 1)
+        kind = m.lastgroup
+        text = m.group()
+        if kind not in ("WS", "COMMENT", "CCOMMENT"):
+            toks.append(Tok(kind, text, line, i - bol + 1, i))
+        nl = text.count("\n")
+        if nl:
+            line += nl
+            bol = i + text.rfind("\n") + 1
+        i = m.end()
+        # Raw-capture a BODY block: after `BODY [props] \n` everything up
+        # to a line consisting of END is body code, not JDF tokens.
+        if kind == "IDENT" and text == "BODY":
+            # consume props + the rest of the BODY line normally
+            j = i
+            depth = 0
+            while j < n and (source[j] != "\n" or depth > 0):
+                if source[j] == "[":
+                    depth += 1
+                elif source[j] == "]":
+                    depth -= 1
+                j += 1
+            # tokenize the props segment through the main regex
+            seg = source[i:j]
+            off = i
+            while off < j:
+                sm = _TOKEN_RE.match(source, off)
+                if sm is None:
+                    raise JDFSyntaxError("bad BODY properties", line, 1)
+                if sm.lastgroup not in ("WS", "COMMENT", "CCOMMENT"):
+                    toks.append(Tok(sm.lastgroup, sm.group(), line,
+                                    off - bol + 1, off))
+                off = sm.end()
+            i = j
+            # find the END line
+            em = re.compile(r"^[ \t]*END[ \t]*$", re.M).search(source, i)
+            if em is None:
+                raise JDFSyntaxError("BODY without END", line, 1)
+            code = source[i:em.start()]
+            toks.append(Tok("BODYCODE", code, line + 1, 1, i))
+            line += source.count("\n", i, em.end())
+            i = em.end()
+            bol = i
+            toks.append(Tok("NL", "\n", line, 1, i))
+            continue
+    toks.append(Tok("EOF", "", line, 1, n))
+    return toks
+
+
+# ----------------------------------------------------------------------- AST
+# (reference jdf.h:117-365: jdf_t / jdf_function_entry_t / jdf_dataflow /
+#  jdf_dep / jdf_guarded_call)
+
+@dataclass
+class Expr:
+    """A Python expression captured from the source, compiled lazily."""
+    text: str
+    line: int = 0
+    _code: Any = None
+
+    def code(self):
+        if self._code is None:
+            try:
+                self._code = compile(self.text.strip(), f"<jdf:{self.line}>",
+                                     "eval")
+            except SyntaxError as exc:
+                raise JDFSemanticError(
+                    f"JDF:{self.line}: bad expression {self.text!r}: {exc}")
+        return self._code
+
+    def __repr__(self):
+        return f"Expr({self.text.strip()!r})"
+
+
+@dataclass
+class CallRef:
+    """``name(args)`` — a task-class or collection reference. Each arg is
+    an Expr or a (lo, hi, step) range triple of Exprs (ranged -> deps)."""
+    name: str
+    args: List[Any]
+    flow: Optional[str] = None      # set for task deps: FLOW Class(args)
+    line: int = 0
+
+    @property
+    def is_task_ref(self) -> bool:
+        return self.flow is not None
+
+
+@dataclass
+class DepTarget:
+    """One side of a dependency: a call ref, NEW(expr), or NULL."""
+    call: Optional[CallRef] = None
+    new: Optional[Expr] = None
+    is_null: bool = False
+
+
+@dataclass
+class JdfDep:
+    """A guarded dependency of a flow (jdf_dep / jdf_guarded_call)."""
+    direction: str                   # "in" | "out"
+    guard: Optional[Expr]
+    then: DepTarget
+    otherwise: Optional[DepTarget]   # the ':' branch of a ternary guard
+    props: Dict[str, Expr] = field(default_factory=dict)
+    line: int = 0
+
+
+@dataclass
+class JdfFlow:
+    name: str
+    access: str                      # RW | READ | WRITE | CTL
+    deps: List[JdfDep] = field(default_factory=list)
+    props: Dict[str, Expr] = field(default_factory=dict)
+
+
+@dataclass
+class JdfBody:
+    code: str
+    props: Dict[str, Expr] = field(default_factory=dict)
+    line: int = 0
+
+
+@dataclass
+class JdfLocal:
+    name: str
+    # either a range (lo, hi, step Exprs) for parameters, or a value Expr
+    range: Optional[Tuple[Expr, Expr, Optional[Expr]]] = None
+    value: Optional[Expr] = None
+    line: int = 0
+
+
+@dataclass
+class JdfTaskClass:
+    name: str
+    params: List[str]
+    locals: List[JdfLocal] = field(default_factory=list)
+    partitioning: Optional[CallRef] = None
+    flows: List[JdfFlow] = field(default_factory=list)
+    priority: Optional[Expr] = None
+    bodies: List[JdfBody] = field(default_factory=list)
+    props: Dict[str, Expr] = field(default_factory=dict)
+    line: int = 0
+
+
+@dataclass
+class JdfGlobal:
+    name: str
+    props: Dict[str, Expr] = field(default_factory=dict)
+    line: int = 0
+
+
+@dataclass
+class JdfFile:
+    prologues: List[str] = field(default_factory=list)
+    globals: List[JdfGlobal] = field(default_factory=list)
+    task_classes: List[JdfTaskClass] = field(default_factory=list)
+
+
+# -------------------------------------------------------------------- parser
+
+_ACCESS_KW = ("RW", "READ", "WRITE", "CTL")
+
+
+class _Parser:
+    def __init__(self, source: str):
+        self.src = source
+        self.toks = tokenize(source)
+        self.i = 0
+
+    # -- token helpers ----------------------------------------------------
+    def peek(self, k: int = 0) -> Tok:
+        return self.toks[min(self.i + k, len(self.toks) - 1)]
+
+    def next(self) -> Tok:
+        t = self.toks[self.i]
+        if t.kind != "EOF":
+            self.i += 1
+        return t
+
+    def skip_nl(self):
+        while self.peek().kind == "NL":
+            self.next()
+
+    def expect(self, kind: str, text: Optional[str] = None) -> Tok:
+        t = self.next()
+        if t.kind != kind or (text is not None and t.text != text):
+            want = text or kind
+            raise JDFSyntaxError(f"expected {want!r}, got {t.text!r}",
+                                 t.line, t.col)
+        return t
+
+    def at(self, kind: str, text: Optional[str] = None, k: int = 0) -> bool:
+        t = self.peek(k)
+        return t.kind == kind and (text is None or t.text == text)
+
+    # -- expression capture ----------------------------------------------
+    def capture_expr(self, stop: Sequence[str], stop_nl: bool = False,
+                     allow_empty: bool = False) -> Expr:
+        """Capture source text of one expression: tokens until a stop
+        OP/RANGE at bracket depth 0 (or newline when stop_nl)."""
+        start_tok = self.peek()
+        depth = 0
+        start = start_tok.pos
+        end = start
+        while True:
+            t = self.peek()
+            if t.kind == "EOF":
+                break
+            if t.kind == "NL":
+                if stop_nl and depth == 0:
+                    break
+                self.next()
+                continue
+            if depth == 0 and (t.text in stop or t.kind in stop):
+                break
+            if t.kind == "VERBATIM":
+                # inline %{ return expr; %} — splice as a Python expression
+                inner = t.text[2:-2].strip()
+                inner = re.sub(r"^return\s+", "", inner).rstrip("; \t\n")
+                self.next()
+                text = self.src[start:t.pos] + f"({inner})"
+                # continue capture after the verbatim with rebuilt text
+                # (an expression may END at the verbatim → empty suffix)
+                rest = self.capture_expr(stop, stop_nl, allow_empty=True)
+                return Expr(text + rest.text, start_tok.line)
+            if t.text in "([{":
+                depth += 1
+            elif t.text in ")]}":
+                if depth == 0:
+                    break
+                depth -= 1
+            end = t.pos + len(t.text)
+            self.next()
+        text = self.src[start:end]
+        if not text.strip():
+            if allow_empty:
+                return Expr("", start_tok.line)
+            t = self.peek()
+            raise JDFSyntaxError("expected expression", t.line, t.col)
+        return Expr(text, start_tok.line)
+
+    def capture_range_or_expr(self, stop: Sequence[str],
+                              stop_nl: bool = False):
+        """expr | expr .. expr [.. expr] — returns Expr or a range triple."""
+        stop2 = list(stop) + ["RANGE"]
+        e1 = self.capture_expr(stop2, stop_nl)
+        if not self.at("RANGE"):
+            return e1
+        self.next()
+        e2 = self.capture_expr(stop2, stop_nl)
+        step = None
+        if self.at("RANGE"):
+            self.next()
+            step = self.capture_expr(stop, stop_nl)
+        return (e1, e2, step)
+
+    # -- properties block -------------------------------------------------
+    def parse_props(self) -> Dict[str, Expr]:
+        """``[ key = expr key = expr ... ]``"""
+        props: Dict[str, Expr] = {}
+        if not self.at("OP", "["):
+            return props
+        self.next()
+        self.skip_nl()
+        while not self.at("OP", "]"):
+            key = self.expect("IDENT").text
+            self.expect("OP", "=")
+            # value ends at ']' or at the start of the next `ident =` pair
+            start_tok = self.peek()
+            depth = 0
+            start = start_tok.pos
+            end = start
+            while True:
+                t = self.peek()
+                if t.kind == "EOF":
+                    raise JDFSyntaxError("unterminated properties", t.line,
+                                         t.col)
+                if t.kind == "NL":
+                    self.next()
+                    continue
+                if depth == 0 and t.text == "]":
+                    break
+                if depth == 0 and t.kind == "IDENT" and \
+                        self.at("OP", "=", 1):
+                    break
+                if t.text in "([{":
+                    depth += 1
+                elif t.text in ")]}":
+                    depth -= 1
+                end = t.pos + len(t.text)
+                self.next()
+            props[key] = Expr(self.src[start:end], start_tok.line)
+            self.skip_nl()
+        self.expect("OP", "]")
+        return props
+
+    # -- top level --------------------------------------------------------
+    def parse(self) -> JdfFile:
+        jdf = JdfFile()
+        while True:
+            self.skip_nl()
+            t = self.peek()
+            if t.kind == "EOF":
+                break
+            if t.kind == "VERBATIM":
+                jdf.prologues.append(t.text[2:-2])
+                self.next()
+                continue
+            if t.kind == "IDENT" and t.text == "extern":
+                # extern "python" %{ ... %}
+                self.next()
+                if self.peek().kind == "STRING":
+                    self.next()
+                v = self.expect("VERBATIM")
+                jdf.prologues.append(v.text[2:-2])
+                continue
+            if t.kind != "IDENT":
+                raise JDFSyntaxError(f"unexpected {t.text!r}", t.line, t.col)
+            # IDENT '(' → task class; otherwise a global declaration
+            if self.at("OP", "(", 1):
+                jdf.task_classes.append(self.parse_task_class())
+            else:
+                name = self.next().text
+                props = self.parse_props()
+                jdf.globals.append(JdfGlobal(name, props, t.line))
+        return jdf
+
+    def parse_task_class(self) -> JdfTaskClass:
+        name_tok = self.expect("IDENT")
+        tc = JdfTaskClass(name_tok.text, [], line=name_tok.line)
+        self.expect("OP", "(")
+        while not self.at("OP", ")"):
+            tc.params.append(self.expect("IDENT").text)
+            if self.at("OP", ","):
+                self.next()
+        self.expect("OP", ")")
+        tc.props = self.parse_props()
+        self.skip_nl()
+        # locals: IDENT = range-or-expr (newline terminated)
+        while self.at("IDENT") and self.at("OP", "=", 1) and \
+                self.peek().text not in _ACCESS_KW:
+            ltok = self.next()
+            self.expect("OP", "=")
+            r = self.capture_range_or_expr(stop=(), stop_nl=True)
+            if isinstance(r, tuple):
+                tc.locals.append(JdfLocal(ltok.text, range=r, line=ltok.line))
+            else:
+                tc.locals.append(JdfLocal(ltok.text, value=r, line=ltok.line))
+            self.skip_nl()
+        # partitioning: ': A(k, n)'
+        if self.at("OP", ":"):
+            self.next()
+            tc.partitioning = self.parse_call_ref(allow_flow=False,
+                                                  allow_range=False)
+            self.skip_nl()
+        # flows
+        while self.at("IDENT") and self.peek().text in _ACCESS_KW:
+            tc.flows.append(self.parse_flow())
+            self.skip_nl()
+        # priority: '; expr'
+        if self.at("OP", ";"):
+            self.next()
+            tc.priority = self.capture_expr(stop=(), stop_nl=True)
+            self.skip_nl()
+        # bodies
+        while self.at("IDENT", "BODY"):
+            btok = self.next()
+            props = self.parse_props()
+            code_tok = self.expect("BODYCODE")
+            tc.bodies.append(JdfBody(textwrap.dedent(code_tok.text),
+                                     props, btok.line))
+            self.skip_nl()
+        if not tc.bodies:
+            raise JDFSyntaxError(f"task class {tc.name} has no BODY",
+                                 name_tok.line, name_tok.col)
+        return tc
+
+    def parse_flow(self) -> JdfFlow:
+        access = self.next().text
+        fname = self.expect("IDENT").text
+        flow = JdfFlow(fname, access)
+        flow.props = self.parse_props()
+        self.skip_nl()
+        while self.at("ARROW_IN") or self.at("ARROW_OUT"):
+            flow.deps.append(self.parse_dep())
+            self.skip_nl()
+        return flow
+
+    def parse_dep(self) -> JdfDep:
+        t = self.next()
+        direction = "in" if t.kind == "ARROW_IN" else "out"
+        guard = None
+        then = otherwise = None
+        if self.at("OP", "("):
+            # '(' expr ')' '?' then [':' else]
+            self.next()
+            guard = self.capture_expr(stop=(")",))
+            self.expect("OP", ")")
+            self.expect("OP", "?")
+            then = self.parse_target(direction)
+            if self.at("OP", ":"):
+                self.next()
+                otherwise = self.parse_target(direction)
+        else:
+            then = self.parse_target(direction)
+        props = self.parse_props()
+        return JdfDep(direction, guard, then, otherwise, props, t.line)
+
+    def parse_target(self, direction: str) -> DepTarget:
+        t = self.peek()
+        if t.kind == "IDENT" and t.text == "NULL":
+            self.next()
+            return DepTarget(is_null=True)
+        if t.kind == "IDENT" and t.text == "NEW":
+            self.next()
+            self.expect("OP", "(")
+            e = self.capture_expr(stop=(")",))
+            self.expect("OP", ")")
+            return DepTarget(new=e)
+        call = self.parse_call_ref(allow_flow=True,
+                                   allow_range=(direction == "out"))
+        return DepTarget(call=call)
+
+    def parse_call_ref(self, allow_flow: bool,
+                       allow_range: bool) -> CallRef:
+        id1 = self.expect("IDENT")
+        flow = None
+        name = id1.text
+        if allow_flow and self.at("IDENT"):
+            flow = id1.text
+            name = self.next().text
+        self.expect("OP", "(")
+        args: List[Any] = []
+        while not self.at("OP", ")"):
+            if allow_range:
+                args.append(self.capture_range_or_expr(stop=(",", ")")))
+            else:
+                args.append(self.capture_expr(stop=(",", ")")))
+            if self.at("OP", ","):
+                self.next()
+        self.expect("OP", ")")
+        return CallRef(name, args, flow, id1.line)
+
+
+def parse(source: str) -> JdfFile:
+    """Parse JDF text to the AST (reference parsec.y analog)."""
+    jdf = _Parser(source).parse()
+    _sanity_check(jdf)
+    return jdf
+
+
+# ------------------------------------------------------- sanity (jdf.c analog)
+
+def _sanity_check(jdf: JdfFile) -> None:
+    gnames = set()
+    for g in jdf.globals:
+        if g.name in gnames:
+            raise JDFSemanticError(f"duplicate global {g.name!r}")
+        gnames.add(g.name)
+    class_names = set()
+    for tc in jdf.task_classes:
+        if tc.name in class_names:
+            raise JDFSemanticError(f"duplicate task class {tc.name!r}")
+        class_names.add(tc.name)
+    flows_of = {tc.name: {f.name for f in tc.flows} for tc in jdf.task_classes}
+    for tc in jdf.task_classes:
+        if len(tc.params) > MAX_PARAM_COUNT:
+            raise JDFSemanticError(
+                f"{tc.name}: {len(tc.params)} parameters exceeds "
+                f"MAX_PARAM_COUNT={MAX_PARAM_COUNT}")
+        if len(tc.locals) > MAX_LOCAL_COUNT:
+            raise JDFSemanticError(
+                f"{tc.name}: {len(tc.locals)} locals exceeds "
+                f"MAX_LOCAL_COUNT={MAX_LOCAL_COUNT}")
+        local_names = [l.name for l in tc.locals]
+        if len(set(local_names)) != len(local_names):
+            raise JDFSemanticError(f"{tc.name}: duplicate local definition")
+        ranged = {l.name for l in tc.locals if l.range is not None}
+        for p in tc.params:
+            if p not in ranged:
+                raise JDFSemanticError(
+                    f"{tc.name}: parameter {p!r} has no range definition")
+        extra = ranged - set(tc.params)
+        if extra:
+            raise JDFSemanticError(
+                f"{tc.name}: ranged locals {sorted(extra)} are not "
+                f"parameters")
+        fnames = set()
+        for f in tc.flows:
+            if f.name in fnames:
+                raise JDFSemanticError(
+                    f"{tc.name}: duplicate flow {f.name!r}")
+            fnames.add(f.name)
+            n_in = sum(1 for d in f.deps if d.direction == "in")
+            n_out = sum(1 for d in f.deps if d.direction == "out")
+            if n_in > MAX_DEP_IN_COUNT:
+                raise JDFSemanticError(
+                    f"{tc.name}.{f.name}: {n_in} input deps exceeds "
+                    f"MAX_DEP_IN_COUNT={MAX_DEP_IN_COUNT}")
+            if n_out > MAX_DEP_OUT_COUNT:
+                raise JDFSemanticError(
+                    f"{tc.name}.{f.name}: {n_out} output deps exceeds "
+                    f"MAX_DEP_OUT_COUNT={MAX_DEP_OUT_COUNT}")
+            if f.access == "READ" and n_in == 0:
+                raise JDFSemanticError(
+                    f"{tc.name}.{f.name}: READ flow with no input dep")
+            for d in f.deps:
+                for target in (d.then, d.otherwise):
+                    if target is None or target.call is None:
+                        continue
+                    c = target.call
+                    if c.is_task_ref:
+                        if c.name not in class_names:
+                            raise JDFSemanticError(
+                                f"{tc.name}.{f.name}: unknown task class "
+                                f"{c.name!r}")
+                        if c.flow not in flows_of[c.name]:
+                            raise JDFSemanticError(
+                                f"{tc.name}.{f.name}: task class {c.name} "
+                                f"has no flow {c.flow!r}")
+                        n_params = len(
+                            next(t for t in jdf.task_classes
+                                 if t.name == c.name).params)
+                        if len(c.args) != n_params:
+                            raise JDFSemanticError(
+                                f"{tc.name}.{f.name}: {c.name} takes "
+                                f"{n_params} parameters, got {len(c.args)}")
+                    elif c.name not in gnames:
+                        raise JDFSemanticError(
+                            f"{tc.name}.{f.name}: unknown collection "
+                            f"{c.name!r} (not a declared global)")
+                    elif any(isinstance(a, tuple) for a in c.args):
+                        raise JDFSemanticError(
+                            f"{tc.name}.{f.name}: ranged arguments are "
+                            f"only allowed on task references, not on "
+                            f"collection {c.name!r}")
+        if tc.partitioning is not None and \
+                tc.partitioning.name not in gnames:
+            raise JDFSemanticError(
+                f"{tc.name}: partitioning references unknown collection "
+                f"{tc.partitioning.name!r}")
+
+
+# ------------------------------------------------------------------- codegen
+# (jdf2c.c analog: emit the task-class vtable as closures over globals)
+
+_SAFE_BUILTINS = {
+    "min": min, "max": max, "abs": abs, "range": range, "len": len,
+    "int": int, "float": float, "bool": bool, "sum": sum, "divmod": divmod,
+    "round": round, "tuple": tuple, "list": list, "enumerate": enumerate,
+    "zip": zip, "print": print, "True": True, "False": False, "None": None,
+}
+
+
+class _Env:
+    """Per-task-class expression evaluation: params + derived locals over
+    the taskpool globals and prologue namespace, memoized per instance."""
+
+    def __init__(self, tc: JdfTaskClass, ns: Dict[str, Any]):
+        self.tc = tc
+        self.ns = ns                # globals + prologue names
+        self._cache: Dict[Tuple, Dict[str, Any]] = {}
+
+    def env(self, params: Tuple[int, ...]) -> Dict[str, Any]:
+        hit = self._cache.get(params)
+        if hit is not None:
+            return hit
+        env = dict(self.ns)
+        env.update(zip(self.tc.params, params))
+        for l in self.tc.locals:
+            if l.value is not None:
+                env[l.name] = eval(l.value.code(), env)
+        if len(self._cache) > 65536:
+            self._cache.clear()
+        self._cache[params] = env
+        return env
+
+    def eval(self, expr: Expr, params: Tuple[int, ...]) -> Any:
+        return eval(expr.code(), self.env(params))
+
+
+def _range_values(env: Dict[str, Any], rng) -> Iterable[int]:
+    lo = eval(rng[0].code(), env)
+    hi = eval(rng[1].code(), env)
+    step = eval(rng[2].code(), env) if rng[2] else 1
+    return range(int(lo), int(hi) + (1 if step > 0 else -1), int(step))
+
+
+def _expand_args(ev: _Env, call: CallRef, params: Tuple[int, ...]):
+    """Expand a -> target's args: Cartesian product over ranged args."""
+    env = ev.env(params)
+    dims: List[List[int]] = []
+    for a in call.args:
+        if isinstance(a, tuple):
+            dims.append(list(_range_values(env, a)))
+        else:
+            dims.append([eval(a.code(), env)])
+    return [tuple(c) for c in itertools.product(*dims)]
+
+
+_DEVICE_NAMES = {
+    "tpu": DeviceType.TPU, "cpu": DeviceType.CPU,
+    "recursive": DeviceType.RECURSIVE, "all": DeviceType.ALL,
+    # reference BODY [type=CUDA] — accelerator body maps to the TPU device
+    "cuda": DeviceType.TPU, "gpu": DeviceType.TPU,
+}
+
+
+class CompiledJDF:
+    """The compiled form: builds :class:`ptg.Taskpool` instances bound to
+    concrete global values (the ``parsec_<name>_new`` constructor analog,
+    jdf2c.c:4483-4798)."""
+
+    def __init__(self, ast: JdfFile, name: str = "jdf"):
+        self.ast = ast
+        self.name = name
+
+    # -- constructor ------------------------------------------------------
+    def taskpool(self, **global_values) -> ptg.Taskpool:
+        declared = {g.name for g in self.ast.globals}
+        ns: Dict[str, Any] = dict(_SAFE_BUILTINS)
+        for g in self.ast.globals:
+            if g.name in global_values:
+                ns[g.name] = global_values[g.name]
+            elif "default" in g.props:
+                ns[g.name] = eval(g.props["default"].code(), dict(ns))
+            else:
+                raise JDFSemanticError(
+                    f"global {g.name!r} not provided and has no default")
+        unknown = set(global_values) - declared
+        if unknown:
+            raise JDFSemanticError(f"unknown globals: {sorted(unknown)}")
+        # prologue: Python exec'd with the globals visible (the reference
+        # inlines `extern "C" %{...%}` verbatim into the generated C)
+        for code in self.ast.prologues:
+            exec(compile(textwrap.dedent(code), "<jdf-prologue>", "exec"), ns)
+
+        tp = ptg.Taskpool(self.name, **{g.name: ns[g.name]
+                                        for g in self.ast.globals})
+        envs: Dict[str, _Env] = {}
+        for tc_ast in self.ast.task_classes:
+            envs[tc_ast.name] = _Env(tc_ast, ns)
+            tp.task_class(
+                tc_ast.name, params=tuple(tc_ast.params),
+                space=self._make_space(tc_ast, envs[tc_ast.name]),
+                flows=self._make_flows(tc_ast, envs[tc_ast.name], tp),
+                affinity=self._make_affinity(tc_ast, envs[tc_ast.name]),
+                priority=self._make_priority(tc_ast, envs[tc_ast.name]))
+        for tc_ast in self.ast.task_classes:
+            ptc = tp.task_class_by_name(tc_ast.name)
+            for b in tc_ast.bodies:
+                self._attach_body(ptc, tc_ast, b, envs[tc_ast.name])
+        return tp
+
+    # -- space (startup-task enumerator analog, jdf2c.c:2989) -------------
+    def _make_space(self, tc: JdfTaskClass, ev: _Env):
+        # Walk the locals in declaration order: ranged locals (= the
+        # parameters) are loop dimensions; derived locals are evaluated
+        # into the environment so later ranges can use them (reference
+        # stencil_1D.jdf: `m = t %% lmt` between the t and n ranges).
+        order = list(tc.locals)
+        params = tc.params
+
+        def space(g):
+            def rec(i, env):
+                if i == len(order):
+                    yield tuple(env[p] for p in params)
+                    return
+                l = order[i]
+                if l.range is not None:
+                    for v in _range_values(env, l.range):
+                        env2 = dict(env)
+                        env2[l.name] = v
+                        yield from rec(i + 1, env2)
+                else:
+                    env2 = dict(env)
+                    env2[l.name] = eval(l.value.code(), env2)
+                    yield from rec(i + 1, env2)
+            yield from rec(0, dict(ev.ns))
+        return space
+
+    def _make_affinity(self, tc: JdfTaskClass, ev: _Env):
+        part = tc.partitioning
+        if part is None:
+            return None
+
+        def affinity(g, *p):
+            env = ev.env(p)
+            dc = env[part.name]
+            key = tuple(eval(a.code(), env)
+                        for a in part.args)
+            return dc, key
+        return affinity
+
+    def _make_priority(self, tc: JdfTaskClass, ev: _Env):
+        if tc.priority is None:
+            return None
+        return lambda g, *p: int(ev.eval(tc.priority, p))
+
+    # -- flows -------------------------------------------------------------
+    def _make_flows(self, tc: JdfTaskClass, ev: _Env, tp) -> List[ptg.FlowSpec]:
+        access_map = {"RW": ptg.RW, "READ": ptg.READ,
+                      "WRITE": ptg.WRITE, "CTL": ptg.CTL}
+        specs = []
+        for f in tc.flows:
+            ins: List[ptg.In] = []
+            outs: List[ptg.Out] = []
+            tile_fn = None
+            for d in f.deps:
+                branches = [(d.guard, d.then, False)]
+                if d.otherwise is not None:
+                    branches.append((d.guard, d.otherwise, True))
+                for guard_e, target, negate in branches:
+                    gfn = self._guard_fn(ev, guard_e, negate)
+                    if target.is_null:
+                        continue
+                    if d.direction == "in":
+                        ins.append(self._make_in(ev, tp, target, gfn, d))
+                    else:
+                        outs.append(self._make_out(ev, tp, target, gfn, d))
+                    c = target.call
+                    if tile_fn is None and c is not None and \
+                            not c.is_task_ref:
+                        tile_fn = self._data_fn(ev, c)
+                if "tile" in d.props:
+                    tile_fn = self._tile_prop_fn(ev, d.props["tile"])
+            if "tile" in f.props:
+                tile_fn = self._tile_prop_fn(ev, f.props["tile"])
+            specs.append(ptg.FlowSpec(f.name, access_map[f.access],
+                                      ins=ins, outs=outs, tile=tile_fn))
+        return specs
+
+    def _guard_fn(self, ev: _Env, guard: Optional[Expr], negate: bool):
+        if guard is None:
+            return None
+        if negate:
+            return lambda g, *p: not bool(ev.eval(guard, p))
+        return lambda g, *p: bool(ev.eval(guard, p))
+
+    def _data_fn(self, ev: _Env, call: CallRef):
+        def data(g, *p):
+            env = ev.env(p)
+            dc = env[call.name]
+            key = tuple(eval(a.code(), env)
+                        for a in call.args)
+            return dc, key
+        return data
+
+    def _tile_prop_fn(self, ev: _Env, expr: Expr):
+        # property value is `A(k, k)`-shaped: reparse as a call ref
+        sub = _Parser(expr.text.strip())
+        call = sub.parse_call_ref(allow_flow=False, allow_range=False)
+        return self._data_fn(ev, call)
+
+    def _make_in(self, ev: _Env, tp, target: DepTarget, gfn, dep: JdfDep):
+        if target.new is not None:
+            e = target.new
+            return ptg.In(new=lambda g, *p: ev.eval(e, p), guard=gfn)
+        c = target.call
+        if c.is_task_ref:
+            def params_fn(g, *p, _c=c):
+                env = ev.env(p)
+                return tuple(eval(a.code(), env)
+                             for a in _c.args)
+            return ptg.In(src=(c.name, params_fn, c.flow), guard=gfn)
+        return ptg.In(data=self._data_fn(ev, c), guard=gfn)
+
+    def _make_out(self, ev: _Env, tp, target: DepTarget, gfn, dep: JdfDep):
+        c = target.call
+        if c is None:
+            raise JDFSemanticError("NEW is not a valid -> target")
+        if c.is_task_ref:
+            ranged = any(isinstance(a, tuple) for a in c.args)
+            if ranged:
+                params_fn = lambda g, *p, _c=c: _expand_args(ev, _c, p)
+            else:
+                def params_fn(g, *p, _c=c):
+                    env = ev.env(p)
+                    return tuple(eval(a.code(), env)
+                                 for a in _c.args)
+            return ptg.Out(dst=(c.name, params_fn, c.flow), guard=gfn)
+        return ptg.Out(data=self._data_fn(ev, c), guard=gfn)
+
+    # -- bodies (jdf_generate_code_hook analog, jdf2c.c:6913) --------------
+    def _attach_body(self, ptc: ptg.PTGTaskClass, tc: JdfTaskClass,
+                     body: JdfBody, ev: _Env):
+        device = DeviceType.ALL
+        if "type" in body.props:
+            dname = body.props["type"].text.strip().strip("\"'").lower()
+            if dname not in _DEVICE_NAMES:
+                raise JDFSemanticError(
+                    f"{tc.name}: unknown BODY type {dname!r}")
+            device = _DEVICE_NAMES[dname]
+        code = compile(body.code or "pass", f"<jdf-body:{tc.name}>", "exec")
+        in_flows = [f.name for f in ptc.flows if not f.is_ctl]
+        out_flows = [f.name for f in ptc.output_flows]
+        # A body that references no params/locals is shape-uniform across
+        # the class → batchable (vmap) on the compiled executors.
+        def _code_names(c):
+            names = set(c.co_names) | set(c.co_freevars)
+            for const in c.co_consts:
+                if hasattr(const, "co_names"):
+                    names |= _code_names(const)
+            return names
+        uses_instance = bool(_code_names(code) &
+                             (set(tc.params) | {l.name for l in tc.locals}))
+
+        def hook(task, *inputs, _code=code):
+            if task is not None:
+                env = dict(ev.env(tuple(task.locals)))
+            else:
+                env = dict(ev.ns)
+            env.update(zip(in_flows, inputs))
+            exec(_code, env)
+            outs = [env.get(f) for f in out_flows]
+            if len(outs) == 1:
+                return outs[0]
+            return tuple(outs)
+
+        ptc.body(hook, device=device, batchable=not uses_instance)
+
+
+def compile_jdf(source: str, name: str = "jdf") -> CompiledJDF:
+    """Compile JDF text (the parsec_ptgpp entry point analog)."""
+    return CompiledJDF(parse(source), name)
+
+
+def compile_file(path: str, name: Optional[str] = None) -> CompiledJDF:
+    with open(path) as fh:
+        src = fh.read()
+    if name is None:
+        name = re.sub(r"\.jdf$", "", path.rsplit("/", 1)[-1])
+    return compile_jdf(src, name)
+
+
+# ------------------------------------------------------------------ unparser
+# (jdf_unparse.c analog: AST → JDF text round-trip)
+
+def _unparse_props(props: Dict[str, Expr]) -> str:
+    if not props:
+        return ""
+    inner = " ".join(f"{k} = {v.text.strip()}" for k, v in props.items())
+    return f" [ {inner} ]"
+
+
+def _unparse_target(t: DepTarget) -> str:
+    if t.is_null:
+        return "NULL"
+    if t.new is not None:
+        return f"NEW({t.new.text.strip()})"
+    c = t.call
+    args = []
+    for a in c.args:
+        if isinstance(a, tuple):
+            s = f"{a[0].text.strip()} .. {a[1].text.strip()}"
+            if a[2] is not None:
+                s += f" .. {a[2].text.strip()}"
+            args.append(s)
+        else:
+            args.append(a.text.strip())
+    head = f"{c.flow} {c.name}" if c.is_task_ref else c.name
+    return f"{head}({', '.join(args)})"
+
+
+def unparse(jdf: JdfFile) -> str:
+    """AST → JDF source (round-trips through :func:`parse`)."""
+    out: List[str] = []
+    for p in jdf.prologues:
+        out.append("extern \"python\" %{" + p + "%}\n")
+    for g in jdf.globals:
+        out.append(f"{g.name}{_unparse_props(g.props)}")
+    out.append("")
+    for tc in jdf.task_classes:
+        out.append(f"{tc.name}({', '.join(tc.params)})"
+                   f"{_unparse_props(tc.props)}")
+        for l in tc.locals:
+            if l.range is not None:
+                s = f"  {l.name} = {l.range[0].text.strip()} .. " \
+                    f"{l.range[1].text.strip()}"
+                if l.range[2] is not None:
+                    s += f" .. {l.range[2].text.strip()}"
+            else:
+                s = f"  {l.name} = {l.value.text.strip()}"
+            out.append(s)
+        if tc.partitioning is not None:
+            out.append(
+                f"  : {_unparse_target(DepTarget(call=tc.partitioning))}")
+        for f in tc.flows:
+            head = f"  {f.access} {f.name}{_unparse_props(f.props)}"
+            pad = " " * len(f"  {f.access} {f.name}")
+            for i, d in enumerate(f.deps):
+                arrow = "<-" if d.direction == "in" else "->"
+                s = f"{head if i == 0 else pad} {arrow} "
+                if d.guard is not None:
+                    s += f"({d.guard.text.strip()}) ? "
+                s += _unparse_target(d.then)
+                if d.otherwise is not None:
+                    s += f" : {_unparse_target(d.otherwise)}"
+                s += _unparse_props(d.props)
+                out.append(s)
+            if not f.deps:
+                out.append(head)
+        if tc.priority is not None:
+            out.append(f"  ; {tc.priority.text.strip()}")
+        for b in tc.bodies:
+            out.append(f"BODY{_unparse_props(b.props)}")
+            out.append(b.code.rstrip("\n"))
+            out.append("END")
+        out.append("")
+    return "\n".join(out) + "\n"

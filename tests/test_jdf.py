@@ -1,0 +1,499 @@
+"""JDF language/compiler tests.
+
+Mirrors the reference's PTG compiler coverage (tests/dsl/ptg/): language
+features (guarded deps, ranged deps, CTL, locals, NEW), end-to-end
+execution of a compiled .jdf taskpool, the unparser round-trip, and the
+ptgpp compile-failure suite (too_many_* .jdf files that must NOT compile,
+tests/CMakeLists.txt:13-36).
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core import context as ctx_mod
+from parsec_tpu.data.matrix import TiledMatrix
+from parsec_tpu.dsl import jdf, ptg
+from parsec_tpu.dsl.jdf import (JDFSemanticError, JDFSyntaxError,
+                                compile_jdf, parse, unparse)
+
+
+CHAIN_JDF = """
+N [ type = int ]
+A [ type = collection ]
+
+STEP(k)
+  k = 0 .. N-1
+  : A(0)
+  RW T <- (k == 0) ? A(0) : T STEP(k-1)
+       -> (k < N-1) ? T STEP(k+1) : A(0)
+BODY
+  T = T + 1
+END
+"""
+
+
+POTRF_JDF = """
+extern "python" %{
+from parsec_tpu.ops.tile_kernels import (gemm_tile, potrf_tile, syrk_tile,
+                                         trsm_tile)
+%}
+
+NT [ type = int ]
+A  [ type = tiled_matrix ]
+
+POTRF(k)
+  k = 0 .. NT-1
+  : A(k, k)
+  RW T <- (k == 0) ? A(k, k) : C SYRK(k, k-1)
+       -> L TRSM(k+1 .. NT-1, k)
+       -> A(k, k)
+  ; 3 * (NT - k) ** 2
+BODY
+  T = potrf_tile(T)
+END
+
+TRSM(m, k)
+  k = 0 .. NT-1
+  m = k+1 .. NT-1
+  : A(m, k)
+  READ L <- T POTRF(k)   [ tile = A(k, k) ]
+  RW C <- (k == 0) ? A(m, k) : C GEMM(m, k, k-1)
+       -> A_ SYRK(m, k)
+       -> A_ GEMM(m, k+1 .. m-1, k)
+       -> B_ GEMM(m+1 .. NT-1, m, k)
+       -> A(m, k)
+  ; 2 * (NT - k) ** 2 - m
+BODY
+  C = trsm_tile(C, L)
+END
+
+SYRK(m, k)
+  m = 1 .. NT-1
+  k = 0 .. m-1
+  : A(m, m)
+  READ A_ <- C TRSM(m, k)   [ tile = A(m, k) ]
+  RW C <- (k == 0) ? A(m, m) : C SYRK(m, k-1)
+       -> (k < m-1) ? C SYRK(m, k+1)
+       -> (k == m-1) ? T POTRF(m)
+BODY
+  C = syrk_tile(C, A_, alpha=-1.0, beta=1.0)
+END
+
+GEMM(m, n, k)
+  m = 2 .. NT-1
+  n = 1 .. m-1
+  k = 0 .. n-1
+  : A(m, n)
+  READ A_ <- C TRSM(m, k)   [ tile = A(m, k) ]
+  READ B_ <- C TRSM(n, k)   [ tile = A(n, k) ]
+  RW C <- (k == 0) ? A(m, n) : C GEMM(m, n, k-1)
+       -> (k < n-1) ? C GEMM(m, n, k+1)
+       -> (k == n-1) ? C TRSM(m, n)
+BODY
+  C = gemm_tile(C, A_, B_, alpha=-1.0, beta=1.0, tb=True)
+END
+"""
+
+
+class _Vec:
+    """Minimal 1-tile collection for the chain test."""
+
+    def __init__(self, v):
+        self.v = {0: v}
+        self.dc_id = 1
+
+    def data_of(self, key):
+        k = key[0] if isinstance(key, tuple) else key
+        return self.v[k]
+
+    def write_tile(self, key, value):
+        k = key[0] if isinstance(key, tuple) else key
+        self.v[k] = value
+
+    def rank_of(self, key):
+        return 0
+
+
+def test_parse_structure():
+    ast = parse(CHAIN_JDF)
+    assert [g.name for g in ast.globals] == ["N", "A"]
+    (tc,) = ast.task_classes
+    assert tc.name == "STEP" and tc.params == ["k"]
+    assert tc.partitioning.name == "A"
+    (flow,) = tc.flows
+    assert flow.name == "T" and flow.access == "RW"
+    assert len(flow.deps) == 2
+    assert flow.deps[0].direction == "in"
+    assert flow.deps[0].otherwise is not None
+
+
+def test_chain_executes():
+    cj = compile_jdf(CHAIN_JDF, name="chain")
+    A = _Vec(np.float32(0.0))
+    tp = cj.taskpool(N=10, A=A)
+    ptg.check_taskpool(tp)
+    ctx = ctx_mod.init(nb_cores=2)
+    try:
+        ctx.add_taskpool(tp)
+        ctx.start()
+        assert ctx.wait(timeout=30)
+    finally:
+        ctx.fini()
+    assert float(A.v[0]) == 10.0
+
+
+def test_potrf_jdf_matches_numpy():
+    cj = compile_jdf(POTRF_JDF, name="potrf")
+    n, nb = 128, 32
+    rng = np.random.default_rng(7)
+    M = rng.standard_normal((n, n)).astype(np.float64)
+    A_host = (M @ M.T + n * np.eye(n)).astype(np.float32)
+    A = TiledMatrix.from_array(A_host.copy(), nb, nb, name="A")
+    tp = cj.taskpool(NT=A.nt, A=A)
+    ptg.check_taskpool(tp)
+    ctx = ctx_mod.init(nb_cores=4)
+    try:
+        ctx.add_taskpool(tp)
+        ctx.start()
+        assert ctx.wait(timeout=60)
+    finally:
+        ctx.fini()
+    L = np.tril(np.asarray(A.to_array(), dtype=np.float64))
+    err = np.linalg.norm(L @ L.T - A_host) / np.linalg.norm(A_host)
+    assert err < 1e-4
+
+
+def test_potrf_jdf_compiled_wavefront():
+    """The same .jdf runs on the compiled wavefront executor (tile info
+    via data refs + [tile = ...] props)."""
+    from parsec_tpu.compiled.wavefront import WavefrontExecutor, plan_taskpool
+    cj = compile_jdf(POTRF_JDF, name="potrf")
+    n, nb = 128, 32
+    rng = np.random.default_rng(3)
+    M = rng.standard_normal((n, n)).astype(np.float64)
+    A_host = (M @ M.T + n * np.eye(n)).astype(np.float32)
+    A = TiledMatrix.from_array(A_host.copy(), nb, nb, name="A")
+    tp = cj.taskpool(NT=A.nt, A=A)
+    plan = plan_taskpool(tp)
+    ex = WavefrontExecutor(plan)
+    ex.run()
+    L = np.tril(np.asarray(A.to_array(), dtype=np.float64))
+    err = np.linalg.norm(L @ L.T - A_host) / np.linalg.norm(A_host)
+    assert err < 1e-4
+
+
+def test_derived_locals_and_body_params():
+    """Derived locals between ranges + body using instance params
+    (stencil_1D.jdf shape)."""
+    src = """
+N [ type = int ]
+A [ type = collection ]
+
+T(t, n)
+  t = 0 .. 1
+  m = t * 10
+  n = 0 .. N-1
+  : A(0)
+  RW X <- (t == 0) ? A(0) : X T(t-1, n)
+       -> (t < 1) ? X T(t+1, n)
+BODY
+  X = X + m + n
+END
+"""
+    cj = compile_jdf(src)
+    A = _Vec(np.float32(0.0))
+    tp = cj.taskpool(N=3, A=A)
+    assert sorted(tp.task_classes[0].enumerate_space()) == \
+        [(0, 0), (0, 1), (0, 2), (1, 0), (1, 1), (1, 2)]
+    ctx = ctx_mod.init(nb_cores=2)
+    try:
+        ctx.add_taskpool(tp)
+        ctx.start()
+        assert ctx.wait(timeout=30)
+    finally:
+        ctx.fini()
+
+
+def test_ctl_flow():
+    src = """
+A [ type = collection ]
+
+FIRST(k)
+  k = 0 .. 0
+  : A(0)
+  RW T <- A(0)
+       -> A(0)
+  CTL X -> X SECOND(0)
+BODY
+  T = T + 1
+END
+
+SECOND(k)
+  k = 0 .. 0
+  : A(0)
+  CTL X <- X FIRST(0)
+  RW T <- A(0)
+       -> A(0)
+BODY
+  T = T * 2
+END
+"""
+    cj = compile_jdf(src)
+    A = _Vec(np.float32(3.0))
+    tp = cj.taskpool(A=A)
+    ctx = ctx_mod.init(nb_cores=2)
+    try:
+        ctx.add_taskpool(tp)
+        ctx.start()
+        assert ctx.wait(timeout=30)
+    finally:
+        ctx.fini()
+    assert float(A.v[0]) == 8.0     # (3+1)*2 — CTL orders the two writers
+
+
+def test_new_dep():
+    src = """
+A [ type = collection ]
+NB [ type = int default = 4 ]
+
+MAKE(k)
+  k = 0 .. 0
+  : A(0)
+  WRITE S <- NEW(np.zeros(NB, dtype="float32"))
+          -> A(0)
+BODY
+  S = S + 7
+END
+
+extern "python" %{
+import numpy as np
+%}
+"""
+    cj = compile_jdf(src)
+    A = _Vec(None)
+    tp = cj.taskpool(A=A)
+    ctx = ctx_mod.init(nb_cores=1)
+    try:
+        ctx.add_taskpool(tp)
+        ctx.start()
+        assert ctx.wait(timeout=30)
+    finally:
+        ctx.fini()
+    assert np.allclose(A.v[0], 7.0) and A.v[0].shape == (4,)
+
+
+def test_unparse_roundtrip():
+    ast = parse(POTRF_JDF)
+    text = unparse(ast)
+    ast2 = parse(text)
+    assert [t.name for t in ast2.task_classes] == \
+        [t.name for t in ast.task_classes]
+    # semantic equivalence: both compile and enumerate the same space
+    tp1 = jdf.CompiledJDF(ast, "a").taskpool(
+        NT=3, A=TiledMatrix.from_array(np.eye(96, dtype=np.float32), 32, 32))
+    tp2 = jdf.CompiledJDF(ast2, "b").taskpool(
+        NT=3, A=TiledMatrix.from_array(np.eye(96, dtype=np.float32), 32, 32))
+    for t1, t2 in zip(tp1.task_classes, tp2.task_classes):
+        assert list(t1.enumerate_space()) == list(t2.enumerate_space())
+
+
+# ---------------------------------------------------------------- failures
+# (reference ptgpp compile-failure suite: must NOT compile)
+
+def test_fail_too_many_params():
+    params = ", ".join(f"p{i}" for i in range(jdf.MAX_PARAM_COUNT + 1))
+    ranges = "\n".join(f"  p{i} = 0 .. 1"
+                       for i in range(jdf.MAX_PARAM_COUNT + 1))
+    src = f"""
+A [ type = collection ]
+T({params})
+{ranges}
+  : A(0)
+  RW X <- A(0)
+BODY
+  pass
+END
+"""
+    with pytest.raises(JDFSemanticError, match="MAX_PARAM_COUNT"):
+        compile_jdf(src)
+
+
+def test_fail_too_many_in_deps():
+    deps = "\n".join(
+        f"     <- (k == {i}) ? A(0)" for i in range(jdf.MAX_DEP_IN_COUNT + 1))
+    src = f"""
+A [ type = collection ]
+T(k)
+  k = 0 .. 3
+  : A(0)
+  RW X {deps.lstrip()}
+BODY
+  pass
+END
+"""
+    with pytest.raises(JDFSemanticError, match="MAX_DEP_IN_COUNT"):
+        compile_jdf(src)
+
+
+def test_fail_unknown_task_class():
+    src = """
+A [ type = collection ]
+T(k)
+  k = 0 .. 1
+  : A(0)
+  RW X <- X NOPE(k)
+BODY
+  pass
+END
+"""
+    with pytest.raises(JDFSemanticError, match="unknown task class"):
+        compile_jdf(src)
+
+
+def test_fail_unknown_flow_on_target():
+    src = """
+A [ type = collection ]
+T(k)
+  k = 0 .. 1
+  : A(0)
+  RW X <- (k > 0) ? Z T(k-1) : A(0)
+BODY
+  pass
+END
+"""
+    with pytest.raises(JDFSemanticError, match="no flow"):
+        compile_jdf(src)
+
+
+def test_fail_param_without_range():
+    src = """
+A [ type = collection ]
+T(k, j)
+  k = 0 .. 1
+  : A(0)
+  RW X <- A(0)
+BODY
+  pass
+END
+"""
+    with pytest.raises(JDFSemanticError, match="no range"):
+        compile_jdf(src)
+
+
+def test_fail_wrong_arity():
+    src = """
+A [ type = collection ]
+T(k)
+  k = 0 .. 1
+  : A(0)
+  RW X <- (k > 0) ? X T(k-1, 0) : A(0)
+BODY
+  pass
+END
+"""
+    with pytest.raises(JDFSemanticError, match="parameters"):
+        compile_jdf(src)
+
+
+def test_fail_body_missing():
+    src = """
+A [ type = collection ]
+T(k)
+  k = 0 .. 1
+  : A(0)
+  RW X <- A(0)
+"""
+    with pytest.raises(JDFSyntaxError, match="BODY"):
+        compile_jdf(src)
+
+
+def test_fail_unknown_collection():
+    src = """
+A [ type = collection ]
+T(k)
+  k = 0 .. 1
+  : A(0)
+  RW X <- B(0)
+BODY
+  pass
+END
+"""
+    with pytest.raises(JDFSemanticError, match="unknown collection"):
+        compile_jdf(src)
+
+
+def test_fail_missing_global_value():
+    cj = compile_jdf(CHAIN_JDF)
+    with pytest.raises(JDFSemanticError, match="not provided"):
+        cj.taskpool(N=4)
+
+
+def test_fail_ranged_collection_target():
+    src = """
+A [ type = collection ]
+T(k)
+  k = 0 .. 1
+  : A(0)
+  RW X <- A(0)
+       -> A(0 .. 1)
+BODY
+  pass
+END
+"""
+    with pytest.raises(JDFSemanticError, match="ranged"):
+        compile_jdf(src)
+
+
+# -------------------------------------------------- regression coverage
+
+def test_body_with_comprehension_and_inline_verbatim():
+    """Bodies exec in one merged namespace (comprehensions see flows) and
+    an expression may consist entirely of a %{ ... %} block."""
+    src = """
+A [ type = collection ]
+N [ type = int ]
+
+T(k)
+  k = 0 .. 0
+  h = %{ return N * 2 %}
+  : A(0)
+  RW X <- A(0)
+       -> A(0)
+BODY
+  X = X + sum(X * 0 + i for i in range(3)) + h
+END
+"""
+    cj = compile_jdf(src)
+    A = _Vec(np.float32(1.0))
+    tp = cj.taskpool(A=A, N=5)
+    ctx = ctx_mod.init(nb_cores=1)
+    try:
+        ctx.add_taskpool(tp)
+        ctx.start()
+        assert ctx.wait(timeout=30)
+    finally:
+        ctx.fini()
+    assert float(A.v[0]) == 1.0 + 3.0 + 10.0
+
+
+def test_batchable_detects_nested_param_use():
+    """A doubly-nested closure referencing a param must disable vmap
+    batching (task=None path would lose the parameter)."""
+    src = """
+A [ type = collection ]
+T(k)
+  k = 0 .. 0
+  : A(0)
+  RW X <- A(0)
+       -> A(0)
+BODY
+  def outer():
+      def inner():
+          return k
+      return inner()
+  X = X + outer()
+END
+"""
+    tp = compile_jdf(src).taskpool(A=_Vec(np.float32(0.0)))
+    tc = tp.task_classes[0]
+    assert tc.incarnations[0].batchable is False
