@@ -15,4 +15,5 @@ cross-slice deployments.
 
 from .engine import CommEngine, AMTag
 from .local import LocalCommEngine
+from .socket_engine import SocketCommEngine
 from .collectives import bcast_tree_children, BcastTopology

@@ -22,6 +22,7 @@ class AMTag(enum.IntEnum):
     TERMDET_FOURCOUNTER = 3
     TERMDET_USER_TRIGGER = 4
     DTD_CONTROL = 5
+    BARRIER = 6
     FIRST_USER_TAG = 8
 
 MAX_REGISTERED_TAGS = 32     # PARSEC_MAX_REGISTERED_TAGS (parsec_comm_engine.h:24)

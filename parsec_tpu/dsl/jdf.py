@@ -18,17 +18,20 @@ Language surface (mirrors the reference; Python expressions)::
     NT  [ type = int ]
     A   [ type = tiled_matrix ]
 
-    POTRF(k)                      // task class: name(parameters)
-      k = 0 .. NT-1               // parameter range (inclusive, JDF-style)
-      h = k + 1                   // derived local
-      : A(k, k)                   // partitioning / affinity predicate
+    POTRF(k)                      /* task class: name(parameters) */
+      k = 0 .. NT-1               # parameter range (inclusive, JDF-style)
+      h = k + 1                   # derived local
+      : A(k, k)                   # partitioning / affinity predicate
       RW T <- (k == 0) ? A(k, k) : C SYRK(k, k-1)
            -> L TRSM(k+1 .. NT-1, k)
            -> A(k, k)
-      ; (NT - k) ** 2             // priority expression
+      ; (NT - k) ** 2             # priority expression
     BODY [ type = tpu ]
       T = potrf_tile(T)
     END
+
+    Comments: ``#`` and ``/* */`` (NOT ``//``, which is Python floor
+    division inside expressions).
 
 Dependency targets: ``FLOW Class(args)`` (task dep), ``Collection(args)``
 (memory dep), ``NULL`` (no dep), ``NEW(expr)`` (fresh value). ``->`` args
@@ -75,7 +78,7 @@ class JDFSemanticError(ValueError):
 
 _TOKEN_RE = re.compile(r"""
     (?P<WS>[ \t\r]+)
-  | (?P<COMMENT>//[^\n]*|\#[^\n]*)
+  | (?P<COMMENT>\#[^\n]*)
   | (?P<CCOMMENT>/\*.*?\*/)
   | (?P<NL>\n)
   | (?P<VERBATIM>%\{.*?%\})

@@ -476,6 +476,37 @@ END
     assert float(A.v[0]) == 1.0 + 3.0 + 10.0
 
 
+def test_floor_division_in_expressions():
+    """`//` is Python floor division, not a comment (comments are # and
+    slash-star)."""
+    src = """
+A [ type = collection ]
+T(k)
+  k = 0 .. 1    # two tasks
+  h = (k + 4) // 2   /* floor div */
+  : A(0)
+  RW X <- A(0)
+       -> A(0)
+BODY
+  X = X + h
+END
+"""
+    cj = compile_jdf(src)
+    ast = cj.ast
+    loc = next(l for l in ast.task_classes[0].locals if l.name == "h")
+    assert "//" in loc.value.text
+    A = _Vec(np.float32(0.0))
+    tp = cj.taskpool(A=A)
+    ctx = ctx_mod.init(nb_cores=1)
+    try:
+        ctx.add_taskpool(tp)
+        ctx.start()
+        assert ctx.wait(timeout=30)
+    finally:
+        ctx.fini()
+    assert float(A.v[0]) == 2.0 + 2.0     # h = 2 for both k=0, k=1
+
+
 def test_batchable_detects_nested_param_use():
     """A doubly-nested closure referencing a param must disable vmap
     batching (task=None path would lose the parameter)."""

