@@ -28,6 +28,7 @@ class _Fabric:
         self.mem: Dict[int, Any] = {}
         self._mem_next = 0
         self._lock = threading.Lock()
+        self.barrier = threading.Barrier(nb_ranks)
 
     def register_mem(self, buf: Any) -> int:
         with self._lock:
@@ -165,6 +166,13 @@ class LocalCommEngine(CommEngine):
 
         self.tag_register(AMTag.ACTIVATE, _on_activate)
 
+        def _on_dtd_control(src_rank: int, msg: Dict) -> None:
+            tp = context.find_taskpool(msg["taskpool"], active_only=False)
+            if tp is not None and hasattr(tp, "_on_dtd_control"):
+                tp._on_dtd_control(src_rank, msg)
+
+        self.tag_register(AMTag.DTD_CONTROL, _on_dtd_control)
+
     def taskpool_registered(self, tp) -> None:
         """Called by Context.add_taskpool once ``tp`` is visible in
         _active_taskpools: re-deliver activations that arrived early."""
@@ -172,6 +180,12 @@ class LocalCommEngine(CommEngine):
         cb = self._am_callbacks.get(AMTag.ACTIVATE)
         for (src_rank, msg) in parked:
             cb(src_rank, msg)
+
+    def sync(self) -> None:
+        """Real barrier across loopback ranks (each rank runs on its own
+        thread): required by collective protocols like DTD flush."""
+        if self.nb_ranks > 1:
+            self.fabric.barrier.wait(timeout=60.0)
 
     # -- termdet services -------------------------------------------------
     def register_termdet(self, name: str, monitor) -> None:

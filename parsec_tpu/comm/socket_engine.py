@@ -396,6 +396,7 @@ class SocketCommEngine(CommEngine):
         self.tag_register(AMTag.ACTIVATE, self._on_activate)
         self.tag_register(AMTag.GET_DATA, self._on_get)
         self.tag_register(AMTag.PUT_DATA, self._on_put)
+        self.tag_register(AMTag.DTD_CONTROL, self._on_dtd_control)
 
     def _find_taskpool(self, name: str):
         ctx = self._context
@@ -473,6 +474,17 @@ class SocketCommEngine(CommEngine):
             st[1]()
         if msg.get("done_tag") is not None:
             self.send_am(msg["done_tag"], src, msg["handle"])
+
+    def _on_dtd_control(self, src: int, msg: Dict) -> None:
+        """Route DTD control messages (flush writebacks/acks) to the
+        owning taskpool (terminated pools included — flush runs after
+        wait)."""
+        tp = self._context.find_taskpool(msg["taskpool"], active_only=False)
+        if tp is None or not hasattr(tp, "_on_dtd_control"):
+            warning("comm", "rank %d: DTD control for unknown taskpool %s",
+                    self.rank, msg["taskpool"])
+            return
+        tp._on_dtd_control(src, msg)
 
     def taskpool_registered(self, tp) -> None:
         parked = self._parked.pop(tp.name, [])

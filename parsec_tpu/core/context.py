@@ -99,6 +99,9 @@ class Context:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._active_taskpools: List[Taskpool] = []
+        # name → taskpool, kept past termination: late control traffic
+        # (DTD flush writebacks/acks) must still find its taskpool
+        self._taskpools_by_name: Dict[str, Taskpool] = {}
         self._aborted: List[Taskpool] = []
         self._started = False
         self._shutdown = False
@@ -129,6 +132,7 @@ class Context:
         tp.context = self
         with self._lock:
             self._active_taskpools.append(tp)
+            self._taskpools_by_name[tp.name] = tp
         if self.comm is not None and hasattr(self.comm, "taskpool_registered"):
             self.comm.taskpool_registered(tp)   # drain parked activations
         if tp.on_enqueue is not None:
@@ -200,6 +204,15 @@ class Context:
         self.scheduler.schedule(es, sorted(tasks, key=lambda t: -t.priority),
                                 distance)
         self._work_evt.set()
+
+    def find_taskpool(self, name: str, active_only: bool = True):
+        """Lookup by name; ``active_only=False`` includes terminated pools
+        (control traffic like DTD flush outlives termination)."""
+        with self._lock:
+            if active_only:
+                return next((t for t in self._active_taskpools
+                             if t.name == name), None)
+            return self._taskpools_by_name.get(name)
 
     def _taskpool_terminated(self, tp: Taskpool) -> None:
         with self._cv:

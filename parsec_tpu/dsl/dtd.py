@@ -16,6 +16,28 @@ in-flight last writer) and WAW (writer chain) edges are materialized, which
 strictly increases available parallelism versus the reference's read-list
 serialization (overlap_strategies.c:38-120).
 
+Distributed DTD (reference: every rank replays the same insertion
+sequence; remote activations for undiscovered tasks are parked,
+remote_dep_mpi.c:1935-1961; bcast restricted to star, remote_dep.c:543):
+tasks are identified by their per-taskpool insertion sequence number
+(identical on every rank). A task placed on another rank becomes a
+*shell*: no body runs locally, but tile tracking is updated so the
+dataflow crosses ranks correctly. Each tile carries a ``holder_rank`` —
+the rank holding the version current at this point in program order,
+updated identically on every rank during replay — so:
+
+- a local reader whose version is held remotely counts one extra dep and
+  receives the value as a remote activation (sent by the holder, which
+  replays the same insert as a shell);
+- a local completion delivers values to remote shells linked as
+  successors (star fan-out);
+- a shell read of a tile this rank holds (no writer in flight) triggers
+  an eager push of the current version.
+
+``flush()`` is collective in distributed mode: each rank quiesces its
+local writers, pushes tiles it holds back to their owners
+(parsec_dtd_data_flush analog), waits for acks, and barriers.
+
 Usage::
 
     tp = dtd.Taskpool("gemm")
@@ -80,17 +102,34 @@ class ScratchArg:
     dtype: Any = "float32"
 
 
-class _Tile:
-    """Per-(collection, key) tracking state (parsec_dtd_tile_t analog)."""
+class _Shell:
+    """Placeholder for a task placed on another rank (the reference's
+    remote shell task, insert_function.c distributed path)."""
 
-    __slots__ = ("collection", "key", "lock", "last_writer", "last_writer_flow")
+    __slots__ = ("seq", "rank")
+
+    def __init__(self, seq: int, rank: int):
+        self.seq = seq
+        self.rank = rank
+
+
+class _Tile:
+    """Per-(collection, key) tracking state (parsec_dtd_tile_t analog).
+
+    ``holder_rank`` is the rank holding the version current at this point
+    of the replayed insertion order (None = the collection owner)."""
+
+    __slots__ = ("collection", "key", "lock", "last_writer",
+                 "last_writer_flow", "holder_rank")
 
     def __init__(self, collection: DataCollection, key):
         self.collection = collection
         self.key = key
         self.lock = threading.Lock()
-        self.last_writer: Optional[Task] = None     # not-yet-complete writer
+        # not-yet-complete writer: local Task or remote _Shell
+        self.last_writer = None
         self.last_writer_flow: Optional[str] = None
+        self.holder_rank: Optional[int] = None
 
 
 class _TileBank:
@@ -123,16 +162,35 @@ class Taskpool(CoreTaskpool):
         self._classes: Dict[Any, TaskClass] = {}
         self._class_lock = threading.Lock()
         self._goals: Dict[int, int] = {}
-        self._tasks_by_uid: Dict[int, Task] = {}
+        self._tasks_by_seq: Dict[int, Task] = {}
         self._state_lock = threading.Lock()
         self._inflight = 0
         self._inflight_cv = threading.Condition()
         self._window = int(mca_param.get("dtd.window_size", 4096))
         self._threshold = int(mca_param.get("dtd.threshold_size", 2048))
         self._closed = False
+        # per-taskpool insertion sequence: the cross-rank task identity
+        # (every rank replays the same sequence → same numbering)
+        self._seq = 0
+        # wire "class" used to address DTD activations rank-to-rank
+        self._wire_tc = TaskClass("__dtd__", -1, params=("seq",), flows=[])
+        self._wire_tc.make_key = lambda locals: ("dtd", locals[0])
+        self._tc_by_name["__dtd__"] = self._wire_tc
+        self._flush_lock = threading.Lock()
+        self._flush_acks = 0
+        self._flush_cv = threading.Condition(self._flush_lock)
         # hold the taskpool open while the user is still inserting
         # (reference: DTD keeps a pending action until taskpool_wait)
         self.on_enqueue = lambda tp: tp.addto_runtime_actions(1)
+
+    # -- rank helpers ------------------------------------------------------
+    @property
+    def my_rank(self) -> int:
+        return self.context.my_rank if self.context is not None else 0
+
+    @property
+    def nb_ranks(self) -> int:
+        return self.context.nb_ranks if self.context is not None else 1
 
     def _on_terminated(self) -> None:
         # release an inserter blocked in the sliding-window throttle (the
@@ -156,8 +214,11 @@ class Taskpool(CoreTaskpool):
                      for i, (kind, access) in enumerate(shape)
                      if kind == "tile"]
             tc = TaskClass(getattr(fn, "__name__", "dtd_task"),
-                           len(self.task_classes), params=("uid",),
+                           len(self.task_classes), params=("seq",),
                            flows=flows, deps_mode=DEPS_COUNTER)
+            # task identity is the insertion sequence number — identical on
+            # every rank, so activations address tasks unambiguously
+            tc.make_key = lambda locals: ("dtd", locals[0])
             tc.deps_goal = lambda locals: self._goals.get(locals[0], _GOAL_UNSET)
             tc.iterate_successors = self._iterate_successors
             tc.data_lookup = self._data_lookup
@@ -180,10 +241,28 @@ class Taskpool(CoreTaskpool):
             return tc
 
     # ------------------------------------------------------------- insert
+    def _placement(self, args) -> int:
+        """Owner rank of the task: the AFFINITY tile's owner, else the
+        first tile argument's owner, else round-robin by sequence
+        (PARSEC_AFFINITY analog — deterministic across the replay)."""
+        first = None
+        for a in args:
+            if isinstance(a, TileArg):
+                if a.affinity:
+                    return a.collection.rank_of(a.key)
+                if first is None:
+                    first = a
+        if first is not None:
+            return first.collection.rank_of(first.key)
+        return self._seq % self.nb_ranks
+
     def insert_task(self, fn: Callable, *args, priority: int = 0,
                     device: DeviceType = DeviceType.ALL,
-                    name: Optional[str] = None) -> Task:
-        """parsec_dtd_insert_task analog (insert_function.c:3488)."""
+                    name: Optional[str] = None) -> Optional[Task]:
+        """parsec_dtd_insert_task analog (insert_function.c:3488). In
+        distributed mode every rank calls this with the identical sequence;
+        returns the local Task, or None when the task is placed remotely
+        (a shell — only tile tracking is updated here)."""
         if self.error is not None:
             raise RuntimeError(
                 f"taskpool {self.name} aborted: {self.error}") from self.error
@@ -196,23 +275,29 @@ class Taskpool(CoreTaskpool):
             # (insert_function.c checks the same and the sliding window
             # would deadlock otherwise)
             self.context.start()
+        seq = self._seq
+        self._seq += 1
         shape = tuple(
             ("tile", a.access) if isinstance(a, TileArg)
             else ("value", None) if isinstance(a, ValueArg)
             else ("scratch", None)
             for a in args)
         tc = self._task_class_for(fn, shape, device)
+        target_rank = self._placement(args) if self.nb_ranks > 1 else 0
+        my_rank = self.my_rank
+        if self.nb_ranks > 1 and target_rank != my_rank:
+            self._insert_shell(seq, target_rank, args, priority)
+            return None
 
-        task = Task(self, tc, (0,), priority=priority)
-        task.locals = (task.uid,)
+        task = Task(self, tc, (seq,), priority=priority)
         task.dsl.update(argspec=[], out_tiles=[], succ=[], done=False,
                         lock=threading.Lock(), affinity=None, aliases={})
 
         # register before linking so a racing writer completion can route
         # activations to this task
         with self._state_lock:
-            self._goals[task.uid] = _GOAL_UNSET
-            self._tasks_by_uid[task.uid] = task
+            self._goals[seq] = _GOAL_UNSET
+            self._tasks_by_seq[seq] = task
         with self._inflight_cv:
             self._inflight += 1
         self.addto_nb_tasks(1)
@@ -244,8 +329,11 @@ class Taskpool(CoreTaskpool):
                 seen_tiles[tile] = fname
                 with tile.lock:
                     writer = tile.last_writer
+                    holder = tile.holder_rank
+                if holder is None:
+                    holder = a.collection.rank_of(a.key)
                 linked = False
-                if writer is not None:
+                if isinstance(writer, Task):
                     with writer.dsl["lock"]:
                         if not writer.dsl["done"]:
                             ref = SuccessorRef(task_class=tc,
@@ -256,19 +344,31 @@ class Taskpool(CoreTaskpool):
                             writer.dsl["succ"].append(ref)
                             goal += 1
                             linked = True
+                elif isinstance(writer, _Shell):
+                    # in-flight remote writer: its rank replays this insert
+                    # and will deliver the value at completion
+                    goal += 1
+                    linked = True
                 if not linked:
-                    # no in-flight writer: snapshot the program-order value
-                    # now (immutable arrays make the snapshot stay valid)
-                    task.data[fname] = a.collection.data_of(a.key)
+                    if holder == my_rank:
+                        # current version is local: snapshot the
+                        # program-order value now (immutable arrays keep
+                        # the snapshot valid)
+                        task.data[fname] = a.collection.data_of(a.key)
+                    else:
+                        # version held remotely: the holder replays this
+                        # insert as a shell and pushes the value eagerly
+                        goal += 1
             if a.access & FlowAccess.WRITE:
                 with tile.lock:
                     tile.last_writer = task
                     tile.last_writer_flow = fname
+                    tile.holder_rank = my_rank
                 task.dsl["out_tiles"].append((tile, fname))
 
         # finalize the goal; racing activations may already have counted
         with self._state_lock:
-            self._goals[task.uid] = goal
+            self._goals[seq] = goal
         if goal == 0:
             self.context.schedule(None, [task])
         else:
@@ -285,6 +385,63 @@ class Taskpool(CoreTaskpool):
                 while self._inflight > self._threshold and not self._closed:
                     self._inflight_cv.wait(timeout=0.05)
         return task
+
+    def _insert_shell(self, seq: int, target_rank: int, args,
+                      priority: int) -> None:
+        """Replay a remotely-placed insert: update tile tracking and feed
+        the remote task any version this rank holds (star topology — the
+        reference restricts DTD collectives to star, remote_dep.c:543)."""
+        my_rank = self.my_rank
+        flow_i = 0
+        seen: set = set()
+        for a in args:
+            if not isinstance(a, TileArg):
+                continue
+            tile = self.tiles.tile_of(a.collection, a.key)
+            fname = f"f{flow_i}"
+            flow_i += 1
+            if tile in seen:
+                continue
+            seen.add(tile)
+            with tile.lock:
+                writer = tile.last_writer
+                holder = tile.holder_rank
+            if holder is None:
+                holder = a.collection.rank_of(a.key)
+            if a.access & FlowAccess.READ and not (a.access & FlowAccess.CTL):
+                if isinstance(writer, Task):
+                    # local in-flight writer feeds the remote task
+                    sent = False
+                    with writer.dsl["lock"]:
+                        if not writer.dsl["done"]:
+                            writer.dsl["succ"].append(
+                                ("remote", target_rank, seq, fname,
+                                 tile.last_writer_flow, priority))
+                            sent = True
+                    if not sent and holder == my_rank:
+                        self._send_value(target_rank, seq, fname,
+                                         a.collection.data_of(a.key),
+                                         priority)
+                elif writer is None and holder == my_rank:
+                    # quiescent version held here: eager push (PULLIN)
+                    self._send_value(target_rank, seq, fname,
+                                     a.collection.data_of(a.key), priority)
+                # else: another rank holds/produces it — not our edge
+            if a.access & FlowAccess.WRITE:
+                with tile.lock:
+                    tile.last_writer = _Shell(seq, target_rank)
+                    tile.last_writer_flow = fname
+                    tile.holder_rank = target_rank
+
+    def _send_value(self, target_rank: int, seq: int, fname: str,
+                    value, priority: int = 0) -> None:
+        """Ship one input value of remote task ``seq`` (eager activation)."""
+        import types as _types
+        ref = SuccessorRef(task_class=self._wire_tc, locals=(seq,),
+                           flow_name=fname, value=value, dep_index=0,
+                           priority=priority)
+        shim = _types.SimpleNamespace(taskpool=self)
+        self.context.comm.remote_dep_activate(shim, ref, target_rank)
 
     # ----------------------------------------------------- class callbacks
     def _data_lookup(self, task: Task) -> None:
@@ -311,15 +468,22 @@ class Taskpool(CoreTaskpool):
             task.dsl["succ"].clear()
         refs: List[SuccessorRef] = []
         for ref in succ:
+            if isinstance(ref, tuple):      # remote shell successor
+                _, rank, seq, dst_fname, src_flow, prio = ref
+                value = task.output.get(src_flow, task.data.get(src_flow)) \
+                    if src_flow is not None else None
+                self._send_value(rank, seq, dst_fname, value, prio)
+                continue
             src_flow = getattr(ref, "src_flow", None)
             if src_flow is not None and src_flow in task.output:
                 ref.value = task.output[src_flow]
             elif src_flow is not None:
                 ref.value = task.data.get(src_flow)
             refs.append(ref)
+        seq = task.locals[0]
         with self._state_lock:
-            self._goals.pop(task.uid, None)
-            self._tasks_by_uid.pop(task.uid, None)
+            self._goals.pop(seq, None)
+            self._tasks_by_seq.pop(seq, None)
         with self._inflight_cv:
             self._inflight -= 1
             self._inflight_cv.notify_all()
@@ -328,18 +492,22 @@ class Taskpool(CoreTaskpool):
     # -------------------------------------------------------------- drain
     def activate_dep(self, ref: SuccessorRef) -> Optional[Task]:
         """DTD successors already exist at activation time — count down on
-        the pre-built task instead of constructing a new one."""
-        uid = ref.locals[0]
+        the pre-built task instead of constructing a new one. Activations
+        for a not-yet-inserted task (remote values racing the replay)
+        accumulate in the pending table against the _GOAL_UNSET sentinel
+        until insert_task finalizes the goal — the parked-undiscovered-task
+        protocol (remote_dep_mpi.c:1935-1961)."""
+        seq = ref.locals[0]
         with self._state_lock:
-            goal = self._goals.get(uid, _GOAL_UNSET)
-            task = self._tasks_by_uid.get(uid)
-        ent = self.pending.update(ref.task_class.make_key(ref.locals),
+            goal = self._goals.get(seq, _GOAL_UNSET)
+            task = self._tasks_by_seq.get(seq)
+        ent = self.pending.update(("dtd", seq),
                                   ref.flow_name, ref.value, ref.dep_index,
                                   goal, DEPS_COUNTER, ref.priority)
         if ent is None:
             return None
         if task is None:
-            raise RuntimeError(f"DTD successor uid={uid} vanished")
+            raise RuntimeError(f"DTD successor seq={seq} vanished")
         task.data.update(ent["data"])
         task.priority = max(task.priority, ent["priority"])
         return task
@@ -358,9 +526,12 @@ class Taskpool(CoreTaskpool):
 
     def flush(self, collection: Optional[DataCollection] = None,
               timeout: float = 60.0) -> None:
-        """parsec_dtd_data_flush analog: wait until no in-flight writer
-        remains for the collection's tiles (produced versions are written
-        back at completion, so afterwards ``data_of`` is current)."""
+        """parsec_dtd_data_flush analog: wait until no in-flight LOCAL
+        writer remains for the collection's tiles (produced versions are
+        written back at completion, so afterwards ``data_of`` is current).
+        In distributed mode this is a COLLECTIVE: after the local quiesce,
+        each rank pushes the tiles it holds back to their owners, waits
+        for the owners' acks, and barriers."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             busy = False
@@ -368,10 +539,63 @@ class Taskpool(CoreTaskpool):
                 if collection is not None and tile.collection is not collection:
                     continue
                 with tile.lock:
-                    if tile.last_writer is not None:
+                    if isinstance(tile.last_writer, Task):
                         busy = True
                         break
             if not busy:
-                return
+                break
             time.sleep(0.001)
-        raise TimeoutError("DTD flush timed out")
+        else:
+            raise TimeoutError("DTD flush timed out")
+        if self.nb_ranks > 1:
+            self._flush_distributed(collection, timeout)
+
+    def _flush_distributed(self, collection, timeout: float) -> None:
+        from ..comm.engine import AMTag
+        comm = self.context.comm
+        my_rank = self.my_rank
+        sent = 0
+        for tile in self.tiles.all():
+            if collection is not None and tile.collection is not collection:
+                continue
+            owner = tile.collection.rank_of(tile.key)
+            with tile.lock:
+                holder = tile.holder_rank
+            if holder == my_rank and owner != my_rank:
+                # writeback to the owner (parsec_dtd_data_flush)
+                comm.send_am(
+                    AMTag.DTD_CONTROL, owner,
+                    {"taskpool": self.name, "op": "flush",
+                     "dc_id": tile.collection.dc_id, "key": tile.key,
+                     "value": tile.collection.data_of(tile.key),
+                     "src": my_rank})
+                sent += 1
+        with self._flush_cv:
+            deadline = time.monotonic() + timeout
+            while self._flush_acks < sent:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError("DTD distributed flush: acks missing")
+                self._flush_cv.wait(timeout=min(0.05, left))
+            self._flush_acks -= sent
+        comm.sync()
+
+    def _on_dtd_control(self, src: int, msg: Dict) -> None:
+        """Handle DTD control AMs (flush writebacks + acks); invoked by
+        the comm engine's DTD_CONTROL dispatcher."""
+        from ..comm.engine import AMTag
+        if msg["op"] == "flush":
+            dc = next((t.collection for t in self.tiles.all()
+                       if t.collection.dc_id == msg["dc_id"]), None)
+            if dc is not None:
+                dc.write_tile(msg["key"], msg["value"])
+                tile = self.tiles.tile_of(dc, msg["key"])
+                with tile.lock:
+                    tile.holder_rank = self.my_rank
+            self.context.comm.send_am(
+                AMTag.DTD_CONTROL, src,
+                {"taskpool": self.name, "op": "flush_ack"})
+        elif msg["op"] == "flush_ack":
+            with self._flush_cv:
+                self._flush_acks += 1
+                self._flush_cv.notify_all()

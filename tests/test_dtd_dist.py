@@ -1,0 +1,180 @@
+"""Distributed DTD: replayed insertion across ranks (loopback fabric).
+
+Reference behavior (insert_function.c distributed path + parked
+activations remote_dep_mpi.c:1935-1961): every rank replays the same
+insertion sequence; a task executes on its placement rank only; values
+cross ranks as activations; flush writes versions back to tile owners.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm.local import LocalCommEngine
+from parsec_tpu.core import context as ctx_mod
+from parsec_tpu.dsl import dtd
+
+
+class _Vec:
+    """Scalar-tile collection distributed round-robin by index."""
+
+    def __init__(self, n, nb_ranks, my_rank, init=0.0, dc_id=11):
+        self.n = n
+        self.nb_ranks = nb_ranks
+        self.my_rank = my_rank
+        self.dc_id = dc_id
+        self.v = {}
+        for i in range(n):
+            self.v[i] = np.float32(init)
+
+    def _k(self, key):
+        return key[0] if isinstance(key, (tuple, list)) else key
+
+    def rank_of(self, key):
+        return self._k(key) % self.nb_ranks
+
+    def data_of(self, key):
+        return self.v[self._k(key)]
+
+    def write_tile(self, key, value):
+        self.v[self._k(key)] = value
+
+
+def _run_pair(scenario, nb_ranks=2, timeout=30.0):
+    """Run `scenario(rank, ctx, col_factory)` on nb_ranks loopback
+    contexts in threads; returns per-rank scenario results."""
+    engines = LocalCommEngine.make_fabric(nb_ranks)
+    ctxs = [ctx_mod.init(nb_cores=2, comm=engines[r])
+            for r in range(nb_ranks)]
+    results = [None] * nb_ranks
+    errors = []
+
+    def _worker(r):
+        try:
+            results[r] = scenario(r, ctxs[r])
+        except BaseException as exc:  # noqa: BLE001
+            import traceback
+            errors.append((r, exc, traceback.format_exc()))
+
+    threads = [threading.Thread(target=_worker, args=(r,))
+               for r in range(nb_ranks)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=timeout)
+    for c in ctxs:
+        c.fini()
+    if errors:
+        r, exc, tb = errors[0]
+        raise AssertionError(f"rank {r} failed: {exc}\n{tb}")
+    return results
+
+
+def test_dtd_cross_rank_chain():
+    """One datum hops between ranks: placement alternates via an affinity
+    tile, the INOUT value must flow rank-to-rank each step."""
+    n_steps = 8
+    nb_ranks = 2
+
+    def scenario(rank, ctx):
+        P = _Vec(n_steps, nb_ranks, rank, dc_id=21)     # placement driver
+        A = _Vec(1, nb_ranks, rank, dc_id=22)           # the datum (owner 0)
+        tp = dtd.Taskpool("xchain")
+        ctx.add_taskpool(tp)
+
+        def bump(p, x):
+            return x + 1
+
+        for k in range(n_steps):
+            tp.insert_task(
+                bump,
+                dtd.TileArg(P, (k,), dtd.INPUT, affinity=True),
+                dtd.TileArg(A, (0,), dtd.INOUT))
+        tp.wait()
+        tp.flush(A)
+        return float(A.v[0])
+
+    results = _run_pair(scenario, nb_ranks)
+    # owner of A(0) is rank 0: after flush it has the final value
+    assert results[0] == float(n_steps)
+
+
+def test_dtd_remote_read_eager_push():
+    """A task on rank 1 reads a tile owned (and only present) on rank 0
+    with no writer in flight: rank 0's shell replay pushes the value."""
+    nb_ranks = 2
+
+    def scenario(rank, ctx):
+        A = _Vec(2, nb_ranks, rank, dc_id=31)
+        if rank == 0:
+            A.v[0] = np.float32(41.0)     # only the owner has the value
+        out = {}
+        tp = dtd.Taskpool("eager")
+        ctx.add_taskpool(tp)
+
+        def consume(x, y):
+            return x + 1
+
+        # task placed on rank 1 (tile (1,) owner), reads rank-0-owned (0,)
+        tp.insert_task(consume,
+                       dtd.TileArg(A, (0,), dtd.INPUT),
+                       dtd.TileArg(A, (1,), dtd.INOUT, affinity=True))
+        tp.wait()
+        tp.flush(A)
+        return float(A.v[1])
+
+    results = _run_pair(scenario, nb_ranks)
+    assert results[1] == 42.0
+
+
+def test_dtd_waw_across_ranks():
+    """Writer chain alternating ranks (WAW ordering) with final flush to
+    the owner."""
+    nb_ranks = 2
+    n = 6
+
+    def scenario(rank, ctx):
+        P = _Vec(n, nb_ranks, rank, dc_id=41)
+        A = _Vec(1, nb_ranks, rank, dc_id=42)
+        tp = dtd.Taskpool("waw")
+        ctx.add_taskpool(tp)
+
+        def scale_add(p, x):
+            return x * 2 + 1
+
+        for k in range(n):
+            tp.insert_task(
+                scale_add,
+                dtd.TileArg(P, (k,), dtd.INPUT, affinity=True),
+                dtd.TileArg(A, (0,), dtd.INOUT))
+        tp.wait()
+        tp.flush(A)
+        return float(A.v[0])
+
+    expected = 0.0
+    for _ in range(n):
+        expected = expected * 2 + 1
+    results = _run_pair(scenario, nb_ranks)
+    assert results[0] == expected
+
+
+def test_dtd_single_rank_unchanged():
+    """nb_ranks == 1 keeps the non-distributed semantics (all tasks local,
+    placement ignored)."""
+    ctx = ctx_mod.init(nb_cores=2)
+    try:
+        A = _Vec(4, 1, 0, dc_id=51)
+        tp = dtd.Taskpool("local")
+        ctx.add_taskpool(tp)
+
+        def bump(x):
+            return x + 1
+
+        for k in range(4):
+            for _ in range(3):
+                tp.insert_task(bump, dtd.TileArg(A, (k,), dtd.INOUT))
+        tp.wait()
+        assert all(float(A.v[k]) == 3.0 for k in range(4))
+    finally:
+        ctx.fini()
