@@ -366,18 +366,22 @@ class Taskpool(CoreTaskpool):
                     tile.holder_rank = my_rank
                 task.dsl["out_tiles"].append((tile, fname))
 
-        # finalize the goal; racing activations may already have counted
+        # Finalize the goal; racing activations may already have counted.
+        # The lock must span both the goal publication AND the finalize
+        # check: activate_dep reads the goal and counts under the same
+        # lock, so an activation can never count against a stale
+        # _GOAL_UNSET after we finalized (that interleaving left the
+        # entry uncompletable forever — a lost-wakeup hang).
         with self._state_lock:
             self._goals[seq] = goal
+            ent = None if goal == 0 else self.pending.finalize(
+                tc.make_key(task.locals), goal, DEPS_COUNTER)
         if goal == 0:
             self.context.schedule(None, [task])
-        else:
-            ent = self.pending.finalize(tc.make_key(task.locals), goal,
-                                        DEPS_COUNTER)
-            if ent is not None:
-                task.data.update(ent["data"])
-                task.priority = max(task.priority, ent["priority"])
-                self.context.schedule(None, [task])
+        elif ent is not None:
+            task.data.update(ent["data"])
+            task.priority = max(task.priority, ent["priority"])
+            self.context.schedule(None, [task])
 
         # sliding window: throttle the inserting thread
         with self._inflight_cv:
@@ -499,11 +503,13 @@ class Taskpool(CoreTaskpool):
         protocol (remote_dep_mpi.c:1935-1961)."""
         seq = ref.locals[0]
         with self._state_lock:
+            # goal read + count must be one critical section against
+            # insert_task's goal publication + finalize (see there)
             goal = self._goals.get(seq, _GOAL_UNSET)
             task = self._tasks_by_seq.get(seq)
-        ent = self.pending.update(("dtd", seq),
-                                  ref.flow_name, ref.value, ref.dep_index,
-                                  goal, DEPS_COUNTER, ref.priority)
+            ent = self.pending.update(("dtd", seq),
+                                      ref.flow_name, ref.value, ref.dep_index,
+                                      goal, DEPS_COUNTER, ref.priority)
         if ent is None:
             return None
         if task is None:
