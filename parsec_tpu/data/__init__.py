@@ -18,3 +18,5 @@ from .collection import DataCollection, LocalCollection
 from .matrix import (TiledMatrix, TwoDimBlockCyclic, SymTwoDimBlockCyclic,
                      TwoDimTabular, OneDimCyclic)
 from .data import Data, DataCopy, CoherencyState
+from .matrix_ops import (build_apply, build_broadcast, build_map_operator,
+                         build_reduce)

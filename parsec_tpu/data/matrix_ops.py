@@ -1,0 +1,268 @@
+"""Library matrix operations shipped as PTG taskpools.
+
+Reference: data_dist/matrix/{apply.jdf, map_operator.c, reduce_row.jdf,
+reduce_col.jdf, broadcast.jdf} — small parameterized task graphs the
+reference ships as library helpers over tiled matrices.
+
+TPU-first divergence: reductions are *binomial trees* expressed in closed
+form (log-depth wavefronts that the compiled executor can batch per level),
+not linear chains; broadcast reuses the collective topologies of
+:mod:`parsec_tpu.comm.collectives` so the same tree shape serves both the
+host runtime and the compiled SPMD lowering.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from ..comm.collectives import BcastTopology, bcast_tree_children, bcast_tree_parent
+from ..dsl import ptg
+from .collection import DataCollection
+from .matrix import TiledMatrix
+
+
+def _uplo_keys(A: TiledMatrix, uplo: str) -> List[Tuple[int, int]]:
+    if uplo == "lower":
+        return [(i, j) for (i, j) in A.keys() if j <= i]
+    if uplo == "upper":
+        return [(i, j) for (i, j) in A.keys() if i <= j]
+    if uplo != "all":
+        raise ValueError(f"uplo must be lower/upper/all, not {uplo!r}")
+    return list(A.keys())
+
+
+def build_apply(A: TiledMatrix, op: Callable, uplo: str = "all",
+                name: str = "apply") -> ptg.Taskpool:
+    """Apply ``op(tile, i, j) -> tile`` to every (uplo-selected) tile of
+    ``A`` in place (apply.jdf analog: one independent task per tile)."""
+    keys = _uplo_keys(A, uplo)
+    tp = ptg.Taskpool(name, A=A, keys=keys)
+    APPLY = tp.task_class(
+        "APPLY", params=("i", "j"),
+        space=lambda g: iter(g.keys),
+        affinity=lambda g, i, j: (g.A, (i, j)),
+        flows=[ptg.FlowSpec(
+            "T", ptg.RW,
+            tile=lambda g, i, j: (g.A, (i, j)),
+            ins=[ptg.In(data=lambda g, i, j: (g.A, (i, j)))],
+            outs=[ptg.Out(data=lambda g, i, j: (g.A, (i, j)))])])
+
+    # needs task.locals → opts out of the shared jit cache (batchable=False)
+    @APPLY.body(batchable=False)
+    def _body(task, T):
+        i, j = task.locals
+        return op(T, i, j)
+
+    return tp
+
+
+def build_map_operator(src: TiledMatrix, dst: TiledMatrix, op: Callable,
+                       name: str = "map_operator") -> ptg.Taskpool:
+    """``dst(i,j) = op(src_tile, dst_tile)`` over all tiles
+    (map_operator.c analog — binary operator over two collections)."""
+    if (src.mt, src.nt) != (dst.mt, dst.nt):
+        raise ValueError("map_operator: tile grids must match")
+    tp = ptg.Taskpool(name, S=src, D=dst)
+    MAP = tp.task_class(
+        "MAP", params=("i", "j"),
+        space=lambda g: iter(list(g.D.keys())),
+        affinity=lambda g, i, j: (g.D, (i, j)),
+        flows=[
+            ptg.FlowSpec(
+                "S", ptg.READ,
+                tile=lambda g, i, j: (g.S, (i, j)),
+                ins=[ptg.In(data=lambda g, i, j: (g.S, (i, j)))]),
+            ptg.FlowSpec(
+                "D", ptg.RW,
+                tile=lambda g, i, j: (g.D, (i, j)),
+                ins=[ptg.In(data=lambda g, i, j: (g.D, (i, j)))],
+                outs=[ptg.Out(data=lambda g, i, j: (g.D, (i, j)))]),
+        ])
+
+    @MAP.body
+    def _body(task, S, D):
+        return {"D": op(S, D)}
+
+    return tp
+
+
+def build_broadcast(A: TiledMatrix, root: Tuple[int, int] = (0, 0),
+                    topology: BcastTopology = BcastTopology.BINOMIAL,
+                    name: str = "broadcast") -> ptg.Taskpool:
+    """Copy the value of tile ``root`` into every tile of ``A`` down a
+    collective tree (broadcast.jdf analog). The tree is the same
+    star/chain/binomial shape the comm layer uses for activation
+    propagation (remote_dep.c:334-372), rebuilt identically from the
+    participant list at every node."""
+    root = tuple(root)
+    keys = [root] + [k for k in sorted(A.keys()) if k != root]
+    part = list(range(len(keys)))  # linearized participant ids; 0 = root
+
+    tp = ptg.Taskpool(name, A=A, keys=keys, part=part, topo=topology)
+    B = tp.task_class(
+        "B", params=("x",),
+        space=lambda g: ((x,) for x in g.part),
+        affinity=lambda g, x: (g.A, g.keys[x]),
+        flows=[ptg.FlowSpec(
+            "V", ptg.RW,
+            tile=lambda g, x: (g.A, g.keys[x]),
+            ins=[ptg.In(data=lambda g, x: (g.A, g.keys[x]),
+                        guard=lambda g, x: x == 0),
+                 ptg.In(src=("B",
+                             lambda g, x: (bcast_tree_parent(g.topo, g.part, x),),
+                             "V"),
+                        guard=lambda g, x: x > 0)],
+            outs=[ptg.Out(dst=("B",
+                               lambda g, x: [(c,) for c in
+                                             bcast_tree_children(g.topo, g.part, x)],
+                               "V")),
+                  ptg.Out(data=lambda g, x: (g.A, g.keys[x]),
+                          guard=lambda g, x: x > 0)])])
+
+    @B.body
+    def _body(task, V):
+        return V
+
+    return tp
+
+
+# ---------------------------------------------------------------------------
+# Binomial-tree reduction (reduce_row.jdf / reduce_col.jdf analog)
+# ---------------------------------------------------------------------------
+
+def _lsb(x: int) -> int:
+    """Index of the lowest set bit (x > 0)."""
+    return (x & -x).bit_length() - 1
+
+
+def _owner_exists(j: int, s: int, n: int) -> bool:
+    """R(j, s) exists iff j owns a combine at step s: j aligned to
+    2^(s+1) and its partner j + 2^s is inside the group."""
+    return j % (1 << (s + 1)) == 0 and j + (1 << s) < n
+
+
+def _last_owner_step(j: int, n: int) -> int:
+    """Largest s with R(j, s) existing, or -1 if j never owns (j is only
+    ever a leaf partner)."""
+    s, last = 0, -1
+    while (1 << s) < n:
+        if _owner_exists(j, s, n):
+            last = s
+        s += 1
+    return last
+
+
+def build_reduce(A: TiledMatrix, op: Callable, axis: str = "row",
+                 dst: Optional[DataCollection] = None,
+                 name: str = "reduce") -> ptg.Taskpool:
+    """Tree-reduce tiles of ``A`` with ``op(acc, part) -> acc``.
+
+    ``axis="row"``: reduce each row's tiles into ``dst[(i, 0)]``
+    (reduce_row.jdf analog); ``axis="col"``: each column into
+    ``dst[(0, j)]`` (reduce_col.jdf); ``axis="all"``: every tile into
+    ``dst[(0, 0)]``. ``dst`` defaults to ``A`` itself.
+
+    Unlike the reference's chain reductions, the tree is binomial: task
+    R(grp, j, s) combines the accumulator at linear index ``j`` with the
+    one at ``j + 2^s``, giving log-depth wavefronts.
+    """
+    dst = dst if dst is not None else A
+    if axis == "row":
+        groups = [([(i, j) for j in range(A.nt)], (i, 0))
+                  for i in range(A.mt)]
+    elif axis == "col":
+        groups = [([(i, j) for i in range(A.mt)], (0, j))
+                  for j in range(A.nt)]
+    elif axis == "all":
+        groups = [(sorted(A.keys()), (0, 0))]
+    else:
+        raise ValueError(f"axis must be row/col/all, not {axis!r}")
+
+    tp = ptg.Taskpool(name, A=A, dst=dst, groups=groups)
+    n_of = lambda g, grp: len(g.groups[grp][0])
+    key_of = lambda g, grp, j: g.groups[grp][0][j]
+
+    def space(g):
+        for grp, (keys, _out) in enumerate(g.groups):
+            n = len(keys)
+            if n == 1:
+                yield (grp, 0, 0)  # degenerate: single COPY-like step
+                continue
+            s = 0
+            while (1 << s) < n:
+                for j in range(0, n, 1 << (s + 1)):
+                    if _owner_exists(j, s, n):
+                        yield (grp, j, s)
+                s += 1
+
+    def acc_in_data(g, grp, j, s):
+        return (g.A, key_of(g, grp, j))
+
+    def part_src_params(g, grp, j, s):
+        j2 = j + (1 << s)
+        return (grp, j2, _last_owner_step(j2, n_of(g, grp)))
+
+    def part_from_task(g, grp, j, s):
+        """Partner value comes from a task iff the partner owned some
+        earlier combine; otherwise it is a leaf read from A."""
+        if s == 0 or n_of(g, grp) == 1:
+            return False
+        j2 = j + (1 << s)
+        return _last_owner_step(j2, n_of(g, grp)) >= 0
+
+    def acc_next(g, grp, j, s):
+        return (grp, j, s + 1)
+
+    def as_partner(g, grp, j, s):
+        """After its last owning step, a nonzero j feeds the PART flow of
+        the owner at step lsb(j)."""
+        sp = _lsb(j)
+        return (grp, j - (1 << sp), sp)
+
+    R = tp.task_class(
+        "R", params=("grp", "j", "s"),
+        space=space,
+        affinity=lambda g, grp, j, s: (g.A, key_of(g, grp, j)),
+        flows=[
+            ptg.FlowSpec(
+                "ACC", ptg.RW,
+                tile=lambda g, grp, j, s: (g.A, key_of(g, grp, j)),
+                ins=[ptg.In(data=acc_in_data,
+                            guard=lambda g, grp, j, s: s == 0),
+                     ptg.In(src=("R", lambda g, grp, j, s: (grp, j, s - 1),
+                                 "ACC"),
+                            guard=lambda g, grp, j, s: s > 0)],
+                outs=[ptg.Out(dst=("R", acc_next, "ACC"),
+                              guard=lambda g, grp, j, s:
+                                  _owner_exists(j, s + 1, n_of(g, grp))),
+                      ptg.Out(dst=("R", as_partner, "PART"),
+                              guard=lambda g, grp, j, s: j > 0 and
+                                  not _owner_exists(j, s + 1, n_of(g, grp))),
+                      ptg.Out(data=lambda g, grp, j, s:
+                                  (g.dst, g.groups[grp][1]),
+                              guard=lambda g, grp, j, s: j == 0 and
+                                  not _owner_exists(0, s + 1, n_of(g, grp)))]),
+            ptg.FlowSpec(
+                "PART", ptg.READ,
+                tile=lambda g, grp, j, s:
+                    (g.A, key_of(g, grp, min(j + (1 << s),
+                                             n_of(g, grp) - 1))),
+                ins=[ptg.In(data=lambda g, grp, j, s:
+                                (g.A, key_of(g, grp,
+                                             min(j + (1 << s),
+                                                 n_of(g, grp) - 1))),
+                            guard=lambda g, grp, j, s:
+                                n_of(g, grp) > 1 and
+                                not part_from_task(g, grp, j, s)),
+                     ptg.In(src=("R", part_src_params, "ACC"),
+                            guard=part_from_task)]),
+        ])
+
+    # host-side branch on a possibly-absent flow → not jit-batchable
+    @R.body(batchable=False)
+    def _body(task, ACC, PART=None):
+        if PART is None:  # degenerate single-tile group
+            return {"ACC": ACC}
+        return {"ACC": op(ACC, PART)}
+
+    return tp

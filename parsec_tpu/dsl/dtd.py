@@ -163,7 +163,13 @@ class Taskpool(CoreTaskpool):
         self._class_lock = threading.Lock()
         self._goals: Dict[int, int] = {}
         self._tasks_by_seq: Dict[int, Task] = {}
-        self._state_lock = threading.Lock()
+        # Per-seq striped locks: goal publication + pending-finalize
+        # (insert_task) and goal read + count (activate_dep) must be one
+        # critical section *per seq* — a single global lock here would
+        # serialize every dependency activation of every DTD task. Dict
+        # accesses themselves are GIL-atomic; only the per-seq ordering
+        # needs the lock.
+        self._seq_locks = [threading.Lock() for _ in range(64)]
         self._inflight = 0
         self._inflight_cv = threading.Condition()
         self._window = int(mca_param.get("dtd.window_size", 4096))
@@ -295,7 +301,7 @@ class Taskpool(CoreTaskpool):
 
         # register before linking so a racing writer completion can route
         # activations to this task
-        with self._state_lock:
+        with self._seq_locks[seq & 63]:
             self._goals[seq] = _GOAL_UNSET
             self._tasks_by_seq[seq] = task
         with self._inflight_cv:
@@ -372,7 +378,7 @@ class Taskpool(CoreTaskpool):
         # lock, so an activation can never count against a stale
         # _GOAL_UNSET after we finalized (that interleaving left the
         # entry uncompletable forever — a lost-wakeup hang).
-        with self._state_lock:
+        with self._seq_locks[seq & 63]:
             self._goals[seq] = goal
             ent = None if goal == 0 else self.pending.finalize(
                 tc.make_key(task.locals), goal, DEPS_COUNTER)
@@ -485,7 +491,7 @@ class Taskpool(CoreTaskpool):
                 ref.value = task.data.get(src_flow)
             refs.append(ref)
         seq = task.locals[0]
-        with self._state_lock:
+        with self._seq_locks[seq & 63]:
             self._goals.pop(seq, None)
             self._tasks_by_seq.pop(seq, None)
         with self._inflight_cv:
@@ -502,7 +508,7 @@ class Taskpool(CoreTaskpool):
         until insert_task finalizes the goal — the parked-undiscovered-task
         protocol (remote_dep_mpi.c:1935-1961)."""
         seq = ref.locals[0]
-        with self._state_lock:
+        with self._seq_locks[seq & 63]:
             # goal read + count must be one critical section against
             # insert_task's goal publication + finalize (see there)
             goal = self._goals.get(seq, _GOAL_UNSET)

@@ -1,0 +1,125 @@
+"""Library matrix-op taskpools: apply / map_operator / broadcast / reduce
+(reference data_dist/matrix/{apply,reduce_row,reduce_col,broadcast}.jdf,
+map_operator.c)."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm.collectives import BcastTopology
+from parsec_tpu.data import TiledMatrix, LocalCollection
+from parsec_tpu.data.matrix_ops import (build_apply, build_broadcast,
+                                        build_map_operator, build_reduce)
+from parsec_tpu.dsl import ptg
+
+
+def _mat(rng, mt, nt, b=4):
+    arr = rng.standard_normal((mt * b, nt * b)).astype(np.float32)
+    return arr, TiledMatrix.from_array(arr, b, b, name="A")
+
+
+def test_apply_all(ctx, rng):
+    arr, A = _mat(rng, 3, 4)
+    tp = build_apply(A, lambda t, i, j: t * 2.0)
+    ptg.check_taskpool(tp)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    np.testing.assert_allclose(A.to_array(), arr * 2.0, rtol=1e-6)
+
+
+def test_apply_lower(ctx, rng):
+    arr, A = _mat(rng, 3, 3)
+    tp = build_apply(A, lambda t, i, j: np.zeros_like(t), uplo="lower")
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    out = A.to_array()
+    b = 4
+    for i in range(3):
+        for j in range(3):
+            blk = out[i*b:(i+1)*b, j*b:(j+1)*b]
+            if j <= i:
+                assert np.all(blk == 0)
+            else:
+                np.testing.assert_array_equal(blk, arr[i*b:(i+1)*b,
+                                                       j*b:(j+1)*b])
+
+
+def test_map_operator(ctx, rng):
+    sarr, S = _mat(rng, 2, 3)
+    darr, D = _mat(rng, 2, 3)
+    tp = build_map_operator(S, D, lambda s, d: s + d)
+    ptg.check_taskpool(tp)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    np.testing.assert_allclose(D.to_array(), sarr + darr, rtol=1e-6)
+
+
+@pytest.mark.parametrize("topo", [BcastTopology.STAR, BcastTopology.CHAIN,
+                                  BcastTopology.BINOMIAL])
+def test_broadcast(ctx, rng, topo):
+    arr, A = _mat(rng, 3, 3)
+    root = (1, 2)
+    b = 4
+    root_tile = arr[root[0]*b:(root[0]+1)*b, root[1]*b:(root[1]+1)*b]
+    tp = build_broadcast(A, root=root, topology=topo)
+    ptg.check_taskpool(tp)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    out = A.to_array()
+    for i in range(3):
+        for j in range(3):
+            np.testing.assert_array_equal(out[i*b:(i+1)*b, j*b:(j+1)*b],
+                                          root_tile)
+
+
+@pytest.mark.parametrize("nt", [1, 2, 3, 5, 8])
+def test_reduce_row(ctx, rng, nt):
+    arr, A = _mat(rng, 2, nt)
+    dst = LocalCollection("R")
+    tp = build_reduce(A, lambda a, p: a + p, axis="row", dst=dst)
+    ptg.check_taskpool(tp)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    b = 4
+    for i in range(2):
+        want = sum(arr[i*b:(i+1)*b, j*b:(j+1)*b] for j in range(nt))
+        np.testing.assert_allclose(dst.data_of((i, 0)), want, rtol=1e-5)
+
+
+def test_reduce_col(ctx, rng):
+    arr, A = _mat(rng, 3, 2)
+    dst = LocalCollection("R")
+    tp = build_reduce(A, lambda a, p: a + p, axis="col", dst=dst)
+    ptg.check_taskpool(tp)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    b = 4
+    for j in range(2):
+        want = sum(arr[i*b:(i+1)*b, j*b:(j+1)*b] for i in range(3))
+        np.testing.assert_allclose(dst.data_of((0, j)), want, rtol=1e-5)
+
+
+def test_reduce_all_non_pow2(ctx, rng):
+    arr, A = _mat(rng, 3, 3)  # 9 tiles: exercises ragged binomial tree
+    dst = LocalCollection("R")
+    tp = build_reduce(A, lambda a, p: a + p, axis="all", dst=dst)
+    ptg.check_taskpool(tp)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    b = 4
+    want = sum(arr[i*b:(i+1)*b, j*b:(j+1)*b]
+               for i in range(3) for j in range(3))
+    np.testing.assert_allclose(dst.data_of((0, 0)), want, rtol=1e-5)
+
+
+def test_reduce_max_op(ctx, rng):
+    """Non-additive operator down the same tree."""
+    arr, A = _mat(rng, 1, 5)
+    dst = LocalCollection("R")
+    tp = build_reduce(A, np.maximum, axis="row", dst=dst)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    b = 4
+    want = arr[:b, :b]
+    for j in range(1, 5):
+        want = np.maximum(want, arr[:b, j*b:(j+1)*b])
+    np.testing.assert_allclose(dst.data_of((0, 0)), want)
