@@ -32,6 +32,8 @@ from .utils.debug import debug_verbose, set_verbosity
 from .core.context import Context, init, fini
 from .core.taskpool import Taskpool, TaskClass, Flow, FlowAccess, Task
 from .core.compound import compose
+from .core.future import Future, DataCopyFuture
+from .core.reshape import ReshapeSpec
 from . import dsl
 from .dsl import dtd, ptg
 from . import data
@@ -47,6 +49,7 @@ __all__ = [
     "__version__",
     "init", "fini", "Context",
     "Taskpool", "TaskClass", "Flow", "FlowAccess", "Task", "compose",
+    "Future", "DataCopyFuture", "ReshapeSpec",
     "dsl", "dtd", "ptg", "data", "device", "sched", "termdet",
     "compiled", "comm", "profiling", "ops", "mca_param",
     "debug_verbose", "set_verbosity",

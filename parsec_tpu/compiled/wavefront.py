@@ -75,6 +75,11 @@ def _flow_tile(tc: PTGTaskClass, fname: str, locals) -> Tuple[Any, Tuple]:
 
 def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
     """Enumerate, level, group and hazard-check a PTG taskpool."""
+    from ..dsl.ptg import taskpool_uses_reshape
+    if taskpool_uses_reshape(tp):
+        raise NotImplementedError(
+            "compiled wavefront executor does not apply reshape specs; "
+            "run reshape-bearing taskpools on the host runtime")
     # ---- enumerate tasks and assign ids
     tasks: List[Tuple[PTGTaskClass, Tuple[int, ...]]] = []
     tid: Dict[Tuple[str, Tuple], int] = {}

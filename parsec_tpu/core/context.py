@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from .future import DataCopyFuture
+from .reshape import resolve_reshape
 from .task import Chore, DeviceType, HookReturn, Task, TaskStatus
 from .taskpool import DataRef, SuccessorRef, Taskpool
 from ..utils import mca_param
@@ -323,6 +325,13 @@ class Context:
             if isinstance(ref, DataRef):
                 ref.collection.write_tile(ref.key, ref.value)
                 continue
+            if ref.reshape_spec is not None or \
+                    isinstance(ref.value, DataCopyFuture):
+                # reshape promise: one shared conversion per layout
+                # (parsec_local_reshape analog, runs on this compute
+                # thread; remote consumers get the converted value)
+                ref.value = resolve_reshape(ref.value, ref.reshape_spec)
+                ref.reshape_spec = None
             if self.nb_ranks > 1:
                 target_rank = ref.task_class.affinity_rank(ref.locals) \
                     if hasattr(ref.task_class, "affinity_rank") else self.my_rank

@@ -40,6 +40,11 @@ class NativeDAGExecutor:
         lib = _native.load()
         if lib is None:
             raise RuntimeError("native core unavailable (no g++?)")
+        from ..dsl.ptg import taskpool_uses_reshape
+        if taskpool_uses_reshape(tp):
+            raise NotImplementedError(
+                "native DAG executor does not apply reshape specs; "
+                "run reshape-bearing taskpools on the host runtime")
         self.lib = lib
         self.tp = tp
         self.nworkers = max(1, nworkers)

@@ -1,0 +1,108 @@
+"""Reshape engine: converting data between datatypes/layouts across deps.
+
+Reference: parsec/parsec_reshape.c (771 LoC) — when a dependency's
+datatype differs from the producer's output, the runtime interposes a
+*reshape promise* (a datacopy future, remote_dep.h:100-108) whose trigger
+converts the data; the conversion runs on a compute or comm thread and is
+shared by every consumer needing the same type
+(parsec_local_reshape, remote_dep_mpi.c:642).
+
+TPU-first design: a "datatype" is a :class:`ReshapeSpec` — a named,
+composable functional transform (dtype cast, transpose, arbitrary
+callable). Producer-side specs (``Out.reshape``) convert before the value
+fans out; consumer-side specs (``In.reshape``) convert on receipt. Both
+compose into one spec resolved through a shared
+:class:`~parsec_tpu.core.future.DataCopyFuture`, so N consumers asking for
+the same layout trigger exactly one conversion (the promise-sharing
+property of the reference). Transforms on jax arrays trace into XLA, so a
+conversion of an HBM-resident tile runs on-device with no host bounce.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Optional, Tuple
+
+_spec_ids = itertools.count(1)
+
+
+class ReshapeSpec:
+    """A named layout/datatype conversion (the parsec_datatype_t analog of
+    a dep's ``[type = ...]`` annotation in JDF).
+
+    ``dtype``: cast target (numpy dtype name or jax dtype).
+    ``transpose``: swap the last two axes.
+    ``fn``: arbitrary transform ``value -> value`` (applied last).
+    ``name``: identity for caching — two specs with the same name are the
+    same conversion. Specs built only from dtype/transpose get a canonical
+    name automatically; specs with ``fn`` get a unique one unless named.
+    """
+
+    def __init__(self, dtype: Any = None, transpose: bool = False,
+                 fn: Optional[Callable[[Any], Any]] = None,
+                 name: Optional[str] = None):
+        self.dtype = dtype
+        self.transpose = transpose
+        self.fn = fn
+        if name is None:
+            if fn is None:
+                name = f"cast:{dtype}:T{int(transpose)}"
+            else:
+                name = f"fn:{next(_spec_ids)}"
+        self.name = name
+
+    @property
+    def key(self) -> str:
+        return self.name
+
+    def apply(self, value: Any) -> Any:
+        if value is None:
+            return None
+        out = value
+        if self.dtype is not None:
+            astype = getattr(out, "astype", None)
+            if astype is not None:
+                out = astype(self.dtype)
+            else:
+                import numpy as np
+                out = np.asarray(out, dtype=self.dtype)
+        if self.transpose:
+            out = out.swapaxes(-1, -2)
+        if self.fn is not None:
+            out = self.fn(out)
+        return out
+
+    def compose(self, then: Optional["ReshapeSpec"]) -> "ReshapeSpec":
+        """Sequential composition: ``self`` then ``then`` (producer-side
+        reshape followed by consumer-side reshape)."""
+        if then is None:
+            return self
+        return ReshapeSpec(fn=lambda v, a=self, b=then: b.apply(a.apply(v)),
+                           name=f"{self.name}>>{then.name}")
+
+    def __call__(self, value: Any) -> Any:
+        return self.apply(value)
+
+    def __repr__(self) -> str:
+        return f"<ReshapeSpec {self.name}>"
+
+
+def compose_specs(producer: Optional[ReshapeSpec],
+                  consumer: Optional[ReshapeSpec]) -> Optional[ReshapeSpec]:
+    """Combine an Out-side and an In-side spec into the single conversion
+    a dep needs (either side may be absent)."""
+    if producer is None:
+        return consumer
+    return producer.compose(consumer)
+
+
+def resolve_reshape(value: Any, spec: Optional[ReshapeSpec]) -> Any:
+    """Resolve a possibly-promised, possibly-reshaped dep value: futures
+    yield their (cached, shared) converted copy; concrete values convert
+    directly (parsec_local_reshape analog)."""
+    from .future import DataCopyFuture
+    if isinstance(value, DataCopyFuture):
+        return value.get_copy(spec)
+    if spec is not None:
+        return spec.apply(value)
+    return value

@@ -51,6 +51,8 @@ class SuccessorRef:
     dep_index: int = 0               # input-dep bit for mask mode
     priority: int = 0
     src_flow: Optional[str] = None   # producer's flow (planners/native exec)
+    reshape_spec: Any = None         # composed reshape (core/reshape.py);
+                                     # resolved before the value fans out
 
 
 @dataclass

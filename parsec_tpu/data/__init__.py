@@ -16,7 +16,10 @@ the batched/compiled execution path.
 
 from .collection import DataCollection, LocalCollection
 from .matrix import (TiledMatrix, TwoDimBlockCyclic, SymTwoDimBlockCyclic,
-                     TwoDimTabular, OneDimCyclic)
+                     TwoDimTabular, TwoDimBandCyclic, OneDimCyclic,
+                     SubtileView)
 from .data import Data, DataCopy, CoherencyState
+from .arena import Arena, ArenaDatatype, ArenaRegistry
+from .redistribute import build_redistribute_ptg, insert_redistribute_dtd
 from .matrix_ops import (build_apply, build_broadcast, build_map_operator,
                          build_reduce)

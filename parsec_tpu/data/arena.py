@@ -1,0 +1,147 @@
+"""Arenas: pooled allocators for communication temporaries.
+
+Reference: parsec/arena.c (295 LoC) — arenas are size+alignment-classed
+allocators with freelist caching, used to allocate buffers for incoming
+remote data; global caps ``arena_max_used`` / ``arena_max_cached`` bound
+total live and cached memory (parsec.c:674-679). An
+``parsec_arena_datatype_t`` pairs an arena with a datatype and is
+registered per taskpool (parsec_internal.h:41-45).
+
+TPU analog: host-side staging buffers are numpy arrays of one
+(shape, dtype) class; device residency is managed by jax, so arenas only
+serve the host/comm path (deserialized remote tiles, scratch staging).
+Freelist reuse avoids allocator churn on the comm thread exactly like the
+reference's elem_cache.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import mca_param
+
+mca_param.register("arena.max_cached_bytes", 1 << 28,
+                   help="global cap on bytes cached in arena freelists")
+mca_param.register("arena.max_used_bytes", 0,
+                   help="global cap on live arena bytes (0 = unlimited)")
+
+
+class _ArenaStats:
+    """Global accounting shared by all arenas (the reference's
+    arena_max_used/arena_max_cached counters)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.used_bytes = 0
+        self.cached_bytes = 0
+
+
+_global = _ArenaStats()
+
+
+def global_stats() -> Dict[str, int]:
+    with _global.lock:
+        return {"used_bytes": _global.used_bytes,
+                "cached_bytes": _global.cached_bytes}
+
+
+class Arena:
+    """One size-class of pooled host buffers (parsec_arena_t analog).
+
+    ``allocate()`` returns a zeroed numpy array of the arena's
+    (shape, dtype), reusing a cached buffer when available;
+    ``release(buf)`` returns it to the freelist subject to the global
+    cached-bytes cap. The used-bytes cap makes over-allocation fail fast
+    instead of silently exhausting host memory.
+    """
+
+    def __init__(self, shape: Tuple[int, ...], dtype=np.float32,
+                 name: str = "arena"):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self.name = name
+        self.elem_bytes = int(np.prod(self.shape)) * self.dtype.itemsize
+        self._freelist: List[np.ndarray] = []
+        self._lock = threading.Lock()
+        self.nb_allocated = 0      # total constructed (not from cache)
+        self.nb_reused = 0
+
+    def allocate(self) -> np.ndarray:
+        max_used = int(mca_param.get("arena.max_used_bytes", 0))
+        with self._lock:
+            buf = self._freelist.pop() if self._freelist else None
+        if buf is not None:
+            with _global.lock:
+                if max_used and \
+                        _global.used_bytes + self.elem_bytes > max_used:
+                    over = True
+                else:
+                    over = False
+                    _global.cached_bytes -= self.elem_bytes
+                    _global.used_bytes += self.elem_bytes
+            if over:
+                with self._lock:
+                    self._freelist.append(buf)
+                raise MemoryError(
+                    f"arena {self.name}: used-bytes cap {max_used} exceeded")
+            with self._lock:
+                self.nb_reused += 1
+            buf.fill(0)
+            return buf
+        with _global.lock:
+            if max_used and _global.used_bytes + self.elem_bytes > max_used:
+                raise MemoryError(
+                    f"arena {self.name}: used-bytes cap {max_used} exceeded")
+            _global.used_bytes += self.elem_bytes
+        with self._lock:
+            self.nb_allocated += 1
+        return np.zeros(self.shape, dtype=self.dtype)
+
+    def release(self, buf: np.ndarray) -> None:
+        if buf.shape != self.shape or buf.dtype != self.dtype:
+            raise ValueError(
+                f"arena {self.name}: buffer {buf.shape}/{buf.dtype} does not "
+                f"belong to class {self.shape}/{self.dtype}")
+        max_cached = int(mca_param.get("arena.max_cached_bytes", 1 << 28))
+        with _global.lock:
+            _global.used_bytes -= self.elem_bytes
+            cache_it = _global.cached_bytes + self.elem_bytes <= max_cached
+            if cache_it:
+                _global.cached_bytes += self.elem_bytes
+        if cache_it:
+            with self._lock:
+                self._freelist.append(buf)
+
+    @property
+    def nb_cached(self) -> int:
+        with self._lock:
+            return len(self._freelist)
+
+
+@dataclass
+class ArenaDatatype:
+    """(arena, datatype) pair (parsec_arena_datatype_t analog) — the
+    datatype is a ReshapeSpec or dtype describing the wire layout."""
+    arena: Arena
+    datatype: Any = None
+
+
+class ArenaRegistry:
+    """Per-taskpool arena-datatype registry (the reference indexes these
+    per taskpool, or in a hash table for DTD)."""
+
+    def __init__(self) -> None:
+        self._by_id: Dict[Any, ArenaDatatype] = {}
+        self._lock = threading.Lock()
+
+    def register(self, adt_id, adt: ArenaDatatype) -> None:
+        with self._lock:
+            self._by_id[adt_id] = adt
+
+    def get(self, adt_id) -> Optional[ArenaDatatype]:
+        with self._lock:
+            return self._by_id.get(adt_id)

@@ -90,6 +90,28 @@ class TwoDimTabular(Distribution):
         return self._nodes
 
 
+class TwoDimBandCyclic(Distribution):
+    """Band distribution (two_dim_band analog): tiles within ``band`` of
+    the diagonal are spread 1D-cyclically along the diagonal across all
+    ranks (dense band work balances independently of the 2D grid), tiles
+    outside the band fall back to plain 2D block cyclic."""
+
+    def __init__(self, P: int, Q: int, band: int = 1, **kw):
+        self.band = band
+        self.off_band = TwoDimBlockCyclic(P, Q, **kw)
+
+    def rank_of(self, i: int, j: int) -> int:
+        if abs(i - j) <= self.band:
+            # diagonal index, cyclic over the full rank set
+            return (min(i, j) * (2 * self.band + 1) + (i - j + self.band)) \
+                % self.off_band.nodes
+        return self.off_band.rank_of(i, j)
+
+    @property
+    def nodes(self) -> int:
+        return self.off_band.nodes
+
+
 class OneDimCyclic(Distribution):
     """1D cyclic over rows (vector_two_dim_cyclic.c analog)."""
 
@@ -189,3 +211,55 @@ class TiledMatrix(DataCollection):
         host = np.asarray(arr)
         for k, s in idx.items():
             self.write_tile(k, host[s])
+
+    # -- recursive subdivision --------------------------------------------
+    def subtile(self, key: Tuple[int, int], mb: int, nb: int,
+                name: Optional[str] = None) -> "SubtileView":
+        """View one tile as a finer-tiled matrix for recursive algorithms
+        (subtile.c analog): a POTRF tile body can run a nested tiled POTRF
+        over the subdivision on the recursive device."""
+        return SubtileView(self, key, mb, nb, name=name)
+
+
+class SubtileView(TiledMatrix):
+    """Recursive subdivision of a single parent tile (subtile.c analog).
+
+    Sub-tiles are slices of a private working copy of the parent tile;
+    :meth:`flush` writes the assembled result back to the parent — the
+    nested taskpool runs entirely on the view, then commits once.
+    """
+
+    def __init__(self, parent: TiledMatrix, key: Tuple[int, int],
+                 mb: int, nb: int, name: Optional[str] = None):
+        self.parent = parent
+        self.parent_key = tuple(key)
+        base = np.array(np.asarray(parent.data_of(key)), copy=True)
+        super().__init__(base.shape[0], base.shape[1], mb, nb,
+                         dtype=base.dtype,
+                         name=name or f"{parent.name}[{key}]")
+        self._base = base
+
+    def data_of(self, key) -> Any:
+        i, j = key
+        with self._lock:
+            t = self._tiles.get((i, j))
+        if t is None:
+            t = np.ascontiguousarray(
+                self._base[i*self.mb:(i+1)*self.mb,
+                           j*self.nb:(j+1)*self.nb])
+            with self._lock:
+                t = self._tiles.setdefault((i, j), t)
+        return t
+
+    def flush(self) -> None:
+        """Commit the subdivided result into the parent tile."""
+        self.parent.write_tile(self.parent_key, self.to_array())
+
+    def to_array(self) -> np.ndarray:
+        out = np.array(self._base, copy=True)
+        with self._lock:
+            items = list(self._tiles.items())
+        for (i, j), t in items:
+            out[i*self.mb:(i+1)*self.mb, j*self.nb:(j+1)*self.nb] = \
+                np.asarray(t)
+        return out

@@ -51,6 +51,8 @@ import types
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
+from ..core.future import DataCopyFuture
+from ..core.reshape import compose_specs
 from ..core.task import Chore, DeviceType, Flow, FlowAccess, Task
 from ..core.taskpool import DEPS_MASK, DataRef, SuccessorRef, TaskClass
 from ..core.taskpool import Taskpool as CoreTaskpool
@@ -73,11 +75,15 @@ class In:
     - ``new=lambda g, *p: value``: materialize a fresh value (JDF ``NEW``)
     ``guard`` selects whether this dep is active for a task instance; the
     guards of a flow's ins must be disjoint (one active input per flow).
+    ``reshape`` (core.reshape.ReshapeSpec) converts the incoming value to
+    this consumer's datatype/layout — the JDF ``[type = ...]`` annotation
+    (reshape promises, parsec_reshape.c).
     """
     src: Optional[Tuple[str, Callable, str]] = None
     data: Optional[Callable] = None
     new: Optional[Callable] = None
     guard: Optional[Callable] = None
+    reshape: Optional[Any] = None
 
     def active(self, g, params) -> bool:
         return self.guard is None or bool(self.guard(g, *params))
@@ -92,10 +98,14 @@ class Out:
       ``params_fn`` may return one tuple or a list of tuples (ranged deps,
       ``-> T TRSM(k+1..NT-1, k)``)
     - ``data=lambda g, *p: (collection, key)``: terminal write-back
+    ``reshape`` converts the produced value before it reaches this dep's
+    target (producer-side ``[type = ...]``); it composes with the
+    consumer's ``In.reshape``.
     """
     dst: Optional[Tuple[str, Callable, str]] = None
     data: Optional[Callable] = None
     guard: Optional[Callable] = None
+    reshape: Optional[Any] = None
 
     def active(self, g, params) -> bool:
         return self.guard is None or bool(self.guard(g, *params))
@@ -186,9 +196,25 @@ class PTGTaskClass(TaskClass):
                 continue
             if dep.data is not None:
                 dc, key = dep.data(g, *task.locals)
-                task.data[f.name] = dc.data_of(key)
+                value = dc.data_of(key)
             elif dep.new is not None:
-                task.data[f.name] = dep.new(g, *task.locals)
+                value = dep.new(g, *task.locals)
+            else:
+                continue
+            if dep.reshape is not None:
+                value = dep.reshape.apply(value)
+            task.data[f.name] = value
+
+    def _reshape_in(self, flow_name: str) -> bool:
+        """Does any In of this class's ``flow_name`` declare a reshape?
+        (cached — keeps the no-reshape hot path free of guard evals)"""
+        cache = self.__dict__.setdefault("_reshape_in_cache", {})
+        hit = cache.get(flow_name)
+        if hit is None:
+            hit = any(d.reshape is not None
+                      for d in self.specs[flow_name].ins)
+            cache[flow_name] = hit
+        return hit
 
     def _iterate_successors(self, task: Task):
         """Producer-side expansion (generated iterate_successors analog,
@@ -199,12 +225,15 @@ class PTGTaskClass(TaskClass):
             value = None
             if not f.is_ctl:
                 value = task.output.get(f.name, task.data.get(f.name))
+            promise = None   # one shared DataCopyFuture per produced flow
             for dep in spec.outs:
                 if not dep.active(g, task.locals):
                     continue
                 if dep.data is not None:
                     dc, key = dep.data(g, *task.locals)
-                    yield DataRef(collection=dc, key=key, value=value)
+                    v = value if dep.reshape is None \
+                        else dep.reshape.apply(value)
+                    yield DataRef(collection=dc, key=key, value=v)
                     continue
                 cls_name, params_fn, dst_flow = dep.dst
                 dst_tc = self.tp.task_class_by_name(cls_name)
@@ -212,11 +241,24 @@ class PTGTaskClass(TaskClass):
                 if isinstance(targets, tuple):
                     targets = [targets]
                 dst_bit_flow = dst_tc.flow_by_name[dst_flow]
+                consumer_reshapes = dst_tc._reshape_in(dst_flow)
                 for tgt in targets:
                     tgt = tuple(tgt) if isinstance(tgt, (tuple, list)) else (tgt,)
+                    composed = None
+                    if dep.reshape is not None or consumer_reshapes:
+                        dst_in = dst_tc._active_in(
+                            g, dst_tc.specs[dst_flow], tgt)
+                        composed = compose_specs(
+                            dep.reshape,
+                            dst_in.reshape if dst_in is not None else None)
+                    v = None if dst_bit_flow.is_ctl else value
+                    if composed is not None and v is not None:
+                        if promise is None:
+                            promise = DataCopyFuture(value)
+                        v = promise
                     yield SuccessorRef(
                         task_class=dst_tc, locals=tgt, flow_name=dst_flow,
-                        value=None if dst_bit_flow.is_ctl else value,
+                        value=v, reshape_spec=composed,
                         dep_index=dst_bit_flow.index,
                         priority=dst_tc.priority_fn(tgt),
                         src_flow=f.name)
@@ -279,6 +321,19 @@ class Taskpool(CoreTaskpool):
                     ready.append(t)
         self.set_nb_tasks(total)
         return ready
+
+
+def taskpool_uses_reshape(tp: Taskpool) -> bool:
+    """True if any dep of any task class declares a reshape spec. The
+    compiled (wavefront/SPMD) and native executors move raw tile values
+    and must refuse such taskpools instead of silently skipping the
+    conversions (the host runtime resolves them in complete_task)."""
+    for tc in tp.task_classes:
+        for spec in tc.spec_list:
+            if any(d.reshape is not None for d in spec.ins) or \
+                    any(d.reshape is not None for d in spec.outs):
+                return True
+    return False
 
 
 def check_taskpool(tp: Taskpool, nb_ranks: int = 1) -> None:

@@ -1,0 +1,178 @@
+"""Data-layer components: arenas, band distribution, subtiles,
+redistribution (reference arena.c, two_dim_band, subtile.c,
+data_dist/matrix/redistribute/)."""
+
+import numpy as np
+import pytest
+
+import parsec_tpu as parsec
+from parsec_tpu.data import TiledMatrix, TwoDimBlockCyclic
+from parsec_tpu.data.arena import (Arena, ArenaDatatype, ArenaRegistry,
+                                   global_stats)
+from parsec_tpu.data.matrix import SubtileView, TwoDimBandCyclic
+from parsec_tpu.data.redistribute import (build_redistribute_ptg,
+                                          insert_redistribute_dtd)
+from parsec_tpu.dsl import dtd, ptg
+from parsec_tpu.utils import mca_param
+
+
+# ---------------------------------------------------------------- arenas
+
+def test_arena_allocate_release_reuse():
+    a = Arena((4, 4), np.float32, name="t")
+    b1 = a.allocate()
+    assert b1.shape == (4, 4) and b1.dtype == np.float32
+    b1[:] = 7
+    a.release(b1)
+    assert a.nb_cached == 1
+    b2 = a.allocate()
+    assert b2 is b1 and np.all(b2 == 0)      # reused and re-zeroed
+    assert a.nb_reused == 1 and a.nb_allocated == 1
+
+
+def test_arena_rejects_foreign_buffer():
+    a = Arena((4, 4), np.float32)
+    with pytest.raises(ValueError):
+        a.release(np.zeros((2, 2), dtype=np.float32))
+
+
+def test_arena_used_cap():
+    old = mca_param.get("arena.max_used_bytes", 0)
+    base = global_stats()["used_bytes"]
+    try:
+        a = Arena((1024,), np.float64, name="cap")   # 8 KiB each
+        mca_param.set("arena.max_used_bytes", base + 3 * a.elem_bytes)
+        bufs = [a.allocate(), a.allocate(), a.allocate()]
+        with pytest.raises(MemoryError):
+            a.allocate()
+        for b in bufs:
+            a.release(b)
+    finally:
+        mca_param.set("arena.max_used_bytes", old)
+
+
+def test_arena_cached_cap():
+    old = mca_param.get("arena.max_cached_bytes", 0)
+    try:
+        a = Arena((1024,), np.float64, name="cache")
+        mca_param.set("arena.max_cached_bytes",
+                      global_stats()["cached_bytes"] + a.elem_bytes)
+        b1, b2 = a.allocate(), a.allocate()
+        a.release(b1)
+        a.release(b2)                       # over cap: dropped, not cached
+        assert a.nb_cached == 1
+    finally:
+        mca_param.set("arena.max_cached_bytes", old)
+
+
+def test_arena_registry():
+    reg = ArenaRegistry()
+    adt = ArenaDatatype(Arena((8, 8)), datatype="float32")
+    reg.register("tile", adt)
+    assert reg.get("tile") is adt
+    assert reg.get("missing") is None
+
+
+# ---------------------------------------------------- band distribution
+
+def test_band_distribution_covers_ranks():
+    d = TwoDimBandCyclic(P=2, Q=2, band=1)
+    assert d.nodes == 4
+    ranks = {d.rank_of(i, j) for i in range(8) for j in range(8)}
+    assert ranks == {0, 1, 2, 3}
+    # off-band tiles match the plain 2D-BC placement
+    assert d.rank_of(0, 7) == TwoDimBlockCyclic(2, 2).rank_of(0, 7)
+    # in-band tiles are deterministic
+    assert d.rank_of(3, 3) == d.rank_of(3, 3)
+
+
+# ------------------------------------------------------------- subtiles
+
+def test_subtile_view_roundtrip(rng):
+    arr = rng.standard_normal((8, 8)).astype(np.float32)
+    A = TiledMatrix.from_array(arr, 8, 8, name="A")
+    sv = A.subtile((0, 0), 2, 2)
+    assert (sv.mt, sv.nt) == (4, 4)
+    np.testing.assert_array_equal(sv.data_of((1, 2)), arr[2:4, 4:6])
+    sv.write_tile((0, 0), np.zeros((2, 2), dtype=np.float32))
+    sv.flush()
+    out = np.asarray(A.data_of((0, 0)))
+    assert np.all(out[0:2, 0:2] == 0)
+    np.testing.assert_array_equal(out[2:, :], arr[2:, :])
+
+
+def test_subtile_nested_potrf(ctx, rng):
+    """Recursive use: run a tiled POTRF over one tile's subdivision
+    (the recursive-device pattern, device.h:64)."""
+    from parsec_tpu.algorithms.potrf import build_potrf
+    from conftest import spd_matrix
+    spd = spd_matrix(rng, 16)
+    A = TiledMatrix.from_array(spd, 16, 16, name="A")
+    sv = A.subtile((0, 0), 4, 4)
+    tp = build_potrf(sv)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=60)
+    sv.flush()
+    L = np.tril(np.asarray(A.data_of((0, 0))))
+    np.testing.assert_allclose(L @ L.T, spd, rtol=1e-3, atol=1e-3)
+
+
+# -------------------------------------------------------- redistribution
+
+def test_redistribute_ptg_same_geometry(ctx, rng):
+    arr = rng.standard_normal((8, 12)).astype(np.float32)
+    S = TiledMatrix.from_array(arr, 4, 4, name="S")
+    D = TiledMatrix(8, 12, 4, 4, name="D")
+    tp = build_redistribute_ptg(S, D)
+    ptg.check_taskpool(tp)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    np.testing.assert_array_equal(D.to_array(), arr)
+
+
+def test_redistribute_ptg_rejects_mismatch():
+    S = TiledMatrix(8, 8, 4, 4)
+    D = TiledMatrix(8, 8, 2, 2)
+    with pytest.raises(ValueError):
+        build_redistribute_ptg(S, D)
+
+
+def test_redistribute_dtd_tile_size_change(ctx, rng):
+    """6x6 source tiles → 4x4 destination tiles (fragment assembly)."""
+    arr = rng.standard_normal((12, 12)).astype(np.float32)
+    S = TiledMatrix.from_array(arr, 6, 6, name="S")
+    D = TiledMatrix(12, 12, 4, 4, name="D")
+    tp = dtd.Taskpool(name="redist")
+    ctx.add_taskpool(tp)
+    insert_redistribute_dtd(tp, S, D)
+    tp.wait()
+    np.testing.assert_array_equal(D.to_array(), arr)
+
+
+def test_redistribute_dtd_submatrix_offsets(ctx, rng):
+    """Copy an interior 6x8 window between offset positions."""
+    sarr = rng.standard_normal((12, 16)).astype(np.float32)
+    S = TiledMatrix.from_array(sarr, 4, 4, name="S")
+    D = TiledMatrix(12, 16, 4, 4, name="D")
+    before = D.to_array()
+    tp = dtd.Taskpool(name="redist2")
+    ctx.add_taskpool(tp)
+    insert_redistribute_dtd(tp, S, D, src_off=(2, 4), dst_off=(4, 2),
+                            extent=(6, 8))
+    tp.wait()
+    out = D.to_array()
+    np.testing.assert_array_equal(out[4:10, 2:10], sarr[2:8, 4:12])
+    # untouched region preserved
+    mask = np.ones_like(out, dtype=bool)
+    mask[4:10, 2:10] = False
+    np.testing.assert_array_equal(out[mask], before[mask])
+
+
+def test_redistribute_dtd_extent_validation(ctx):
+    S = TiledMatrix(8, 8, 4, 4)
+    D = TiledMatrix(8, 8, 4, 4)
+    tp = dtd.Taskpool(name="redist3")
+    ctx.add_taskpool(tp)
+    with pytest.raises(ValueError):
+        insert_redistribute_dtd(tp, S, D, extent=(10, 2))
+    tp.wait()

@@ -1,0 +1,215 @@
+"""Futures, datacopy futures and the reshape engine
+(reference parsec/class/parsec_future.c, parsec_datacopy_future.c,
+parsec/parsec_reshape.c; test analog tests/class/future*.c and
+tests/collections/reshape/)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parsec_tpu.core.future import DataCopyFuture, Future
+from parsec_tpu.core.reshape import ReshapeSpec, compose_specs, resolve_reshape
+from parsec_tpu.data import LocalCollection, TiledMatrix
+from parsec_tpu.dsl import ptg
+
+
+# ---------------------------------------------------------------- futures
+
+def test_future_set_get():
+    f = Future()
+    assert not f.is_ready()
+    f.set(41)
+    assert f.is_ready() and f.get() == 41
+    with pytest.raises(RuntimeError):
+        f.set(42)
+
+
+def test_future_blocking_get_across_threads():
+    f = Future()
+    got = []
+    th = threading.Thread(target=lambda: got.append(f.get(timeout=5)))
+    th.start()
+    time.sleep(0.05)
+    f.set("v")
+    th.join(timeout=5)
+    assert got == ["v"]
+
+
+def test_future_timeout():
+    with pytest.raises(TimeoutError):
+        Future().get(timeout=0.05)
+
+
+def test_future_callbacks():
+    f = Future()
+    seen = []
+    f.on_ready(seen.append)
+    f.set(7)
+    f.on_ready(seen.append)   # after fulfillment: fires immediately
+    assert seen == [7, 7]
+
+
+def test_datacopy_future_shared_conversion():
+    calls = []
+
+    def trig(base, spec):
+        calls.append(spec.key)
+        return spec.apply(base)
+
+    fut = DataCopyFuture(np.arange(6, dtype=np.float64), trigger=trig)
+    s = ReshapeSpec(dtype=np.float32)
+    a = fut.get_copy(s)
+    b = fut.get_copy(ReshapeSpec(dtype=np.float32))  # same canonical key
+    assert a.dtype == np.float32 and a is b
+    assert len(calls) == 1                            # converted once
+    assert fut.get_copy(None).dtype == np.float64     # base untouched
+
+
+def test_datacopy_future_concurrent_get():
+    fut = DataCopyFuture()
+    spec = ReshapeSpec(dtype=np.float32)
+    outs = []
+    ths = [threading.Thread(target=lambda: outs.append(
+        fut.get_copy(spec, timeout=5))) for _ in range(4)]
+    for t in ths:
+        t.start()
+    fut.set(np.ones(4, dtype=np.float64))
+    for t in ths:
+        t.join(timeout=5)
+    assert len(outs) == 4
+    assert all(o.dtype == np.float32 for o in outs)
+
+
+# ----------------------------------------------------------------- specs
+
+def test_reshape_spec_cast_transpose_fn():
+    v = np.arange(6, dtype=np.float64).reshape(2, 3)
+    assert ReshapeSpec(dtype=np.float32).apply(v).dtype == np.float32
+    assert ReshapeSpec(transpose=True).apply(v).shape == (3, 2)
+    s = ReshapeSpec(dtype=np.float32, transpose=True,
+                    fn=lambda x: x * 2, name="both")
+    out = s.apply(v)
+    assert out.shape == (3, 2) and out.dtype == np.float32
+    np.testing.assert_array_equal(out, v.T.astype(np.float32) * 2)
+
+
+def test_compose_specs():
+    a = ReshapeSpec(fn=lambda v: v + 1, name="inc")
+    b = ReshapeSpec(fn=lambda v: v * 10, name="x10")
+    assert compose_specs(None, b) is b
+    assert compose_specs(a, None) is a
+    assert compose_specs(a, b).apply(1) == 20   # (1+1)*10
+
+
+def test_resolve_reshape_plain_and_future():
+    s = ReshapeSpec(fn=lambda v: v + 1, name="inc")
+    assert resolve_reshape(5, s) == 6
+    assert resolve_reshape(5, None) == 5
+    fut = DataCopyFuture(5)
+    assert resolve_reshape(fut, s) == 6
+    assert resolve_reshape(fut, None) == 5
+
+
+# ----------------------------------------- PTG integration (dep [type=...])
+
+def test_ptg_consumer_reshape_shared(ctx):
+    """One producer, two consumers with the same In.reshape: the promise
+    converts once; a third consumer reads the base value unconverted."""
+    calls = []
+    spec = ReshapeSpec(fn=lambda v: calls.append(1) or v * 10, name="x10")
+    store = LocalCollection("S", {("src",): 3, ("a",): 0, ("b",): 0,
+                                  ("plain",): 0})
+    tp = ptg.Taskpool("reshape", S=store)
+    tp.task_class(
+        "P", params=("i",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, i: (g.S, ("src",)))],
+            outs=[ptg.Out(dst=("C", lambda g, i: [(0,), (1,), (2,)], "V"))])])
+    C = tp.task_class(
+        "C", params=("j",), space=lambda g: ((j,) for j in range(3)),
+        flows=[ptg.FlowSpec(
+            "V", ptg.RW,
+            ins=[ptg.In(src=("P", lambda g, j: (0,), "X"),
+                        guard=lambda g, j: j < 2, reshape=spec),
+                 ptg.In(src=("P", lambda g, j: (0,), "X"),
+                        guard=lambda g, j: j == 2)],
+            outs=[ptg.Out(data=lambda g, j:
+                          (g.S, (["a", "b", "plain"][j],)))])])
+
+    @tp.get_task_class("P").body
+    def pbody(task, X):
+        return X
+
+    @C.body
+    def cbody(task, V):
+        return V
+
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    assert store.data_of(("a",)) == 30
+    assert store.data_of(("b",)) == 30
+    assert store.data_of(("plain",)) == 3
+    assert len(calls) == 1      # shared promise: one conversion for a & b
+
+
+def test_ptg_producer_and_consumer_reshape_compose(ctx):
+    """Out.reshape then In.reshape compose; terminal DataRef writes get
+    the Out-side conversion only."""
+    out_s = ReshapeSpec(fn=lambda v: v + 1, name="inc")
+    in_s = ReshapeSpec(fn=lambda v: v * 10, name="x10")
+    store = LocalCollection("S", {("src",): 5, ("via",): 0, ("term",): 0})
+    tp = ptg.Taskpool("compose", S=store)
+    tp.task_class(
+        "P", params=("i",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, i: (g.S, ("src",)))],
+            outs=[ptg.Out(dst=("C", lambda g, i: (0,), "V"),
+                          reshape=out_s),
+                  ptg.Out(data=lambda g, i: (g.S, ("term",)),
+                          reshape=out_s)])])
+    C = tp.task_class(
+        "C", params=("j",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "V", ptg.RW,
+            ins=[ptg.In(src=("P", lambda g, j: (0,), "X"), reshape=in_s)],
+            outs=[ptg.Out(data=lambda g, j: (g.S, ("via",)))])])
+
+    @tp.get_task_class("P").body
+    def pbody(task, X):
+        return X
+
+    @C.body
+    def cbody(task, V):
+        return V
+
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    assert store.data_of(("term",)) == 6        # out-side only: 5+1
+    assert store.data_of(("via",)) == 60        # composed: (5+1)*10
+
+
+def test_ptg_collection_read_reshape(ctx):
+    """In.reshape on a collection-sourced dep converts at data_lookup."""
+    store = LocalCollection("S", {("x",): np.arange(4, dtype=np.float64),
+                                  ("y",): None})
+    tp = ptg.Taskpool("dlr", S=store)
+    T = tp.task_class(
+        "T", params=("i",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "V", ptg.RW,
+            ins=[ptg.In(data=lambda g, i: (g.S, ("x",)),
+                        reshape=ReshapeSpec(dtype=np.float32))],
+            outs=[ptg.Out(data=lambda g, i: (g.S, ("y",)))])])
+
+    @T.body(batchable=False)
+    def body(task, V):
+        assert V.dtype == np.float32
+        return V
+
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    assert store.data_of(("y",)).dtype == np.float32
