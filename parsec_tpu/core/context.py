@@ -53,7 +53,8 @@ class ExecutionStream:
         self.sched_obj = None
         self.next_task: Optional[Task] = None   # priority bypass slot
         self.thread: Optional[threading.Thread] = None
-        self.stats = {"executed": 0, "selected": 0, "starved": 0}
+        self.stats = {"executed": 0, "selected": 0, "starved": 0,
+                      "stolen": 0}
         self._vp_peers = None        # cached steal orders (sched/base.py)
         self._steal_order = None
 
@@ -110,6 +111,10 @@ class Context:
         self._work_evt = threading.Event()
         self.grapher = None          # profiling.grapher hook
         self.trace = None            # profiling trace hook
+        # PINS modules selected by the `pins` MCA param; must come after
+        # trace/grapher init (task_profiler installs a Trace on self.trace)
+        from ..profiling import pins_modules as pins_modules_mod
+        self.pins_modules = pins_modules_mod.install_selected(self)
 
         if comm is not None and hasattr(comm, "install_activate_handler"):
             comm.install_activate_handler(self)
@@ -272,7 +277,9 @@ class Context:
         task.status = TaskStatus.PREPARE_INPUT
         lookup = getattr(tc, "data_lookup", None)
         if lookup is not None:
+            self.pins.prepare_input_begin(es, task)
             lookup(task)
+            self.pins.prepare_input_end(es, task)
         # execute: walk incarnations honoring the chore mask
         task.status = TaskStatus.HOOK
         self.pins.exec_begin(es, task)
@@ -315,11 +322,13 @@ class Context:
         if es is not None:
             es.stats["executed"] += 1
         self.pins.exec_end(es, task)
+        self.pins.complete_exec_begin(es, task)
         if self.trace is not None:
             self.trace.task_complete(task)
         if self.grapher is not None:
             self.grapher.task_executed(task)
 
+        self.pins.release_deps_begin(es, task)
         ready: List[Task] = []
         for ref in tc.iterate_successors(task):
             if isinstance(ref, DataRef):
@@ -351,6 +360,8 @@ class Context:
                 es.next_task = ready.pop(0)   # bypass: run best successor now
             if ready:
                 self.schedule(es, ready)
+        self.pins.release_deps_end(es, task)
+        self.pins.complete_exec_end(es, task)
         tp.addto_nb_tasks(-1)
 
 

@@ -216,9 +216,13 @@ class Taskpool(CoreTaskpool):
             tc = self._classes.get(key)
             if tc is not None:
                 return tc
-            flows = [Flow(f"f{i}", access if access else FlowAccess.READ)
-                     for i, (kind, access) in enumerate(shape)
-                     if kind == "tile"]
+            # flow names must match insert_task's tile-only numbering
+            # (value/scratch args don't consume a flow slot)
+            flows = []
+            for kind, access in shape:
+                if kind == "tile":
+                    flows.append(Flow(f"f{len(flows)}",
+                                      access if access else FlowAccess.READ))
             tc = TaskClass(getattr(fn, "__name__", "dtd_task"),
                            len(self.task_classes), params=("seq",),
                            flows=flows, deps_mode=DEPS_COUNTER)
@@ -546,6 +550,12 @@ class Taskpool(CoreTaskpool):
         for the owners' acks, and barriers."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
+            if self.error is not None:
+                # a task body failed — its tile writes can never quiesce;
+                # surface the abort instead of spinning to the timeout
+                raise RuntimeError(
+                    f"taskpool {self.name} aborted: {self.error}") \
+                    from self.error
             busy = False
             for tile in self.tiles.all():
                 if collection is not None and tile.collection is not collection:

@@ -60,8 +60,26 @@ class PinsManager:
     def select_begin(self, es, tasks) -> None:
         self._fire(PinsEvent.SELECT_BEGIN, es, tasks)
 
+    def prepare_input_begin(self, es, task) -> None:
+        self._fire(PinsEvent.PREPARE_INPUT_BEGIN, es, task)
+
+    def prepare_input_end(self, es, task) -> None:
+        self._fire(PinsEvent.PREPARE_INPUT_END, es, task)
+
     def exec_begin(self, es, task) -> None:
         self._fire(PinsEvent.EXEC_BEGIN, es, task)
 
     def exec_end(self, es, task) -> None:
         self._fire(PinsEvent.EXEC_END, es, task)
+
+    def release_deps_begin(self, es, task) -> None:
+        self._fire(PinsEvent.RELEASE_DEPS_BEGIN, es, task)
+
+    def release_deps_end(self, es, task) -> None:
+        self._fire(PinsEvent.RELEASE_DEPS_END, es, task)
+
+    def complete_exec_begin(self, es, task) -> None:
+        self._fire(PinsEvent.COMPLETE_EXEC_BEGIN, es, task)
+
+    def complete_exec_end(self, es, task) -> None:
+        self._fire(PinsEvent.COMPLETE_EXEC_END, es, task)

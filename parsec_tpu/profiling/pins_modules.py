@@ -1,0 +1,222 @@
+"""PINS instrumentation modules.
+
+Reference: parsec/mca/pins/ — modules hook the runtime's callback chains
+(pins.h:26-53) per execution stream. The reference ships task_profiler
+(writes task begin/end to the trace), print_steals (per-stream steal
+counters), alperf (per-class activity/performance), iterators_checker
+(runtime sanity of successor iterators) and papi (hardware counters —
+no analog here; the SDE-style software counters live in
+profiling.sde). Modules are selected MCA-style via the ``pins`` param
+(comma-separated names) and installed at context init.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+from .pins import PinsEvent
+from ..utils import mca_param
+from ..utils.debug import debug_verbose
+
+mca_param.register("pins", "",
+                   help="comma-separated PINS modules to install at init "
+                        "(task_profiler, print_steals, alperf, "
+                        "iterators_checker)")
+
+
+class PinsModule:
+    """Base module: ``install(context)`` subscribes to the PINS chains,
+    ``uninstall()`` removes the subscriptions, ``report()`` returns the
+    collected data (reference modules print at component close)."""
+
+    name = "module"
+
+    def __init__(self) -> None:
+        self.context = None
+        self._subs: List = []    # (event, cb) pairs for uninstall
+
+    def _sub(self, event: PinsEvent, cb) -> None:
+        self.context.pins.register(event, cb)
+        self._subs.append((event, cb))
+
+    def install(self, context) -> "PinsModule":
+        self.context = context
+        return self
+
+    def uninstall(self) -> None:
+        for event, cb in self._subs:
+            self.context.pins.unregister(event, cb)
+        self._subs.clear()
+
+    def report(self) -> Dict[str, Any]:
+        return {}
+
+
+class TaskProfiler(PinsModule):
+    """mca/pins/task_profiler analog: records task begin/end into the
+    context trace (creating one if absent)."""
+
+    name = "task_profiler"
+
+    def install(self, context) -> "TaskProfiler":
+        super().install(context)
+        from .trace import Trace
+        if context.trace is None:
+            Trace().install(context)
+        self.trace = context.trace
+        return self
+
+    def report(self) -> Dict[str, Any]:
+        return self.trace.counts()
+
+
+class PrintSteals(PinsModule):
+    """mca/pins/print_steals analog: per-stream counts of tasks obtained
+    by stealing (from a VP peer or the system overflow queue). The
+    counters themselves are maintained by the local-queue schedulers in
+    ``es.stats["stolen"]``; this module snapshots and reports them."""
+
+    name = "print_steals"
+
+    def report(self) -> Dict[int, Dict[str, int]]:
+        return {es.th_id: {"selected": es.stats.get("selected", 0),
+                           "stolen": es.stats.get("stolen", 0)}
+                for es in self.context.streams}
+
+    def print(self) -> None:
+        for th_id, row in sorted(self.report().items()):
+            debug_verbose(0, "pins", "stream %d: %d selected, %d stolen",
+                          th_id, row["selected"], row["stolen"])
+
+
+class Alperf(PinsModule):
+    """mca/pins/alperf analog: per-task-class activity counters —
+    executions and cumulative body time."""
+
+    name = "alperf"
+
+    def install(self, context) -> "Alperf":
+        super().install(context)
+        self._lock = threading.Lock()
+        self._stats: Dict[str, Dict[str, float]] = defaultdict(
+            lambda: {"count": 0, "time_s": 0.0})
+        self._sub(PinsEvent.EXEC_BEGIN, self._begin)
+        self._sub(PinsEvent.EXEC_END, self._end)
+        return self
+
+    def _begin(self, es, task) -> None:
+        task.prof["alperf_t0"] = time.perf_counter()
+
+    def _end(self, es, task) -> None:
+        t0 = task.prof.pop("alperf_t0", None)
+        dt = 0.0 if t0 is None else time.perf_counter() - t0
+        with self._lock:
+            row = self._stats[task.task_class.name]
+            row["count"] += 1
+            row["time_s"] += dt
+
+    def report(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._stats.items()}
+
+
+class IteratorsChecker(PinsModule):
+    """mca/pins/iterators_checker analog: at release time, re-runs the
+    completed task's ``iterate_successors`` and validates every ref —
+    target class belongs to the same taskpool, the named flow exists,
+    the dep bit matches the flow, and (for PTG classes, where the task
+    space is closed-form) the target instance exists. Violations raise,
+    failing the run loudly the way the reference module aborts."""
+
+    name = "iterators_checker"
+
+    def install(self, context) -> "IteratorsChecker":
+        super().install(context)
+        self.checked = 0
+        self._lock = threading.Lock()
+        self._space_cache: Dict[Any, set] = {}
+        self._sub(PinsEvent.RELEASE_DEPS_BEGIN, self._check)
+        return self
+
+    def _space_of(self, tc) -> Optional[set]:
+        if not hasattr(tc, "enumerate_space"):
+            return None
+        with self._lock:
+            space = self._space_cache.get(tc)
+            if space is None:
+                space = self._space_cache[tc] = set(tc.enumerate_space())
+        return space
+
+    def _check(self, es, task) -> None:
+        from ..core.taskpool import DataRef, SuccessorRef
+        tc = task.task_class
+        # DTD successor lists are consumed-once runtime state, not a pure
+        # closed-form iterator — only PTG-style classes can be re-iterated
+        if not hasattr(tc, "enumerate_space"):
+            return
+        tp = task.taskpool
+        for ref in tc.iterate_successors(task):
+            if isinstance(ref, DataRef):
+                if ref.collection is None:
+                    raise AssertionError(
+                        f"{task!r}: DataRef with no collection")
+                continue
+            assert isinstance(ref, SuccessorRef)
+            dst = ref.task_class
+            if dst not in tp.task_classes:
+                raise AssertionError(
+                    f"{task!r} -> {dst.name}: class not in taskpool")
+            flow = dst.flow_by_name.get(ref.flow_name)
+            if flow is None:
+                raise AssertionError(
+                    f"{task!r} -> {dst.name}.{ref.flow_name}: no such flow")
+            if ref.dep_index != flow.index:
+                raise AssertionError(
+                    f"{task!r} -> {dst.name}.{ref.flow_name}: dep bit "
+                    f"{ref.dep_index} != flow index {flow.index}")
+            if len(ref.locals) != len(dst.params):
+                raise AssertionError(
+                    f"{task!r} -> {dst.name}{ref.locals}: arity "
+                    f"{len(ref.locals)} != {len(dst.params)} params")
+            space = self._space_of(dst)
+            if space is not None and tuple(ref.locals) not in space:
+                raise AssertionError(
+                    f"{task!r} -> {dst.name}{tuple(ref.locals)}: target "
+                    f"instance outside the task space")
+        with self._lock:
+            self.checked += 1
+
+    def report(self) -> Dict[str, int]:
+        return {"tasks_checked": self.checked}
+
+
+_MODULES = {
+    "task_profiler": TaskProfiler,
+    "print_steals": PrintSteals,
+    "alperf": Alperf,
+    "iterators_checker": IteratorsChecker,
+}
+
+
+def available() -> List[str]:
+    return sorted(_MODULES)
+
+
+def new_module(name: str) -> PinsModule:
+    try:
+        return _MODULES[name]()
+    except KeyError:
+        raise ValueError(f"unknown PINS module {name!r}; have {available()}")
+
+
+def install_selected(context) -> List[PinsModule]:
+    """Install the modules named by the ``pins`` MCA param
+    (mca/pins/pins_init.c analog)."""
+    spec = str(mca_param.get("pins", "") or "")
+    mods = []
+    for name in filter(None, (s.strip() for s in spec.split(","))):
+        mods.append(new_module(name).install(context))
+    return mods

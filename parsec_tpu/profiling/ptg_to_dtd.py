@@ -1,0 +1,200 @@
+"""Replay a PTG taskpool through the DTD front end.
+
+Reference: parsec/mca/pins/ptg_to_dtd (601 LoC) — a correctness
+cross-check that takes a compiled PTG DAG and re-executes it through the
+dynamic-task-discovery interface, validating that both front ends drive
+the runtime to the same result.
+
+Here the replay uses the PTG class's closed-form structure directly:
+
+1. enumerate every task instance of every class and topologically order
+   them (Kahn) over ``iterate_successors`` edges **plus write-after-read
+   edges**: PTG values travel with activations, so a reader and the
+   tile's next writer are unordered in the dataflow DAG — but DTD
+   discovers dependencies from *insertion order* over tiles, so a reader
+   inserted after the next writer would see the wrong version. Each
+   reader is therefore ordered before the tile's first writer that
+   follows the reader's producer;
+2. for each task, form one :class:`~parsec_tpu.dsl.dtd.TileArg` per flow
+   from the flow's ``tile`` placement (``FlowSpec.tile`` — the JDF data
+   annotation) with the flow's access mode, and insert the class's body.
+
+DTD's tile tracking then rebuilds the same RAW/WAW dependency structure
+the PTG expressions encode, and ``flush()`` writes the tiles back —
+running the identical bodies through a completely different discovery
+path. Tests compare the resulting collection contents against a PTG run.
+
+Requirements on the PTG taskpool: every flow declares ``tile``, no CTL
+flows (DTD has no control-only arguments), no NEW inputs and no
+reshapes — i.e. the same class of taskpools the compiled wavefront
+executor accepts. Bodies receive a :class:`_ReplayTask` shim as their
+``task`` argument carrying ``task_class`` and ``locals`` (the identity
+fields bodies legitimately read); runtime-private Task state is absent.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict, deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..core.task import FlowAccess, Task
+from ..core.taskpool import DataRef
+from ..dsl import dtd as dtd_mod
+from ..dsl import ptg as ptg_mod
+
+_Key = Tuple[str, Tuple[int, ...]]
+
+
+class _ReplayTask:
+    """Stand-in for the Task handed to PTG bodies during DTD replay."""
+
+    __slots__ = ("task_class", "locals")
+
+    def __init__(self, task_class, locals: Tuple[int, ...]):
+        self.task_class = task_class
+        self.locals = tuple(locals)
+
+    def __repr__(self) -> str:
+        return f"{self.task_class.name}{self.locals}"
+
+
+def _kahn(keys, succs, indeg) -> List[_Key]:
+    indeg = dict(indeg)
+    queue = deque(k for k in keys if indeg[k] == 0)
+    order = []
+    while queue:
+        k = queue.popleft()
+        order.append(k)
+        for dst in succs.get(k, ()):
+            indeg[dst] -= 1
+            if indeg[dst] == 0:
+                queue.append(dst)
+    return order
+
+
+def topo_order(tp: ptg_mod.Taskpool) -> List[Tuple[ptg_mod.PTGTaskClass,
+                                                   Tuple[int, ...]]]:
+    """Topologically order the full task space over dataflow edges plus
+    per-tile WAR edges, producing a valid sequential *program order* for
+    tile-granular discovery (DTD insertion)."""
+    g = tp.g
+    by_key: Dict[_Key, Tuple] = {}
+    succs: Dict[_Key, List[_Key]] = defaultdict(list)
+    indeg: Dict[_Key, int] = {}
+    for tc in tp.task_classes:
+        for p in tc.enumerate_space():
+            by_key[(tc.name, p)] = (tc, p)
+            indeg[(tc.name, p)] = 0
+
+    def add_edge(a: _Key, b: _Key) -> None:
+        if a != b:
+            succs[a].append(b)
+            indeg[b] += 1
+
+    for tc, p in by_key.values():
+        probe = Task(tp, tc, p)
+        for f in tc.flows:
+            probe.data[f.name] = 0
+            probe.output[f.name] = 0
+        for ref in tc.iterate_successors(probe):
+            if isinstance(ref, DataRef):
+                continue
+            add_edge((tc.name, p), (ref.task_class.name, tuple(ref.locals)))
+
+    base = _kahn(by_key, succs, indeg)
+    if len(base) != len(by_key):
+        raise RuntimeError("PTG taskpool has a dependency cycle")
+    pos = {k: i for i, k in enumerate(base)}
+
+    # Per-tile access lists → WAR edges. A reader of version v (produced
+    # by task P, or the initial collection value when its In is a data
+    # read, treated as position -1) must precede the first writer of the
+    # tile positioned after P.
+    writers: Dict[Tuple, List[_Key]] = defaultdict(list)
+    readers: Dict[Tuple, List[Tuple[_Key, int]]] = defaultdict(list)
+    for tc, p in by_key.values():
+        for f, spec in zip(tc.flows, tc.spec_list):
+            if f.is_ctl or spec.tile is None:
+                continue
+            dc, key = spec.tile(g, *p)
+            key = tuple(key) if isinstance(key, (tuple, list)) else (key,)
+            tile = (id(dc), key)
+            if f.access & FlowAccess.WRITE:
+                writers[tile].append((tc.name, p))
+            dep = tc._active_in(g, spec, p)
+            if dep is None:
+                continue
+            producer_pos = -1
+            if dep.src is not None:
+                src_cls, src_params_fn, _sf = dep.src
+                sp = src_params_fn(g, *p)
+                sp = tuple(sp) if isinstance(sp, (tuple, list)) else (sp,)
+                producer_pos = pos[(src_cls, tuple(sp))]
+            readers[tile].append(((tc.name, p), producer_pos))
+
+    war_added = False
+    for tile, rlist in readers.items():
+        wchain = sorted(writers.get(tile, ()), key=pos.__getitem__)
+        wpos = [pos[w] for w in wchain]
+        for rkey, producer_pos in rlist:
+            # first writer strictly after the version's producer
+            for w, wp in zip(wchain, wpos):
+                if wp > producer_pos:
+                    if w != rkey:
+                        add_edge(rkey, w)
+                        war_added = True
+                    break
+
+    if war_added:
+        base = _kahn(by_key, succs, indeg)
+        if len(base) != len(by_key):
+            raise RuntimeError(
+                "PTG taskpool has no valid sequential order: WAR edges "
+                "close a cycle (conflicting writers of one tile?)")
+    return [by_key[k] for k in base]
+
+
+def replay_ptg_through_dtd(tp: ptg_mod.Taskpool, context,
+                           name: Optional[str] = None) -> dtd_mod.Taskpool:
+    """Execute PTG taskpool ``tp``'s DAG through the DTD interface on
+    ``context``; returns the drained DTD taskpool (tiles flushed back to
+    their collections). ``tp`` itself is never enqueued."""
+    if ptg_mod.taskpool_uses_reshape(tp):
+        raise ValueError("ptg_to_dtd replay cannot carry reshape specs")
+    for tc in tp.task_classes:
+        for f, spec in zip(tc.flows, tc.spec_list):
+            if f.is_ctl:
+                raise ValueError(
+                    f"{tc.name}.{f.name}: CTL flows cannot replay via DTD")
+            if spec.tile is None:
+                raise ValueError(
+                    f"{tc.name}.{f.name}: flow needs a tile placement")
+            if any(d.new is not None for d in spec.ins):
+                raise ValueError(
+                    f"{tc.name}.{f.name}: NEW inputs cannot replay via DTD")
+
+    dtd_tp = dtd_mod.Taskpool(name or f"{tp.name}-via-dtd")
+    context.add_taskpool(dtd_tp)
+
+    # one wrapper per class so DTD's lazy (fn, shape) class cache reuses
+    # classes instead of minting one per insert; the task's locals arrive
+    # as a leading ValueArg and are rewrapped into a _ReplayTask shim
+    bodies: Dict[str, Callable] = {}
+    for tc in tp.task_classes:
+        hook = tc.incarnations[0].hook
+
+        def fn(locals_, *tiles, _h=hook, _tc=tc):
+            return _h(_ReplayTask(_tc, locals_), *tiles)
+
+        fn.__name__ = f"{tc.name}_dtd"
+        bodies[tc.name] = fn
+
+    g = tp.g
+    for tc, p in topo_order(tp):
+        args = [dtd_mod.TileArg(*spec.tile(g, *p), access=f.access)
+                for f, spec in zip(tc.flows, tc.spec_list)]
+        dtd_tp.insert_task(bodies[tc.name], dtd_mod.ValueArg(tuple(p)),
+                           *args, priority=tc.priority_fn(p))
+    dtd_tp.flush()
+    dtd_tp.wait()
+    return dtd_tp

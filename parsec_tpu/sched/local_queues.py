@@ -106,8 +106,12 @@ class _LocalQueueScheduler(Scheduler):
                 continue
             t = self._steal(peer.sched_obj)
             if t is not None:
+                es.stats["stolen"] += 1     # pins/print_steals counter
                 return t
-        return self.system.pop_front()
+        t = self.system.pop_front()
+        if t is not None:
+            es.stats["stolen"] += 1
+        return t
 
     def _steal_order(self, es):
         return vp_peers(es)
