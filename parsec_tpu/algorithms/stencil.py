@@ -1,0 +1,82 @@
+"""1D stencil with halo exchange as a PTG taskpool.
+
+Reference: tests/apps/stencil/stencil_1D.jdf — the canonical halo-chain
+dataflow pattern (each timestep's task consumes its neighbors' previous
+values), which SURVEY §5 identifies as the reference's nearest analog of
+sequence/context-parallel long-context execution: the halo flows are the
+ring edges, and over a multi-rank block distribution the activations
+carry exactly the neighbor slices a ring-attention step would.
+
+Radius-1 Jacobi form: ``X[t,i] = w·(X[t-1,i-1] + X[t-1,i] + X[t-1,i+1])``
+with reflected (absent-neighbor-skipped) boundaries. Tiles may be scalars
+or arrays — the body only needs ``+`` and ``*``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..dsl import ptg
+from ..data.collection import DataCollection
+
+
+def build_stencil_1d(X: DataCollection, n_tiles: int, timesteps: int,
+                     weight: float = 1.0 / 3.0) -> ptg.Taskpool:
+    """Stencil taskpool over collection ``X`` keyed ``(i,)`` for
+    ``i in range(n_tiles)``; runs ``timesteps`` sweeps and writes the
+    final values back (stencil_1D.jdf analog)."""
+    tp = ptg.Taskpool("stencil1d", X=X, N=n_tiles, T=timesteps, w=weight)
+
+    S = tp.task_class(
+        "S", params=("t", "i"),
+        space=lambda g: ((t, i) for t in range(g.T) for i in range(g.N)),
+        affinity=lambda g, t, i: (g.X, (i,)),
+        # earlier timesteps first keeps the wavefront narrow
+        priority=lambda g, t, i: g.T - t,
+        flows=[
+            # west halo: neighbor i-1's previous value
+            ptg.FlowSpec(
+                "L", ptg.READ,
+                tile=lambda g, t, i: (g.X, (max(i - 1, 0),)),
+                ins=[ptg.In(data=lambda g, t, i: (g.X, (i - 1,)),
+                            guard=lambda g, t, i: t == 0 and i > 0),
+                     ptg.In(src=("S", lambda g, t, i: (t - 1, i - 1), "C"),
+                            guard=lambda g, t, i: t > 0 and i > 0)]),
+            # center
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                tile=lambda g, t, i: (g.X, (i,)),
+                ins=[ptg.In(data=lambda g, t, i: (g.X, (i,)),
+                            guard=lambda g, t, i: t == 0),
+                     ptg.In(src=("S", lambda g, t, i: (t - 1, i), "C"),
+                            guard=lambda g, t, i: t > 0)],
+                outs=[
+                    ptg.Out(dst=("S", lambda g, t, i: (t + 1, i), "C"),
+                            guard=lambda g, t, i: t < g.T - 1),
+                    ptg.Out(dst=("S", lambda g, t, i: (t + 1, i + 1), "L"),
+                            guard=lambda g, t, i: t < g.T - 1 and
+                            i + 1 < g.N),
+                    ptg.Out(dst=("S", lambda g, t, i: (t + 1, i - 1), "R"),
+                            guard=lambda g, t, i: t < g.T - 1 and i > 0),
+                    ptg.Out(data=lambda g, t, i: (g.X, (i,)),
+                            guard=lambda g, t, i: t == g.T - 1)]),
+            # east halo
+            ptg.FlowSpec(
+                "R", ptg.READ,
+                tile=lambda g, t, i: (g.X, (min(i + 1, g.N - 1),)),
+                ins=[ptg.In(data=lambda g, t, i: (g.X, (i + 1,)),
+                            guard=lambda g, t, i: t == 0 and i < g.N - 1),
+                     ptg.In(src=("S", lambda g, t, i: (t - 1, i + 1), "C"),
+                            guard=lambda g, t, i: t > 0 and i < g.N - 1)]),
+        ])
+
+    w = weight
+
+    @S.body
+    def s_body(task, L, C, R):
+        # boundary tasks have no active halo dep — reflect by reusing C
+        left = C if L is None else L
+        right = C if R is None else R
+        return (left + C + right) * w
+
+    return tp

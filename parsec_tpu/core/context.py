@@ -196,6 +196,11 @@ class Context:
         if self.comm is not None:
             self.comm.disable()
         self.scheduler.remove(self)
+        # MCA-selected PINS modules report at component close then detach
+        # (reference modules print their data in their _fini)
+        for mod in self.pins_modules:
+            debug_verbose(2, "pins", "%s: %s", mod.name, mod.report())
+            mod.uninstall()
         debug_verbose(3, "context", "context down; stats=%s",
                       {es.th_id: es.stats for es in self.streams})
 

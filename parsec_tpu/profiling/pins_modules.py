@@ -64,10 +64,19 @@ class TaskProfiler(PinsModule):
     def install(self, context) -> "TaskProfiler":
         super().install(context)
         from .trace import Trace
+        self._installed_trace = context.trace is None
         if context.trace is None:
             Trace().install(context)
         self.trace = context.trace
+        # Trace.install registered this outside our bookkeeping — adopt it
+        # so uninstall() actually stops the event flow
+        self._subs.append((PinsEvent.EXEC_BEGIN, self.trace.task_begin))
         return self
+
+    def uninstall(self) -> None:
+        super().uninstall()
+        if self._installed_trace and self.context.trace is self.trace:
+            self.context.trace = None   # stop task_complete recording too
 
     def report(self) -> Dict[str, Any]:
         return self.trace.counts()

@@ -1,0 +1,262 @@
+"""Mini-workload apps (reference tests/apps/): stencil_1D, pingpong,
+all2all, merge_sort, haar_tree, generalized_reduction — each a small DAG
+exercising a distinct dataflow shape through a front end."""
+
+import numpy as np
+import pytest
+
+import parsec_tpu as parsec
+from parsec_tpu.dsl import dtd, ptg
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.algorithms.stencil import build_stencil_1d
+
+
+# --------------------------------------------------------------- stencil
+def _stencil_ref(x, steps, w):
+    for _ in range(steps):
+        left = np.concatenate([x[:1], x[:-1]])
+        right = np.concatenate([x[1:], x[-1:]])
+        x = (left + x + right) * w
+    return x
+
+
+def test_stencil_1d(ctx):
+    """tests/apps/stencil/stencil_1D.jdf analog: radius-1 halo chain."""
+    n, steps, w = 16, 5, 1.0 / 3.0
+    x0 = np.arange(n, dtype=np.float64)
+    X = LocalCollection("X", {(i,): x0[i] for i in range(n)})
+    ctx.add_taskpool(build_stencil_1d(X, n, steps, w))
+    assert ctx.wait(timeout=60)
+    got = np.array([X.data_of((i,)) for i in range(n)])
+    # bodies may run through the jax device (f32) — tolerance accordingly
+    np.testing.assert_allclose(got, _stencil_ref(x0, steps, w), rtol=1e-5)
+
+
+def test_stencil_1d_checker():
+    X = LocalCollection("X", {(i,): 0.0 for i in range(6)})
+    ptg.check_taskpool(build_stencil_1d(X, 6, 4))
+
+
+# -------------------------------------------------------------- pingpong
+def test_pingpong(ctx):
+    """tests/apps/pingpong analog: a value bounces PING→PONG N times,
+    each touch increments it."""
+    n = 25
+    S = LocalCollection("S", {("ball",): 0})
+    tp = ptg.Taskpool("pingpong", N=n, S=S)
+    tp.task_class(
+        "PING", params=("i",),
+        space=lambda g: ((i,) for i in range(g.N)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            tile=lambda g, i: (g.S, ("ball",)),
+            ins=[ptg.In(data=lambda g, i: (g.S, ("ball",)),
+                        guard=lambda g, i: i == 0),
+                 ptg.In(src=("PONG", lambda g, i: (i - 1,), "X"),
+                        guard=lambda g, i: i > 0)],
+            outs=[ptg.Out(dst=("PONG", lambda g, i: (i,), "X"))])])
+    tp.task_class(
+        "PONG", params=("i",),
+        space=lambda g: ((i,) for i in range(g.N)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            tile=lambda g, i: (g.S, ("ball",)),
+            ins=[ptg.In(src=("PING", lambda g, i: (i,), "X"))],
+            outs=[ptg.Out(dst=("PING", lambda g, i: (i + 1,), "X"),
+                          guard=lambda g, i: i < g.N - 1),
+                  ptg.Out(data=lambda g, i: (g.S, ("ball",)),
+                          guard=lambda g, i: i == g.N - 1)])])
+
+    @tp.get_task_class("PING").body
+    def ping(task, x):
+        return x + 1
+
+    @tp.get_task_class("PONG").body
+    def pong(task, x):
+        return x + 1
+
+    ptg.check_taskpool(tp)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=60)
+    assert S.data_of(("ball",)) == 2 * n
+
+
+# --------------------------------------------------------------- all2all
+def test_all2all(ctx):
+    """tests/apps/all2all analog: every source feeds every receiver;
+    receiver j gathers along a chain R(j,0..N-1)."""
+    n = 6
+    src = LocalCollection("src", {(i,): [10 * i + j for j in range(n)]
+                                  for i in range(n)})
+    out = LocalCollection("out", {(j,): None for j in range(n)})
+    tp = ptg.Taskpool("all2all", N=n, SRC=src, OUT=out)
+    tp.task_class(
+        "S", params=("i",),
+        space=lambda g: ((i,) for i in range(g.N)),
+        flows=[ptg.FlowSpec(
+            "V", ptg.RW,
+            tile=lambda g, i: (g.SRC, (i,)),
+            ins=[ptg.In(data=lambda g, i: (g.SRC, (i,)))],
+            outs=[ptg.Out(dst=("R", lambda g, i: [(j, i) for j in range(g.N)],
+                               "V"))])])
+    tp.task_class(
+        "R", params=("j", "k"),
+        space=lambda g: ((j, k) for j in range(g.N) for k in range(g.N)),
+        flows=[
+            ptg.FlowSpec(
+                "V", ptg.READ,
+                tile=lambda g, j, k: (g.SRC, (k,)),
+                ins=[ptg.In(src=("S", lambda g, j, k: (k,), "V"))]),
+            ptg.FlowSpec(
+                "ACC", ptg.RW,
+                tile=lambda g, j, k: (g.OUT, (j,)),
+                ins=[ptg.In(new=lambda g, j, k: [],
+                            guard=lambda g, j, k: k == 0),
+                     ptg.In(src=("R", lambda g, j, k: (j, k - 1), "ACC"),
+                            guard=lambda g, j, k: k > 0)],
+                outs=[ptg.Out(dst=("R", lambda g, j, k: (j, k + 1), "ACC"),
+                              guard=lambda g, j, k: k < g.N - 1),
+                      ptg.Out(data=lambda g, j, k: (g.OUT, (j,)),
+                              guard=lambda g, j, k: k == g.N - 1)])])
+
+    @tp.get_task_class("S").body_cpu
+    def s_body(task, v):
+        return {"V": v}     # dict form: a bare list would be read as
+                            # one-value-per-output-flow
+
+    @tp.get_task_class("R").body_cpu
+    def r_body(task, v, acc):
+        j = task.locals[0]
+        return {"ACC": acc + [v[j]]}
+
+    ptg.check_taskpool(tp)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=60)
+    for j in range(n):
+        assert out.data_of((j,)) == [10 * k + j for k in range(n)]
+
+
+# ------------------------------------------------------------ merge sort
+def test_merge_sort_dtd(ctx, rng):
+    """tests/apps/merge_sort analog through DTD: leaves sort chunks,
+    internal nodes merge — a reduction tree discovered at insertion."""
+    levels, chunk = 3, 8
+    n_leaves = 1 << levels
+    data = rng.integers(0, 1000, size=n_leaves * chunk)
+    C = LocalCollection(
+        "C", {(l, i): None for l in range(levels + 1)
+              for i in range(n_leaves >> l)})
+    for i in range(n_leaves):
+        C.write_tile((0, i), np.array(data[i * chunk:(i + 1) * chunk]))
+
+    tp = dtd.Taskpool("msort")
+    ctx.add_taskpool(tp)
+
+    def sort_leaf(x):
+        return np.sort(x)
+
+    def merge(a, b, out):
+        return np.sort(np.concatenate([a, b]), kind="mergesort")
+
+    for i in range(n_leaves):
+        tp.insert_task(sort_leaf, dtd.TileArg(C, (0, i), dtd.INOUT))
+    for l in range(1, levels + 1):
+        for i in range(n_leaves >> l):
+            tp.insert_task(
+                merge,
+                dtd.TileArg(C, (l - 1, 2 * i), dtd.INPUT),
+                dtd.TileArg(C, (l - 1, 2 * i + 1), dtd.INPUT),
+                dtd.TileArg(C, (l, i), dtd.OUTPUT))
+    tp.flush()
+    tp.wait()
+    np.testing.assert_array_equal(C.data_of((levels, 0)), np.sort(data))
+
+
+# -------------------------------------------------------------- haar tree
+def test_haar_tree_dtd(ctx):
+    """tests/apps/haar_tree analog: dynamic binary wavelet tree — each
+    node averages its children and emits the detail coefficient."""
+    depth = 4
+    n = 1 << depth
+    vals = np.arange(n, dtype=np.float64)
+    C = LocalCollection(
+        "H", {(l, i): None for l in range(depth + 1)
+              for i in range(n >> l)})
+    D = LocalCollection(
+        "D", {(l, i): None for l in range(1, depth + 1)
+              for i in range(n >> l)})
+    for i in range(n):
+        C.write_tile((0, i), vals[i])
+
+    tp = dtd.Taskpool("haar")
+    ctx.add_taskpool(tp)
+
+    def haar(a, b, avg_out, det_out):
+        return (a + b) / 2.0, (a - b) / 2.0
+
+    for l in range(1, depth + 1):
+        for i in range(n >> l):
+            tp.insert_task(
+                haar,
+                dtd.TileArg(C, (l - 1, 2 * i), dtd.INPUT),
+                dtd.TileArg(C, (l - 1, 2 * i + 1), dtd.INPUT),
+                dtd.TileArg(C, (l, i), dtd.OUTPUT),
+                dtd.TileArg(D, (l, i), dtd.OUTPUT))
+    tp.flush()
+    tp.wait()
+    assert C.data_of((depth, 0)) == pytest.approx(vals.mean())
+    # detail at the root: mean(first half) - mean(second half), halved
+    assert D.data_of((depth, 0)) == pytest.approx(
+        (vals[:n // 2].mean() - vals[n // 2:].mean()) / 2.0)
+
+
+# ------------------------------------------------- generalized reduction
+def test_generalized_reduction(ctx):
+    """tests/apps/generalized_reduction analog: binary-tree PTG reduction
+    with a NON-commutative operator — order must be preserved."""
+    depth = 3
+    n = 1 << depth
+    leaves = LocalCollection("L", {(i,): [i] for i in range(n)})
+    out = LocalCollection("O", {("root",): None})
+    tp = ptg.Taskpool("genred", D=depth, N=n, L=leaves, O=out)
+    tp.task_class(
+        "RED", params=("l", "i"),
+        space=lambda g: ((l, i) for l in range(1, g.D + 1)
+                         for i in range(g.N >> l)),
+        flows=[
+            ptg.FlowSpec(
+                "A", ptg.READ,
+                tile=lambda g, l, i: (g.L, (2 * i,)),
+                ins=[ptg.In(data=lambda g, l, i: (g.L, (2 * i,)),
+                            guard=lambda g, l, i: l == 1),
+                     ptg.In(src=("RED", lambda g, l, i: (l - 1, 2 * i), "C"),
+                            guard=lambda g, l, i: l > 1)]),
+            ptg.FlowSpec(
+                "B", ptg.READ,
+                tile=lambda g, l, i: (g.L, (2 * i + 1,)),
+                ins=[ptg.In(data=lambda g, l, i: (g.L, (2 * i + 1,)),
+                            guard=lambda g, l, i: l == 1),
+                     ptg.In(src=("RED", lambda g, l, i: (l - 1, 2 * i + 1),
+                                 "C"),
+                            guard=lambda g, l, i: l > 1)]),
+            ptg.FlowSpec(
+                "C", ptg.WRITE,
+                tile=lambda g, l, i: (g.O, ("root",)),
+                outs=[
+                    ptg.Out(dst=("RED",
+                                 lambda g, l, i: (l + 1, i // 2), "A"),
+                            guard=lambda g, l, i: l < g.D and i % 2 == 0),
+                    ptg.Out(dst=("RED",
+                                 lambda g, l, i: (l + 1, i // 2), "B"),
+                            guard=lambda g, l, i: l < g.D and i % 2 == 1),
+                    ptg.Out(data=lambda g, l, i: (g.O, ("root",)),
+                            guard=lambda g, l, i: l == g.D)])])
+
+    @tp.get_task_class("RED").body_cpu
+    def red(task, a, b, c):
+        return {"C": a + b}   # list concat: non-commutative
+
+    ptg.check_taskpool(tp)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=60)
+    assert out.data_of(("root",)) == [i for i in range(n)]

@@ -146,9 +146,8 @@ def test_ptg_to_dtd_replay_orders_war(ctx):
         return x * 2
 
     # topo_order must place R before W via the WAR edge
-    order = [f"{tc.name}{p}" for tc, p in
-             __import__("parsec_tpu.profiling.ptg_to_dtd",
-                        fromlist=["topo_order"]).topo_order(tp)]
+    from parsec_tpu.profiling.ptg_to_dtd import topo_order
+    order = [f"{tc.name}{p}" for tc, p in topo_order(tp)]
     assert order.index("R(0,)") < order.index("W(0,)")
 
     replay_ptg_through_dtd(tp, ctx)
