@@ -42,6 +42,44 @@ class CommEngine:
         self.nb_ranks = nb_ranks
         self._am_callbacks: Dict[int, Callable] = {}
         self._enabled = False
+        # flying-message counters (remote_dep.h:355-365 analog) — SDE
+        # gauges and the comm trace read these
+        self.stats = {"activations_sent": 0, "activations_recv": 0,
+                      "bytes_sent": 0, "bytes_recv": 0}
+        self._stats_lock = threading.Lock()
+        self._trace = None
+
+    # -- instrumentation (profiling msg-size info, remote_dep.h:374-384) --
+    def install_trace(self, trace) -> None:
+        """Attach a profiling.trace.Trace: every activation send/recv is
+        recorded with its payload size (the reference's MPI_ACTIVATE
+        events + msg_size info struct that check-comms.py asserts on)."""
+        self._trace = trace
+
+    @staticmethod
+    def payload_bytes(value: Any) -> int:
+        """Best-effort payload size of an activation value."""
+        if value is None:
+            return 0
+        nb = getattr(value, "nbytes", None)
+        if nb is not None:
+            return int(nb)
+        if isinstance(value, (bytes, bytearray)):
+            return len(value)
+        return 0
+
+    def record_msg(self, direction: str, kind: str, peer: int,
+                   nbytes: int) -> None:
+        with self._stats_lock:
+            if direction == "sent":
+                self.stats["activations_sent"] += 1
+                self.stats["bytes_sent"] += nbytes
+            else:
+                self.stats["activations_recv"] += 1
+                self.stats["bytes_recv"] += nbytes
+        if self._trace is not None:
+            self._trace.event(f"comm_{kind}", direction, stream_id=-1,
+                              object_id=peer, info={"msg_size": nbytes})
 
     # -- lifecycle --------------------------------------------------------
     def enable(self) -> None:

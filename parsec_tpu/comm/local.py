@@ -134,6 +134,8 @@ class LocalCommEngine(CommEngine):
                "locals": ref.locals, "flow": ref.flow_name,
                "dep_index": ref.dep_index, "priority": ref.priority,
                "value": ref.value}
+        self.record_msg("sent", "activate", target_rank,
+                        self.payload_bytes(ref.value))
         self.send_am(AMTag.ACTIVATE, target_rank, msg)
         monitor.outgoing_message_end(target_rank)
 
@@ -154,6 +156,8 @@ class LocalCommEngine(CommEngine):
                         (src_rank, msg))
                     return
             tp.monitor.incoming_message_start(src_rank)
+            self.record_msg("recv", "activate", src_rank,
+                            self.payload_bytes(msg["value"]))
             tc = tp.get_task_class(msg["class"])
             ref = SuccessorRef(task_class=tc, locals=tuple(msg["locals"]),
                                flow_name=msg["flow"], value=msg["value"],

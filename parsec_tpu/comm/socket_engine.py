@@ -55,12 +55,10 @@ _HDR = struct.Struct("!Q")     # frame length prefix
 
 
 def _approx_nbytes(value: Any) -> int:
-    nb = getattr(value, "nbytes", None)
-    if nb is not None:
-        return int(nb)
-    if isinstance(value, (bytes, bytearray)):
-        return len(value)
-    return 64
+    """Payload size for the eager/rendezvous decision and the symmetric
+    send/recv byte counters (same estimator on both ends)."""
+    from .engine import CommEngine
+    return CommEngine.payload_bytes(value)
 
 
 class _WaveState:
@@ -380,12 +378,14 @@ class SocketCommEngine(CommEngine):
                "locals": tuple(ref.locals), "flow": ref.flow_name,
                "dep_index": ref.dep_index, "priority": ref.priority}
         value = ref.value
+        nbytes = _approx_nbytes(value)
         eager_limit = int(mca_param.get("comm.eager_limit", 256 * 1024))
-        if value is not None and _approx_nbytes(value) > eager_limit:
+        if value is not None and nbytes > eager_limit:
             msg["value_handle"] = self.mem_register(value)
-            msg["nbytes"] = _approx_nbytes(value)
+            msg["nbytes"] = nbytes
         else:
             msg["value"] = value
+        self.record_msg("sent", "activate", target_rank, nbytes)
         self._cmd_q.put(("activate", target_rank, msg))
         monitor.outgoing_message_end(target_rank)
 
@@ -423,6 +423,9 @@ class SocketCommEngine(CommEngine):
     def _deliver_activation(self, tp, src: int, msg: Dict) -> None:
         from ..core.taskpool import SuccessorRef
         self._stats["activations_recv"] += 1
+        self.record_msg("recv", "activate", src,
+                        msg.get("nbytes",
+                                self.payload_bytes(msg.get("value"))))
         tp.monitor.incoming_message_start(src)
         if "value_handle" in msg:
             # rendezvous: allocate the receive slot, GET the payload, and
@@ -586,5 +589,8 @@ class SocketCommEngine(CommEngine):
         else:
             self._barrier_release.set()
 
-    def stats(self) -> Dict[str, int]:
+    def wire_stats(self) -> Dict[str, int]:
+        """Frame-level wire counters (header+payload bytes on the socket);
+        payload-level activation counters live in the base ``stats`` dict
+        shared with every engine (remote_dep.h:355-365 analog)."""
         return dict(self._stats)

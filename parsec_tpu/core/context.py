@@ -198,8 +198,10 @@ class Context:
         self.scheduler.remove(self)
         # MCA-selected PINS modules report at component close then detach
         # (reference modules print their data in their _fini)
+        from ..utils.debug import get_verbosity
         for mod in self.pins_modules:
-            debug_verbose(2, "pins", "%s: %s", mod.name, mod.report())
+            if get_verbosity() >= 2:    # report() can scan the full trace
+                debug_verbose(2, "pins", "%s: %s", mod.name, mod.report())
             mod.uninstall()
         debug_verbose(3, "context", "context down; stats=%s",
                       {es.th_id: es.stats for es in self.streams})

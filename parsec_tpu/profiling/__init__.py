@@ -16,3 +16,6 @@ from .pins_modules import TaskProfiler, PrintSteals, Alperf, \
 from .trace import Trace
 from .grapher import Grapher
 from .ptg_to_dtd import replay_ptg_through_dtd
+from .dictionary import PropertiesDictionary, install_runtime_properties
+from .sde import SDERegistry, global_registry, install_runtime_counters
+from .sim import SimReport, simulate

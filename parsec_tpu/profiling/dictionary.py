@@ -1,0 +1,85 @@
+"""Runtime properties dictionary (reference parsec/dictionary.c, 943 LoC).
+
+The reference exports a tree of namespaces/task-class properties backed by
+live provider functions, published to shared memory so external monitors
+can sample the runtime online. Here the dictionary is an in-process
+registry of ``namespace → property → provider()``; :meth:`snapshot`
+samples everything, and :func:`install_runtime_properties` wires the
+standard namespaces (context, scheduler, devices, comm, taskpools) the
+reference registers at init.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional
+
+
+class PropertiesDictionary:
+    """Namespaced registry of live runtime properties."""
+
+    def __init__(self) -> None:
+        self._ns: Dict[str, Dict[str, Callable[[], Any]]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, namespace: str, name: str,
+                 provider: Callable[[], Any]) -> None:
+        with self._lock:
+            self._ns.setdefault(namespace, {})[name] = provider
+
+    def unregister(self, namespace: str, name: Optional[str] = None) -> None:
+        with self._lock:
+            if name is None:
+                self._ns.pop(namespace, None)
+            else:
+                self._ns.get(namespace, {}).pop(name, None)
+
+    def namespaces(self):
+        with self._lock:
+            return sorted(self._ns)
+
+    def properties(self, namespace: str):
+        with self._lock:
+            return sorted(self._ns.get(namespace, {}))
+
+    def query(self, namespace: str, name: str) -> Any:
+        with self._lock:
+            provider = self._ns[namespace][name]
+        return provider()
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Sample every property once (the online-monitoring read)."""
+        with self._lock:
+            items = {ns: dict(props) for ns, props in self._ns.items()}
+        out: Dict[str, Dict[str, Any]] = {}
+        for ns, props in items.items():
+            out[ns] = {}
+            for name, provider in props.items():
+                try:
+                    out[ns][name] = provider()
+                except Exception as exc:  # provider died — report, not raise
+                    out[ns][name] = f"<error: {exc}>"
+        return out
+
+
+def install_runtime_properties(context) -> PropertiesDictionary:
+    """Register the standard namespaces over a live context (the set the
+    reference's dictionary.c publishes at parsec_init)."""
+    d = PropertiesDictionary()
+    d.register("context", "nb_cores", lambda: context.nb_cores)
+    d.register("context", "nb_ranks", lambda: context.nb_ranks)
+    d.register("context", "my_rank", lambda: context.my_rank)
+    d.register("context", "active_taskpools",
+               lambda: len(context._active_taskpools))
+    d.register("sched", "name", lambda: context.scheduler.name)
+    d.register("sched", "pending_tasks",
+               lambda: context.scheduler.pending_tasks())
+    for es in context.streams:
+        d.register("streams", f"es{es.th_id}", lambda es=es: dict(es.stats))
+    for dev in context.devices.devices:
+        d.register("device", dev.name,
+                   lambda dev=dev: dev.dump_statistics())
+    if context.comm is not None:
+        d.register("comm", "stats", lambda: dict(context.comm.stats))
+    context.properties = d
+    return d

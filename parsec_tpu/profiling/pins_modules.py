@@ -68,9 +68,11 @@ class TaskProfiler(PinsModule):
         if context.trace is None:
             Trace().install(context)
         self.trace = context.trace
-        # Trace.install registered this outside our bookkeeping — adopt it
-        # so uninstall() actually stops the event flow
-        self._subs.append((PinsEvent.EXEC_BEGIN, self.trace.task_begin))
+        if self._installed_trace:
+            # Trace.install registered this outside our bookkeeping — adopt
+            # it so uninstall() stops the event flow; a user-installed
+            # trace keeps its own subscription
+            self._subs.append((PinsEvent.EXEC_BEGIN, self.trace.task_begin))
         return self
 
     def uninstall(self) -> None:

@@ -1,0 +1,92 @@
+"""Software-defined-event counters (reference parsec/papi_sde.c, 264 LoC
++ the per-scheduler pending-task gauges each sched component registers).
+
+The reference exports runtime counters through PAPI-SDE so external PAPI
+consumers can read them. Here :class:`SDERegistry` holds *counters*
+(monotonic, incremented by the runtime) and *gauges* (sampled provider
+functions); ``read()`` returns the merged live view. A process-global
+registry mirrors PAPI-SDE's global handle; contexts register their
+standard gauges at :func:`install_runtime_counters`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Union
+
+
+class SDERegistry:
+    def __init__(self) -> None:
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, Callable[[], Any]] = {}
+        self._lock = threading.Lock()
+
+    # -- counters (monotonic, runtime-incremented) ------------------------
+    def register_counter(self, name: str, initial: float = 0) -> None:
+        with self._lock:
+            self._counters.setdefault(name, initial)
+
+    def add(self, name: str, delta: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + delta
+
+    # -- gauges (sampled) -------------------------------------------------
+    def register_gauge(self, name: str, provider: Callable[[], Any]) -> None:
+        with self._lock:
+            self._gauges[name] = provider
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._counters.pop(name, None)
+            self._gauges.pop(name, None)
+
+    # -- reads ------------------------------------------------------------
+    def read(self, name: str) -> Any:
+        with self._lock:
+            if name in self._counters:
+                return self._counters[name]
+            provider = self._gauges.get(name)
+        if provider is None:
+            raise KeyError(name)
+        return provider()
+
+    def read_all(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = dict(self._counters)
+            gauges = dict(self._gauges)
+        for name, provider in gauges.items():
+            try:
+                out[name] = provider()
+            except Exception as exc:
+                out[name] = f"<error: {exc}>"
+        return out
+
+
+_global = SDERegistry()
+
+
+def global_registry() -> SDERegistry:
+    return _global
+
+
+def install_runtime_counters(context,
+                             registry: SDERegistry = None) -> SDERegistry:
+    """Register the standard gauges the reference's components export:
+    scheduler queue depth (SCHEDULER::PENDING_TASKS in the reference's
+    sched components), per-stream exec counts, comm message counters."""
+    reg = registry or _global
+    prefix = f"parsec::rank{context.my_rank}"
+    reg.register_gauge(f"{prefix}::SCHEDULER::PENDING_TASKS",
+                       lambda: context.scheduler.pending_tasks())
+    reg.register_gauge(f"{prefix}::TASKS_EXECUTED",
+                       lambda: sum(es.stats["executed"]
+                                   for es in context.streams))
+    reg.register_gauge(f"{prefix}::TASKS_STOLEN",
+                       lambda: sum(es.stats["stolen"]
+                                   for es in context.streams))
+    if context.comm is not None:
+        reg.register_gauge(f"{prefix}::COMM::ACTIVATIONS_SENT",
+                           lambda: context.comm.stats["activations_sent"])
+        reg.register_gauge(f"{prefix}::COMM::BYTES_SENT",
+                           lambda: context.comm.stats["bytes_sent"])
+    return reg

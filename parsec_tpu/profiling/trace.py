@@ -86,6 +86,39 @@ class Trace:
             json.dump({"dictionary": self._dict,
                        "events": self.to_records()}, fh)
 
+    def dump_chrome_trace(self, path: str) -> None:
+        """Second trace backend (the reference's OTF2 drop-in,
+        profiling_otf2.c): Chrome trace-event JSON — loadable by
+        chrome://tracing / Perfetto. begin/end pairs become duration
+        events per stream; unpaired events become instants."""
+        out = []
+        # pair on (key, object) — ends may be recorded by a different
+        # stream than the begin (e.g. task completion on another worker),
+        # so the stream id is display info (tid from the begin), not key
+        open_begins: Dict[tuple, Dict] = {}
+        for ev in self.to_records():
+            us = ev["t"] * 1e6
+            key = (ev["key"], ev["object"])
+            if ev["phase"] == "begin":
+                open_begins[key] = ev
+            elif ev["phase"] == "end" and key in open_begins:
+                b = open_begins.pop(key)
+                out.append({"name": ev["key"], "ph": "X", "pid": 0,
+                            "tid": b["stream"], "ts": b["t"] * 1e6,
+                            "dur": us - b["t"] * 1e6,
+                            "args": ev["info"] or {}})
+            else:
+                out.append({"name": f"{ev['key']}:{ev['phase']}",
+                            "ph": "i", "pid": 0, "tid": ev["stream"],
+                            "ts": us, "s": "t",
+                            "args": ev["info"] or {}})
+        for b in open_begins.values():      # still-open begins → instants
+            out.append({"name": f"{b['key']}:begin", "ph": "i", "pid": 0,
+                        "tid": b["stream"], "ts": b["t"] * 1e6, "s": "t",
+                        "args": b["info"] or {}})
+        with open(path, "w") as fh:
+            json.dump({"traceEvents": out}, fh)
+
     def counts(self) -> Dict[str, int]:
         out: Dict[str, int] = defaultdict(int)
         for ev in self.to_records():

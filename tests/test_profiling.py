@@ -1,0 +1,186 @@
+"""Profiling-tool tests: properties dictionary, SDE counters, simulation
+mode (critical-path dating), Chrome-trace backend, and the comm-volume
+assertion harness (reference tests/profiling/check-comms.py)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import parsec_tpu as parsec
+from parsec_tpu.algorithms.potrf import build_potrf
+from parsec_tpu.comm.local import LocalCommEngine
+from parsec_tpu.data import LocalCollection, TiledMatrix
+from parsec_tpu.dsl import ptg
+from parsec_tpu.profiling import (SDERegistry, Trace, simulate,
+                                  install_runtime_counters,
+                                  install_runtime_properties)
+from parsec_tpu.termdet import FourCounterTermdet
+
+
+def _chain_tp(n, store):
+    tp = ptg.Taskpool("chain", N=n, S=store)
+    T = tp.task_class(
+        "T", params=("i",),
+        space=lambda g: ((i,) for i in range(g.N)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            tile=lambda g, i: (g.S, ("x",)),
+            ins=[ptg.In(data=lambda g, i: (g.S, ("x",)),
+                        guard=lambda g, i: i == 0),
+                 ptg.In(src=("T", lambda g, i: (i - 1,), "X"),
+                        guard=lambda g, i: i > 0)],
+            outs=[ptg.Out(dst=("T", lambda g, i: (i + 1,), "X"),
+                          guard=lambda g, i: i < g.N - 1),
+                  ptg.Out(data=lambda g, i: (g.S, ("x",)),
+                          guard=lambda g, i: i == g.N - 1)])])
+
+    @T.body
+    def body(task, x):
+        return x + 1
+    return tp
+
+
+# ------------------------------------------------- properties dictionary
+def test_properties_dictionary(ctx):
+    d = install_runtime_properties(ctx)
+    assert "context" in d.namespaces()
+    assert d.query("context", "nb_cores") == ctx.nb_cores
+    assert d.query("sched", "name") == ctx.scheduler.name
+    snap = d.snapshot()
+    assert snap["context"]["active_taskpools"] == 0
+    assert "pending_tasks" in snap["sched"]
+
+
+def test_properties_survive_provider_errors():
+    from parsec_tpu.profiling import PropertiesDictionary
+    d = PropertiesDictionary()
+    d.register("ns", "bad", lambda: 1 / 0)
+    snap = d.snapshot()
+    assert snap["ns"]["bad"].startswith("<error:")
+
+
+# ----------------------------------------------------------- SDE counters
+def test_sde_counters_and_gauges(ctx):
+    reg = SDERegistry()
+    install_runtime_counters(ctx, reg)
+    store = LocalCollection("S", {("x",): 0})
+    ctx.add_taskpool(_chain_tp(10, store))
+    assert ctx.wait(timeout=30)
+    vals = reg.read_all()
+    assert vals[f"parsec::rank0::TASKS_EXECUTED"] == 10
+    reg.register_counter("custom", 5)
+    reg.add("custom", 2)
+    assert reg.read("custom") == 7
+    with pytest.raises(KeyError):
+        reg.read("nonesuch")
+
+
+# -------------------------------------------------------- simulation mode
+def test_sim_chain_critical_path():
+    store = LocalCollection("S", {("x",): 0})
+    rep = simulate(_chain_tp(17, store))
+    assert rep.critical_path == 17.0          # pure chain, unit costs
+    assert rep.n_tasks == 17
+    assert rep.parallelism() == pytest.approx(1.0)
+
+
+def test_sim_potrf_critical_path():
+    """Unit-cost POTRF critical path: POTRF(k) → TRSM(k+1,k) →
+    SYRK(k+1,k) → POTRF(k+1) ⇒ 3(NT-1)+1 levels."""
+    NT = 5
+    A = TiledMatrix(NT * 16, NT * 16, 16, 16, name="A")
+    rep = simulate(build_potrf(A))
+    assert rep.critical_path == 3 * (NT - 1) + 1
+    assert rep.parallelism() > 1.0
+    assert rep.date_of("POTRF", (0,)) == 1.0
+
+
+def test_sim_custom_cost():
+    store = LocalCollection("S", {("x",): 0})
+    rep = simulate(_chain_tp(4, store), cost=lambda tc, p: 2.5)
+    assert rep.critical_path == 10.0
+
+
+# ----------------------------------------------------- chrome trace export
+def test_chrome_trace_export(tmp_path, ctx):
+    tr = Trace().install(ctx)
+    store = LocalCollection("S", {("x",): 0})
+    ctx.add_taskpool(_chain_tp(8, store))
+    assert ctx.wait(timeout=30)
+    path = tmp_path / "trace.json"
+    tr.dump_chrome_trace(str(path))
+    doc = json.loads(path.read_text())
+    durations = [ev for ev in doc["traceEvents"] if ev["ph"] == "X"]
+    assert len(durations) == 8          # one paired duration per task
+    assert all(ev["dur"] >= 0 for ev in durations)
+
+
+# ------------------------------------------- comm volume (check-comms.py)
+def test_check_comms_volume():
+    """2-rank chain with array payloads: assert activation counts and
+    byte totals on both engines (check-comms.py:7-14 analog — the
+    reference asserts 100 MPI_ACTIVATEs and the exact payload bytes)."""
+    N = 20
+    payload_elems = 512
+    engines = LocalCommEngine.make_fabric(2)
+    traces = [Trace(), Trace()]
+    for e, t in zip(engines, traces):
+        e.install_trace(t)
+
+    class AltStore(LocalCollection):
+        def rank_of(self, key):
+            return key[0] % 2
+
+    ctxs, stores = [], []
+    for r in range(2):
+        ctx = parsec.init(nb_cores=2, comm=engines[r])
+        store = AltStore("S")
+        store.write_tile((0,), np.zeros(payload_elems, dtype=np.float32))
+        tp = ptg.Taskpool("bw", N=N, S=store)
+        T = tp.task_class(
+            "T", params=("i",),
+            space=lambda g: ((i,) for i in range(g.N)),
+            affinity=lambda g, i: (g.S, (i,)),
+            flows=[ptg.FlowSpec(
+                "X", ptg.RW,
+                ins=[ptg.In(data=lambda g, i: (g.S, (0,)),
+                            guard=lambda g, i: i == 0),
+                     ptg.In(src=("T", lambda g, i: (i - 1,), "X"),
+                            guard=lambda g, i: i > 0)],
+                outs=[ptg.Out(dst=("T", lambda g, i: (i + 1,), "X"),
+                              guard=lambda g, i: i < g.N - 1),
+                      ptg.Out(data=lambda g, i: (g.S, (g.N - 1,)),
+                              guard=lambda g, i: i == g.N - 1)])])
+
+        @T.body_cpu
+        def body(task, x):
+            return x + 1.0
+
+        tp.monitor = FourCounterTermdet(comm=engines[r])
+        ctxs.append(ctx)
+        stores.append(store)
+        ctx.add_taskpool(tp)
+    try:
+        for ctx in ctxs:
+            ctx.start()
+        for ctx in ctxs:
+            assert ctx.wait(timeout=60)
+        # every hop crosses ranks: N-1 activations total, each carrying
+        # one payload_elems float32 array
+        sent = [e.stats["activations_sent"] for e in engines]
+        recv = [e.stats["activations_recv"] for e in engines]
+        assert sum(sent) == N - 1
+        assert sum(recv) == N - 1
+        expect_bytes = (N - 1) * payload_elems * 4
+        assert sum(e.stats["bytes_sent"] for e in engines) == expect_bytes
+        assert sum(e.stats["bytes_recv"] for e in engines) == expect_bytes
+        # trace events carry the per-message msg_size info
+        events = [ev for t in traces for ev in t.to_records()
+                  if ev["key"] == "comm_activate" and ev["phase"] == "sent"]
+        assert len(events) == N - 1
+        assert all(ev["info"]["msg_size"] == payload_elems * 4
+                   for ev in events)
+    finally:
+        for ctx in ctxs:
+            parsec.fini(ctx)
