@@ -1,0 +1,205 @@
+"""Tiled QR factorization (flat-tree DPLASMA dgeqrf) as a PTG taskpool.
+
+The BASELINE.md "PTG dgeqrf reduction-tree stress" config. Task classes
+mirror the classic dgeqrf JDF (panel factorization + trailing update per
+step k):
+
+    GEQRT(k):     QR of diagonal tile            → Q_k, R
+    TSQRT(m,k):   QR of [R; A(m,k)] stacked      → Q₂(m,k), updated R
+                  (flat reduction tree down column k: m = k+1 .. MT-1)
+    UNMQR(k,n):   row-panel update A(k,n) ← Q_kᵀ·A(k,n)
+    TSMQR(m,n,k): stacked-pair update [C(k,n); A(m,n)] ← Q₂(m,k)ᵀ·[..]
+
+On completion A holds R in its upper-triangular tile blocks and zeros
+below (V/T storage is a compact-BLAS artifact the functional dataflow
+does not keep — see ops/tile_kernels.py). Validation identity:
+AᵀA = RᵀR (orthogonal-invariant, sign-independent).
+
+Orthogonal factors flow task→task as values (no collection placement),
+so this taskpool exercises the host runtime's value-flow path; flows that
+live in A carry tile placements for distribution.
+"""
+
+from __future__ import annotations
+
+from ..dsl import ptg
+from ..data.matrix import TiledMatrix
+from ..ops.tile_kernels import geqrt_tile, tsmqr_tile, tsqrt_tile, unmqr_tile
+
+
+def build_geqrf(A: TiledMatrix) -> ptg.Taskpool:
+    """Build the GEQRF taskpool over tiled matrix ``A`` (MT ≥ NT)."""
+    MT, NT = A.mt, A.nt
+    if MT < NT:
+        raise ValueError("GEQRF needs MT >= NT (tall or square tile grid)")
+    tp = ptg.Taskpool("geqrf", A=A, MT=MT, NT=NT)
+
+    GEQRT = tp.task_class(
+        "GEQRT", params=("k",),
+        space=lambda g: ((k,) for k in range(g.NT)),
+        affinity=lambda g, k: (g.A, (k, k)),
+        priority=lambda g, k: 4 * (g.NT - k) ** 2,
+        flows=[
+            ptg.FlowSpec(
+                "A", ptg.READ,
+                tile=lambda g, k: (g.A, (k, k)),
+                ins=[ptg.In(data=lambda g, k: (g.A, (k, k)),
+                            guard=lambda g, k: k == 0),
+                     ptg.In(src=("TSMQR", lambda g, k: (k, k, k - 1), "A2"),
+                            guard=lambda g, k: k > 0)]),
+            ptg.FlowSpec(
+                "Q", ptg.WRITE,
+                outs=[ptg.Out(dst=("UNMQR",
+                               lambda g, k: [(k, n)
+                                             for n in range(k + 1, g.NT)],
+                               "Q"))]),
+            ptg.FlowSpec(
+                "R", ptg.WRITE,
+                tile=lambda g, k: (g.A, (k, k)),
+                outs=[ptg.Out(dst=("TSQRT", lambda g, k: (k + 1, k), "R"),
+                              guard=lambda g, k: k + 1 < g.MT),
+                      ptg.Out(data=lambda g, k: (g.A, (k, k)),
+                              guard=lambda g, k: k + 1 >= g.MT)]),
+        ])
+
+    TSQRT = tp.task_class(
+        "TSQRT", params=("m", "k"),
+        space=lambda g: ((m, k) for k in range(g.NT)
+                         for m in range(k + 1, g.MT)),
+        affinity=lambda g, m, k: (g.A, (m, k)),
+        priority=lambda g, m, k: 3 * (g.NT - k) ** 2 - m,
+        flows=[
+            ptg.FlowSpec(
+                "R", ptg.RW,
+                tile=lambda g, m, k: (g.A, (k, k)),
+                ins=[ptg.In(src=("GEQRT", lambda g, m, k: (k,), "R"),
+                            guard=lambda g, m, k: m == k + 1),
+                     ptg.In(src=("TSQRT", lambda g, m, k: (m - 1, k), "R"),
+                            guard=lambda g, m, k: m > k + 1)],
+                outs=[ptg.Out(dst=("TSQRT", lambda g, m, k: (m + 1, k), "R"),
+                              guard=lambda g, m, k: m + 1 < g.MT),
+                      ptg.Out(data=lambda g, m, k: (g.A, (k, k)),
+                              guard=lambda g, m, k: m + 1 >= g.MT)]),
+            ptg.FlowSpec(
+                "A", ptg.READ,
+                tile=lambda g, m, k: (g.A, (m, k)),
+                ins=[ptg.In(data=lambda g, m, k: (g.A, (m, k)),
+                            guard=lambda g, m, k: k == 0),
+                     ptg.In(src=("TSMQR", lambda g, m, k: (m, k, k - 1),
+                                 "A2"),
+                            guard=lambda g, m, k: k > 0)]),
+            ptg.FlowSpec(
+                "Q2", ptg.WRITE,
+                outs=[ptg.Out(dst=("TSMQR",
+                               lambda g, m, k: [(m, n, k)
+                                                for n in range(k + 1, g.NT)],
+                               "Q2"))]),
+            # the V block of A(m,k) is consumed; R lives strictly above
+            ptg.FlowSpec(
+                "Z", ptg.WRITE,
+                tile=lambda g, m, k: (g.A, (m, k)),
+                outs=[ptg.Out(data=lambda g, m, k: (g.A, (m, k)))]),
+        ])
+
+    UNMQR = tp.task_class(
+        "UNMQR", params=("k", "n"),
+        space=lambda g: ((k, n) for k in range(g.NT)
+                         for n in range(k + 1, g.NT)),
+        affinity=lambda g, k, n: (g.A, (k, n)),
+        priority=lambda g, k, n: 3 * (g.NT - k) ** 2 - n,
+        flows=[
+            ptg.FlowSpec(
+                "Q", ptg.READ,
+                ins=[ptg.In(src=("GEQRT", lambda g, k, n: (k,), "Q"))]),
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                tile=lambda g, k, n: (g.A, (k, n)),
+                ins=[ptg.In(data=lambda g, k, n: (g.A, (k, n)),
+                            guard=lambda g, k, n: k == 0),
+                     ptg.In(src=("TSMQR", lambda g, k, n: (k, n, k - 1),
+                                 "A2"),
+                            guard=lambda g, k, n: k > 0)],
+                outs=[ptg.Out(dst=("TSMQR",
+                                   lambda g, k, n: (k + 1, n, k), "C1"),
+                              guard=lambda g, k, n: k + 1 < g.MT),
+                      ptg.Out(data=lambda g, k, n: (g.A, (k, n)),
+                              guard=lambda g, k, n: k + 1 >= g.MT)]),
+        ])
+
+    TSMQR = tp.task_class(
+        "TSMQR", params=("m", "n", "k"),
+        space=lambda g: ((m, n, k) for k in range(g.NT)
+                         for m in range(k + 1, g.MT)
+                         for n in range(k + 1, g.NT)),
+        affinity=lambda g, m, n, k: (g.A, (m, n)),
+        priority=lambda g, m, n, k: (g.NT - k) ** 2 - m - n,
+        flows=[
+            ptg.FlowSpec(
+                "Q2", ptg.READ,
+                ins=[ptg.In(src=("TSQRT", lambda g, m, n, k: (m, k), "Q2"))]),
+            # running row-k tile C(k,n), reduced down the column
+            ptg.FlowSpec(
+                "C1", ptg.RW,
+                tile=lambda g, m, n, k: (g.A, (k, n)),
+                ins=[ptg.In(src=("UNMQR", lambda g, m, n, k: (k, n), "C"),
+                            guard=lambda g, m, n, k: m == k + 1),
+                     ptg.In(src=("TSMQR",
+                                 lambda g, m, n, k: (m - 1, n, k), "C1"),
+                            guard=lambda g, m, n, k: m > k + 1)],
+                outs=[ptg.Out(dst=("TSMQR",
+                                   lambda g, m, n, k: (m + 1, n, k), "C1"),
+                              guard=lambda g, m, n, k: m + 1 < g.MT),
+                      ptg.Out(data=lambda g, m, n, k: (g.A, (k, n)),
+                              guard=lambda g, m, n, k: m + 1 >= g.MT)]),
+            # trailing tile A(m,n)
+            ptg.FlowSpec(
+                "A2", ptg.RW,
+                tile=lambda g, m, n, k: (g.A, (m, n)),
+                ins=[ptg.In(data=lambda g, m, n, k: (g.A, (m, n)),
+                            guard=lambda g, m, n, k: k == 0),
+                     ptg.In(src=("TSMQR",
+                                 lambda g, m, n, k: (m, n, k - 1), "A2"),
+                            guard=lambda g, m, n, k: k > 0)],
+                outs=[
+                    ptg.Out(dst=("GEQRT", lambda g, m, n, k: (k + 1,), "A"),
+                            guard=lambda g, m, n, k: m == k + 1 and
+                            n == k + 1),
+                    ptg.Out(dst=("TSQRT", lambda g, m, n, k: (m, k + 1), "A"),
+                            guard=lambda g, m, n, k: m > k + 1 and
+                            n == k + 1),
+                    ptg.Out(dst=("UNMQR", lambda g, m, n, k: (k + 1, n), "C"),
+                            guard=lambda g, m, n, k: m == k + 1 and
+                            n > k + 1),
+                    ptg.Out(dst=("TSMQR",
+                                 lambda g, m, n, k: (m, n, k + 1), "A2"),
+                            guard=lambda g, m, n, k: m > k + 1 and
+                            n > k + 1),
+                ]),
+        ])
+
+    @GEQRT.body
+    def geqrt_body(task, A_, Qv, Rv):
+        Q, R = geqrt_tile(A_)
+        return {"Q": Q, "R": R}
+
+    @TSQRT.body
+    def tsqrt_body(task, R, A_, Q2v, Zv):
+        import jax.numpy as jnp
+        Q2, Rn = tsqrt_tile(R, A_)
+        return {"R": Rn, "Q2": Q2, "Z": jnp.zeros_like(A_)}
+
+    @UNMQR.body
+    def unmqr_body(task, Q, C):
+        return {"C": unmqr_tile(Q, C)}
+
+    @TSMQR.body
+    def tsmqr_body(task, Q2, C1, A2):
+        nC1, nA2 = tsmqr_tile(Q2, C1, A2)
+        return {"C1": nC1, "A2": nA2}
+
+    return tp
+
+
+def geqrf_flops(m: int, n: int) -> float:
+    """Useful FLOPs of an m×n QR (LAPACK count, m ≥ n)."""
+    return 2.0 * m * n * n - 2.0 * n ** 3 / 3.0 + m * n + n * n / 2.0

@@ -1,0 +1,176 @@
+"""Transformer block (multi-head attention + FFN) as a PTG taskpool.
+
+The BASELINE.md stretch config ("transformer FFN+attention PTG DAG").
+Attention is expressed as a *streaming online-softmax chain over KV
+tiles* — per (head h, query tile i), task ATT(h,i,j) folds KV tile j
+into a running (accumulator, row-max, row-sum) state:
+
+    ATT(h,i,0) → ATT(h,i,1) → ... → ATT(h,i,T-1) → NORM(h,i)
+
+This is exactly the ring-attention dataflow: distributed over ranks with
+KV tiles owner-placed round-robin, the chain's state activation is the
+ring's send/recv (SURVEY §5 "long-context": chain dataflow + the
+redistribute engine). The compiled XLA twin of this DAG lives in
+``parsec_tpu.compiled.ring_attention`` (shard_map + ppermute over a
+mesh); this taskpool is the runtime-scheduled, arbitrarily-overlappable
+form of the same computation.
+
+Head outputs are gathered per query tile (GATH chain over heads), output
+projected, then a 2-layer FFN with residuals; results land in the ``Y``
+collection.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ..dsl import ptg
+from ..data.collection import DataCollection
+
+
+def build_transformer_block(Qc: DataCollection, Kc: DataCollection,
+                            Vc: DataCollection, Y: DataCollection,
+                            n_heads: int, n_tiles: int, tile_s: int,
+                            d_head: int, Wo, W1, W2) -> ptg.Taskpool:
+    """Attention+FFN taskpool.
+
+    ``Qc/Kc/Vc`` hold per-(head, seq-tile) tiles of shape
+    ``(tile_s, d_head)`` keyed ``(h, i)``; ``Y`` receives per-seq-tile
+    block outputs keyed ``(i,)``. ``Wo`` is ``(H·dh, D)``, ``W1/W2`` the
+    FFN weights (``(D, F)`` / ``(F, D)``)."""
+    import jax.numpy as jnp
+
+    scale = 1.0 / math.sqrt(d_head)
+    tp = ptg.Taskpool("transformer", Qc=Qc, Kc=Kc, Vc=Vc, Y=Y,
+                      H=n_heads, T=n_tiles, TS=tile_s, DH=d_head,
+                      Wo=Wo, W1=W1, W2=W2)
+
+    def _init_state(g, h, i, j):
+        return (jnp.zeros((g.TS, g.DH), jnp.float32),       # accumulator
+                jnp.full((g.TS,), -jnp.inf, jnp.float32),   # running max
+                jnp.zeros((g.TS,), jnp.float32))            # running sum
+
+    ATT = tp.task_class(
+        "ATT", params=("h", "i", "j"),
+        space=lambda g: ((h, i, j) for h in range(g.H)
+                         for i in range(g.T) for j in range(g.T)),
+        affinity=lambda g, h, i, j: (g.Kc, (h, j)),   # owner of the KV tile
+        priority=lambda g, h, i, j: g.T - j,
+        flows=[
+            ptg.FlowSpec(
+                "Q", ptg.READ,
+                tile=lambda g, h, i, j: (g.Qc, (h, i)),
+                ins=[ptg.In(data=lambda g, h, i, j: (g.Qc, (h, i)))]),
+            ptg.FlowSpec(
+                "K", ptg.READ,
+                tile=lambda g, h, i, j: (g.Kc, (h, j)),
+                ins=[ptg.In(data=lambda g, h, i, j: (g.Kc, (h, j)))]),
+            ptg.FlowSpec(
+                "V", ptg.READ,
+                tile=lambda g, h, i, j: (g.Vc, (h, j)),
+                ins=[ptg.In(data=lambda g, h, i, j: (g.Vc, (h, j)))]),
+            ptg.FlowSpec(
+                "S", ptg.RW,
+                ins=[ptg.In(new=_init_state,
+                            guard=lambda g, h, i, j: j == 0),
+                     ptg.In(src=("ATT", lambda g, h, i, j: (h, i, j - 1),
+                                 "S"),
+                            guard=lambda g, h, i, j: j > 0)],
+                outs=[ptg.Out(dst=("ATT", lambda g, h, i, j: (h, i, j + 1),
+                                   "S"),
+                              guard=lambda g, h, i, j: j < g.T - 1),
+                      ptg.Out(dst=("NORM", lambda g, h, i, j: (h, i), "S"),
+                              guard=lambda g, h, i, j: j == g.T - 1)]),
+        ])
+
+    NORM = tp.task_class(
+        "NORM", params=("h", "i"),
+        space=lambda g: ((h, i) for h in range(g.H) for i in range(g.T)),
+        affinity=lambda g, h, i: (g.Qc, (h, i)),
+        flows=[
+            ptg.FlowSpec(
+                "S", ptg.READ,
+                ins=[ptg.In(src=("ATT", lambda g, h, i: (h, i, g.T - 1),
+                                 "S"))]),
+            ptg.FlowSpec(
+                "O", ptg.WRITE,
+                outs=[ptg.Out(dst=("GATH", lambda g, h, i: (i, h), "Hd"))]),
+        ])
+
+    GATH = tp.task_class(
+        "GATH", params=("i", "h"),
+        space=lambda g: ((i, h) for i in range(g.T) for h in range(g.H)),
+        affinity=lambda g, i, h: (g.Qc, (0, i)),
+        flows=[
+            ptg.FlowSpec(
+                "Hd", ptg.READ,
+                ins=[ptg.In(src=("NORM", lambda g, i, h: (h, i), "O"))]),
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                ins=[ptg.In(new=lambda g, i, h: None,
+                            guard=lambda g, i, h: h == 0),
+                     ptg.In(src=("GATH", lambda g, i, h: (i, h - 1), "C"),
+                            guard=lambda g, i, h: h > 0)],
+                outs=[ptg.Out(dst=("GATH", lambda g, i, h: (i, h + 1), "C"),
+                              guard=lambda g, i, h: h < g.H - 1),
+                      ptg.Out(dst=("FFN", lambda g, i, h: (i,), "X"),
+                              guard=lambda g, i, h: h == g.H - 1)]),
+        ])
+
+    FFN = tp.task_class(
+        "FFN", params=("i",),
+        space=lambda g: ((i,) for i in range(g.T)),
+        affinity=lambda g, i: (g.Qc, (0, i)),
+        flows=[
+            ptg.FlowSpec(
+                "X", ptg.RW,
+                ins=[ptg.In(src=("GATH", lambda g, i: (i, g.H - 1), "C"))],
+                outs=[ptg.Out(data=lambda g, i: (g.Y, (i,)))]),
+        ])
+
+    @ATT.body
+    def att_body(task, Q, K, V, S):
+        acc, m, l = S
+        s = jnp.matmul(Q, K.T, preferred_element_type=jnp.float32) * scale
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[:, None] + jnp.matmul(
+            p, V, preferred_element_type=jnp.float32)
+        return {"S": (acc_new, m_new, l_new)}
+
+    @NORM.body
+    def norm_body(task, S, O):
+        acc, m, l = S
+        return {"O": acc / l[:, None]}
+
+    @GATH.body
+    def gath_body(task, Hd, C):
+        return {"C": Hd if C is None else jnp.concatenate([C, Hd], axis=-1)}
+
+    @FFN.body
+    def ffn_body(task, X):
+        a = jnp.matmul(X, Wo, preferred_element_type=jnp.float32)
+        hdn = jnp.maximum(jnp.matmul(a, W1,
+                                     preferred_element_type=jnp.float32), 0.0)
+        return {"X": a + jnp.matmul(hdn, W2,
+                                    preferred_element_type=jnp.float32)}
+
+    return tp
+
+
+def reference_block(q, k, v, Wo, W1, W2):
+    """Dense numpy reference: per-head softmax attention → concat →
+    output proj → FFN with residual. q/k/v: (H, S, dh)."""
+    import numpy as np
+    H, S, dh = q.shape
+    outs = []
+    for h in range(H):
+        s = (q[h] @ k[h].T) / math.sqrt(dh)
+        p = np.exp(s - s.max(axis=-1, keepdims=True))
+        p = p / p.sum(axis=-1, keepdims=True)
+        outs.append(p @ v[h])
+    concat = np.concatenate(outs, axis=-1)          # (S, H·dh)
+    a = concat @ Wo
+    return a + np.maximum(a @ W1, 0.0) @ W2

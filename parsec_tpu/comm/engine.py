@@ -58,7 +58,9 @@ class CommEngine:
 
     @staticmethod
     def payload_bytes(value: Any) -> int:
-        """Best-effort payload size of an activation value."""
+        """Best-effort payload size of an activation value. Containers
+        (the transformer chain ships (acc, m, l) state tuples) count the
+        sum of their elements."""
         if value is None:
             return 0
         nb = getattr(value, "nbytes", None)
@@ -66,6 +68,10 @@ class CommEngine:
             return int(nb)
         if isinstance(value, (bytes, bytearray)):
             return len(value)
+        if isinstance(value, (tuple, list)):
+            return sum(CommEngine.payload_bytes(v) for v in value)
+        if isinstance(value, dict):
+            return sum(CommEngine.payload_bytes(v) for v in value.values())
         return 0
 
     def record_msg(self, direction: str, kind: str, peer: int,

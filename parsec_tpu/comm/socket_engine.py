@@ -54,13 +54,6 @@ mca_param.register("comm.wireup_timeout_s", 30.0,
 _HDR = struct.Struct("!Q")     # frame length prefix
 
 
-def _approx_nbytes(value: Any) -> int:
-    """Payload size for the eager/rendezvous decision and the symmetric
-    send/recv byte counters (same estimator on both ends)."""
-    from .engine import CommEngine
-    return CommEngine.payload_bytes(value)
-
-
 class _WaveState:
     """Coordinator-side (rank 0) state of one in-flight termdet wave."""
 
@@ -105,9 +98,10 @@ class SocketCommEngine(CommEngine):
         self.tag_register(AMTag.BARRIER, self._on_barrier)
         self.tag_register(AMTag.TERMDET_FOURCOUNTER, self._on_termdet)
         self.tag_register(AMTag.TERMDET_USER_TRIGGER, self._on_trigger)
+        # frame-level wire counters only; payload-level activation
+        # counters live in the base ``stats`` dict (record_msg)
         self._stats = {"frames_sent": 0, "frames_recv": 0, "bytes_sent": 0,
-                       "bytes_recv": 0, "activations_sent": 0,
-                       "activations_recv": 0, "gets": 0, "puts": 0}
+                       "bytes_recv": 0, "gets": 0, "puts": 0}
         if nb_ranks > 1:
             self._wireup()
 
@@ -237,7 +231,6 @@ class SocketCommEngine(CommEngine):
             else:
                 for m in msgs:
                     self._send_frame(dst, AMTag.ACTIVATE, [m])
-            self._stats["activations_sent"] += len(msgs)
         for (_, tag, dst, msg) in other:
             self._send_frame(dst, tag, msg)
         return n
@@ -378,7 +371,7 @@ class SocketCommEngine(CommEngine):
                "locals": tuple(ref.locals), "flow": ref.flow_name,
                "dep_index": ref.dep_index, "priority": ref.priority}
         value = ref.value
-        nbytes = _approx_nbytes(value)
+        nbytes = self.payload_bytes(value)
         eager_limit = int(mca_param.get("comm.eager_limit", 256 * 1024))
         if value is not None and nbytes > eager_limit:
             msg["value_handle"] = self.mem_register(value)
@@ -422,7 +415,6 @@ class SocketCommEngine(CommEngine):
 
     def _deliver_activation(self, tp, src: int, msg: Dict) -> None:
         from ..core.taskpool import SuccessorRef
-        self._stats["activations_recv"] += 1
         self.record_msg("recv", "activate", src,
                         msg.get("nbytes",
                                 self.payload_bytes(msg.get("value"))))

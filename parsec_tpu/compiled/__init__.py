@@ -16,3 +16,5 @@ PTG taskpool's whole DAG into XLA programs instead:
 
 from .wavefront import WavefrontPlan, plan_taskpool, WavefrontExecutor
 from . import spmd
+from .ring_attention import (ring_attention, ulysses_attention,
+                             dense_attention)

@@ -1,0 +1,152 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The compiled XLA twins of the streaming-attention task DAG
+(``parsec_tpu.algorithms.transformer``). Where the runtime form ships the
+online-softmax state between tasks through activations (the reference's
+chain-dataflow pattern, SURVEY §5 "long-context"), these shard the
+sequence over a ``jax.sharding.Mesh`` axis and move KV blocks with XLA
+collectives riding ICI:
+
+- :func:`ring_attention` — each device holds one Q/K/V sequence block;
+  KV blocks rotate around the ring with ``lax.ppermute`` while every
+  device folds the visiting block into its online-softmax state
+  (`Ring Attention with Blockwise Transformers`, Liu et al. 2023 —
+  PAPERS.md). Peak memory per device is O(block²) independent of the
+  full sequence length; the permute overlaps with the block compute.
+- :func:`ulysses_attention` — all-to-all re-shard: scatter heads /
+  gather sequence (`DeepSpeed-Ulysses`, Jacobs et al. 2023), dense
+  per-head attention locally, inverse all-to-all back to
+  sequence-sharded. One collective pair instead of N-1 permutes; needs
+  n_heads divisible by the mesh axis size.
+
+Both are pure jittable functions of sequence-sharded operands: drop them
+under ``pjit``/``shard_map`` with the rest of a model and XLA fuses and
+overlaps the collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+
+def _online_softmax_step(q_blk, k_cur, v_cur, acc, m, l, scale):
+    import jax.numpy as jnp
+
+    s = jnp.matmul(q_blk, jnp.swapaxes(k_cur, -1, -2),
+                   preferred_element_type=jnp.float32) * scale
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + p.sum(axis=-1)
+    acc_new = acc * corr[..., None] + jnp.matmul(
+        p, v_cur, preferred_element_type=jnp.float32)
+    return acc_new, m_new, l_new
+
+
+def ring_attention(q, k, v, mesh, axis: str = "seq"):
+    """Multi-head attention with the sequence sharded over mesh ``axis``.
+
+    ``q/k/v``: float arrays of shape ``(S, H, dh)`` (sequence-major) laid
+    out ``PartitionSpec(axis)`` over ``mesh``. Returns the attention
+    output in the same layout. Full (non-causal) attention.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    shard_map = jax.shard_map
+
+    n = mesh.shape[axis]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def block(q_blk, k_blk, v_blk):
+        # [Sb, H, dh] → head-major [H, Sb, dh] for batched matmuls
+        qh = jnp.swapaxes(q_blk, 0, 1).astype(jnp.float32)
+        kh = jnp.swapaxes(k_blk, 0, 1).astype(jnp.float32)
+        vh = jnp.swapaxes(v_blk, 0, 1).astype(jnp.float32)
+
+        def step(carry, _):
+            # permute first, fold second: the local block is folded
+            # before the loop, so exactly n-1 rotations happen — no
+            # wasted final ppermute (XLA can't peel a scan iteration)
+            k_cur, v_cur, acc, m, l = carry
+            k_cur = lax.ppermute(k_cur, axis, perm)
+            v_cur = lax.ppermute(v_cur, axis, perm)
+            acc, m, l = _online_softmax_step(qh, k_cur, v_cur, acc, m, l,
+                                             scale)
+            return (k_cur, v_cur, acc, m, l), None
+
+        # fold the resident block, then rotate n-1 times; the init state
+        # derives from qh so it carries the same varying manual axes as
+        # the loop outputs (JAX ≥0.8 shard_map typing)
+        acc0, m0, l0 = _online_softmax_step(
+            qh, kh, vh, qh * 0.0, qh[..., 0] * 0.0 - jnp.inf,
+            qh[..., 0] * 0.0, scale)
+        (k_f, v_f, acc, m, l), _ = lax.scan(
+            step, (kh, vh, acc0, m0, l0), None, length=n - 1)
+        out = acc / l[..., None]
+        return jnp.swapaxes(out, 0, 1).astype(q_blk.dtype)
+
+    fn = shard_map(block, mesh=mesh,
+                   in_specs=(P(axis), P(axis), P(axis)),
+                   out_specs=P(axis))
+    return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v, mesh, axis: str = "seq"):
+    """All-to-all sequence parallelism: re-shard (S/n, H, dh) →
+    (S, H/n, dh), dense per-head attention locally, inverse all-to-all.
+    ``H`` must be divisible by the mesh axis size."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    shard_map = jax.shard_map
+
+    n = mesh.shape[axis]
+    H = q.shape[1]
+    if H % n:
+        raise ValueError(f"n_heads={H} not divisible by mesh axis size {n}")
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def block(q_blk, k_blk, v_blk):
+        # scatter heads, gather sequence: [Sb, H, dh] → [Sb·n, H/n, dh]
+        def fwd(x):
+            x = lax.all_to_all(x, axis, split_axis=1, concat_axis=0,
+                               tiled=True)
+            return jnp.swapaxes(x, 0, 1).astype(jnp.float32)  # [H/n, S, dh]
+
+        qh, kh, vh = fwd(q_blk), fwd(k_blk), fwd(v_blk)
+        s = jnp.matmul(qh, jnp.swapaxes(kh, -1, -2),
+                       preferred_element_type=jnp.float32) * scale
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.matmul(p, vh, preferred_element_type=jnp.float32)
+        # inverse: gather heads, scatter sequence
+        out = jnp.swapaxes(out, 0, 1)                         # [S, H/n, dh]
+        out = lax.all_to_all(out, axis, split_axis=0, concat_axis=1,
+                             tiled=True)
+        return out.astype(q_blk.dtype)
+
+    fn = shard_map(block, mesh=mesh,
+                   in_specs=(P(axis), P(axis), P(axis)),
+                   out_specs=P(axis))
+    return fn(q, k, v)
+
+
+def dense_attention(q, k, v):
+    """Unsharded reference: softmax(QKᵀ/√dh)·V per head; q/k/v (S, H, dh)."""
+    import jax
+    import jax.numpy as jnp
+
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    qh = jnp.swapaxes(q, 0, 1).astype(jnp.float32)
+    kh = jnp.swapaxes(k, 0, 1).astype(jnp.float32)
+    vh = jnp.swapaxes(v, 0, 1).astype(jnp.float32)
+    s = jnp.matmul(qh, jnp.swapaxes(kh, -1, -2),
+                   preferred_element_type=jnp.float32) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.matmul(p, vh, preferred_element_type=jnp.float32)
+    return jnp.swapaxes(out, 0, 1).astype(q.dtype)

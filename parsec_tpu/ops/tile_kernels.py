@@ -62,3 +62,41 @@ def add_tile(A, B):
 
 def scale_tile(A, alpha):
     return alpha * A
+
+
+# ---- tiled-QR kernels (DPLASMA dgeqrf tile operations) -----------------
+# Functional variant: the reference's Householder kernels (GEQRT/TSQRT/
+# UNMQR/TSMQR with compact V+T storage) are re-expressed with explicit
+# per-tile orthogonal factors — Q values flow between tasks as tiles,
+# which is what XLA can batch; compact-V storage is a memory optimization
+# tied to in-place BLAS that functional dataflow doesn't need.
+
+def geqrt_tile(A):
+    """Diagonal-tile QR: A = Q·R → (Q, R)."""
+    Q, R = jnp.linalg.qr(A.astype(jnp.float32), mode="complete")
+    return Q.astype(A.dtype), R.astype(A.dtype)
+
+
+def unmqr_tile(Q, C):
+    """C ← Qᵀ·C (apply a diagonal-tile factor to a row-panel tile)."""
+    out = jnp.matmul(Q.T, C, preferred_element_type=jnp.float32,
+                     precision=_prec())
+    return out.astype(C.dtype)
+
+
+def tsqrt_tile(R, A):
+    """Triangular-on-top-of-square QR: [R; A] = Q₂·R' → (Q₂, R').
+    Q₂ is the full (2nb × 2nb) factor; R' the updated nb × nb triangle."""
+    nb = R.shape[0]
+    S = jnp.concatenate([R, A], axis=0).astype(jnp.float32)
+    Q2, Rfull = jnp.linalg.qr(S, mode="complete")
+    return Q2.astype(R.dtype), Rfull[:nb].astype(R.dtype)
+
+
+def tsmqr_tile(Q2, C1, C2):
+    """Apply a TSQRT factor to a stacked pair: [C1; C2] ← Q₂ᵀ·[C1; C2]."""
+    nb = C1.shape[0]
+    S = jnp.concatenate([C1, C2], axis=0)
+    out = jnp.matmul(Q2.T, S, preferred_element_type=jnp.float32,
+                     precision=_prec()).astype(C1.dtype)
+    return out[:nb], out[nb:]

@@ -25,10 +25,12 @@ from ..dsl import ptg as ptg_mod
 class SimReport:
     """Critical-path dating result."""
 
-    def __init__(self, dates: Dict, length: float, n_tasks: int):
+    def __init__(self, dates: Dict, length: float, n_tasks: int,
+                 total_work: float = 0.0):
         self.dates = dates          # (class_name, locals) -> completion date
         self.critical_path = length
         self.n_tasks = n_tasks
+        self._total_work = total_work
 
     def date_of(self, class_name: str, locals: Tuple[int, ...]) -> float:
         return self.dates[(class_name, tuple(locals))]
@@ -71,6 +73,5 @@ def simulate(tp: ptg_mod.Taskpool,
                 continue
             skey = (ref.task_class.name, tuple(ref.locals))
             ready_at[skey] = max(ready_at.get(skey, 0.0), done)
-    report = SimReport(dates, max(dates.values(), default=0.0), len(dates))
-    report._total_work = total_work
-    return report
+    return SimReport(dates, max(dates.values(), default=0.0), len(dates),
+                     total_work=total_work)

@@ -186,7 +186,7 @@ def scenario_rendezvous(ctx, engine, rank, nb_ranks, nbytes=2 * 1024 * 1024):
         if B.rank_of((0,)) != rank:
             st = engine.wire_stats()
             assert st["gets"] >= 1, st     # rendezvous actually used
-    return engine.wire_stats()["activations_recv"]
+    return engine.stats["activations_recv"]
 
 
 def scenario_potrf(ctx, engine, rank, nb_ranks, n=192, nb=32):

@@ -1,0 +1,53 @@
+"""Transformer-block PTG DAG tests (BASELINE stretch config)."""
+
+import numpy as np
+import pytest
+
+import parsec_tpu as parsec
+from parsec_tpu.algorithms.transformer import (build_transformer_block,
+                                               reference_block)
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl import ptg
+
+
+def _setup(rng, H=2, T=3, TS=8, DH=4, F=16):
+    D = H * DH
+    q = rng.standard_normal((H, T * TS, DH)).astype(np.float32)
+    k = rng.standard_normal((H, T * TS, DH)).astype(np.float32)
+    v = rng.standard_normal((H, T * TS, DH)).astype(np.float32)
+    Wo = (rng.standard_normal((D, D)) / np.sqrt(D)).astype(np.float32)
+    W1 = (rng.standard_normal((D, F)) / np.sqrt(D)).astype(np.float32)
+    W2 = (rng.standard_normal((F, D)) / np.sqrt(F)).astype(np.float32)
+    Qc = LocalCollection("Q", {(h, i): q[h, i * TS:(i + 1) * TS]
+                               for h in range(H) for i in range(T)})
+    Kc = LocalCollection("K", {(h, i): k[h, i * TS:(i + 1) * TS]
+                               for h in range(H) for i in range(T)})
+    Vc = LocalCollection("V", {(h, i): v[h, i * TS:(i + 1) * TS]
+                               for h in range(H) for i in range(T)})
+    Y = LocalCollection("Y", {(i,): None for i in range(T)})
+    tp = build_transformer_block(Qc, Kc, Vc, Y, H, T, TS, DH, Wo, W1, W2)
+    ref = reference_block(q, k, v, Wo, W1, W2)
+    return tp, Y, ref, T, TS
+
+
+def test_transformer_checker(rng):
+    tp, *_ = _setup(rng)
+    ptg.check_taskpool(tp)
+
+
+def test_transformer_block_matches_dense(ctx, rng):
+    """Streaming online-softmax chain must equal dense softmax attention
+    + FFN."""
+    tp, Y, ref, T, TS = _setup(rng)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=120)
+    got = np.concatenate([np.asarray(Y.data_of((i,))) for i in range(T)])
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)
+
+
+def test_transformer_bigger_config(ctx, rng):
+    tp, Y, ref, T, TS = _setup(rng, H=4, T=4, TS=16, DH=8, F=64)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=120)
+    got = np.concatenate([np.asarray(Y.data_of((i,))) for i in range(T)])
+    np.testing.assert_allclose(got, ref, rtol=5e-3, atol=5e-3)
