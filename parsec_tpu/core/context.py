@@ -37,7 +37,12 @@ from .. import termdet as termdet_mod
 mca_param.register("runtime.nb_cores", 0, help="worker streams (0 = os.cpu_count())")
 mca_param.register("runtime.backoff_min_us", 50, help="starvation backoff floor")
 mca_param.register("runtime.backoff_max_us", 2000, help="starvation backoff ceiling")
-mca_param.register("vpmap", "flat", help="virtual-process map: 'flat' or 'nb:SIZE'")
+mca_param.register("vpmap", "flat",
+                   help="virtual-process map: flat | nb:SIZE | "
+                        "list:0,0,1,... | file:PATH")
+mca_param.register("profiling.dot", "",
+                   help="capture the executed DAG to this .dot file at "
+                        "fini (--dot flag, parsec.c:589-607 analog)")
 
 
 class ExecutionStream:
@@ -60,13 +65,10 @@ class ExecutionStream:
 
 
 def _parse_vpmap(nb_cores: int) -> List[int]:
-    """Return vp_id per stream. 'flat' = single VP; 'nb:SIZE' = VPs of SIZE
-    streams (reference vpmap.c:162-368 simplified)."""
-    spec = str(mca_param.get("vpmap", "flat"))
-    if spec.startswith("nb:"):
-        size = max(1, int(spec[3:]))
-        return [i // size for i in range(nb_cores)]
-    return [0] * nb_cores
+    """Return vp_id per stream (reference vpmap.c:162-368; spec grammar
+    in utils/vpmap.py: flat | nb:SIZE | list:... | file:PATH)."""
+    from ..utils import vpmap
+    return vpmap.parse(str(mca_param.get("vpmap", "flat")), nb_cores)
 
 
 class Context:
@@ -115,6 +117,10 @@ class Context:
         # trace/grapher init (task_profiler installs a Trace on self.trace)
         from ..profiling import pins_modules as pins_modules_mod
         self.pins_modules = pins_modules_mod.install_selected(self)
+        self._dot_path = str(mca_param.get("profiling.dot", "") or "")
+        if self._dot_path:
+            from ..profiling.grapher import Grapher
+            Grapher().install(self)     # written out at fini
 
         if comm is not None and hasattr(comm, "install_activate_handler"):
             comm.install_activate_handler(self)
@@ -196,6 +202,12 @@ class Context:
         if self.comm is not None:
             self.comm.disable()
         self.scheduler.remove(self)
+        if self._dot_path and self.grapher is not None:
+            try:
+                self.grapher.write(self._dot_path)
+            except OSError as exc:
+                warning("profiling", "could not write %s: %s",
+                        self._dot_path, exc)
         # MCA-selected PINS modules report at component close then detach
         # (reference modules print their data in their _fini)
         from ..utils.debug import get_verbosity
@@ -373,9 +385,18 @@ class Context:
 
 
 def init(nb_cores: Optional[int] = None, scheduler: Optional[str] = None,
-         comm=None) -> Context:
-    """parsec_init analog."""
-    return Context(nb_cores=nb_cores, scheduler=scheduler, comm=comm)
+         comm=None, argv: Optional[Sequence[str]] = None) -> Context:
+    """parsec_init analog. ``argv`` (if given) is parsed for runtime
+    options (--mca/--cores/--vpmap/--sched/...; parsec.c:411-463) before
+    the context is built; leftover arguments are stored on
+    ``context.argv_rest``."""
+    rest = None
+    if argv is not None:
+        from ..utils import cmd_line
+        rest = cmd_line.parse(list(argv))
+    ctx = Context(nb_cores=nb_cores, scheduler=scheduler, comm=comm)
+    ctx.argv_rest = rest
+    return ctx
 
 
 def fini(context: Context) -> None:

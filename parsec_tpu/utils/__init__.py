@@ -1,2 +1,5 @@
 from . import mca_param
 from . import debug
+from . import vpmap
+from . import cmd_line
+from .zone_malloc import ZoneAllocator

@@ -1,0 +1,88 @@
+"""Command-line parsing for runtime options (reference
+parsec/utils/cmd_line.c + the option table in parsec_init,
+parsec.c:411-463).
+
+Recognized options (each also settable as an MCA param):
+
+    --mca <key> <value>     set any MCA parameter
+    -c / --cores N          worker streams          (runtime.nb_cores)
+    -V / --vpmap SPEC       virtual-process map     (vpmap)
+    --sched NAME            scheduler module        (sched)
+    --pins M1,M2            PINS modules            (pins)
+    --dot FILE              DAG capture to FILE     (profiling.dot)
+    -h / --help             return the help text instead of parsing on
+
+``parse`` applies recognized options to the MCA registry and returns the
+leftover argv (the reference hands those back to the application).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from . import mca_param
+
+_OPTIONS = {
+    # flag           (mca name,          takes_value)
+    "-c":            ("runtime.nb_cores", True),
+    "--cores":       ("runtime.nb_cores", True),
+    "-V":            ("vpmap", True),
+    "--vpmap":       ("vpmap", True),
+    "--sched":       ("sched", True),
+    "--pins":        ("pins", True),
+    "--dot":         ("profiling.dot", True),
+}
+
+
+class HelpRequested(Exception):
+    """Raised by parse() on -h/--help; carries the help text."""
+
+    def __init__(self, text: str):
+        super().__init__(text)
+        self.text = text
+
+
+def help_text() -> str:
+    """The --help dump: every registered MCA parameter with its current
+    value (parsec.c:903-918 analog)."""
+    lines = ["parsec_tpu runtime options:",
+             "  --mca <key> <value>   set an MCA parameter", ""]
+    for flag, (name, _) in sorted(_OPTIONS.items()):
+        lines.append(f"  {flag:<22}-> {name}")
+    lines.append("")
+    lines.append("MCA parameters (name = value, default, help):")
+    for row in mca_param.dump():
+        lines.append(f"  {row['name']} = {row['value']!r} "
+                     f"(default {row['default']!r}) — {row['help']}")
+    return "\n".join(lines)
+
+
+def parse(argv: List[str]) -> List[str]:
+    """Apply recognized options to the MCA registry; return leftover argv.
+    Raises :class:`HelpRequested` on ``-h``/``--help``."""
+    argv = mca_param.parse_cli(list(argv))
+    out: List[str] = []
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "--mca":
+            # parse_cli only consumes complete triples; a surviving --mca
+            # means the key or value is missing
+            raise ValueError("--mca requires a key and a value")
+        if arg in ("-h", "--help"):
+            raise HelpRequested(help_text())
+        opt = _OPTIONS.get(arg)
+        if opt is None:
+            out.append(arg)
+            i += 1
+            continue
+        name, takes_value = opt
+        if takes_value:
+            if i + 1 >= len(argv):
+                raise ValueError(f"{arg} requires a value")
+            mca_param.set(name, argv[i + 1])
+            i += 2
+        else:
+            mca_param.set(name, True)
+            i += 1
+    return out

@@ -1,0 +1,94 @@
+"""Zone allocator: segment bookkeeping for a device heap.
+
+Reference: parsec/utils/zone_malloc.c — the CUDA device module carves
+one big device allocation into tile-sized segments with this allocator
+(unit-granular first-fit with segment merge on free). On TPU the XLA
+runtime owns physical HBM, but the device layer still needs the same
+*accounting* structure to decide eviction (LRU over zone segments,
+device_gpu.h:115-136) and to answer "does this tile set fit" before
+scheduling a task's stage-in. Offsets returned here index a logical
+heap, e.g. slots of a stacked tile store."""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class ZoneAllocator:
+    """First-fit allocator over ``capacity`` bytes with ``unit``-byte
+    granularity (zone_malloc keeps unit-counted segments)."""
+
+    def __init__(self, capacity: int, unit: int = 512):
+        if capacity <= 0 or unit <= 0:
+            raise ValueError("capacity and unit must be positive")
+        self.unit = unit
+        # round DOWN: handing out the partial trailing unit would let a
+        # full-unit write overrun the real heap
+        self.nb_units = capacity // unit
+        if self.nb_units == 0:
+            raise ValueError(f"capacity {capacity} < one unit ({unit})")
+        # free segments as sorted (start_unit, n_units)
+        self._free: List[Tuple[int, int]] = [(0, self.nb_units)]
+        self._used: Dict[int, int] = {}        # start_unit -> n_units
+        self._lock = threading.Lock()
+
+    @property
+    def capacity(self) -> int:
+        return self.nb_units * self.unit
+
+    def bytes_free(self) -> int:
+        with self._lock:
+            return sum(n for _, n in self._free) * self.unit
+
+    def bytes_used(self) -> int:
+        with self._lock:
+            return sum(self._used.values()) * self.unit
+
+    def malloc(self, nbytes: int) -> Optional[int]:
+        """Reserve ``nbytes``; returns the byte offset or None when no
+        segment fits (caller evicts and retries — the GPU module's
+        reserve/evict loop, device_cuda_module.c:864)."""
+        if nbytes <= 0:
+            raise ValueError("malloc size must be positive")
+        units = (nbytes + self.unit - 1) // self.unit
+        with self._lock:
+            for idx, (start, n) in enumerate(self._free):
+                if n >= units:
+                    if n == units:
+                        self._free.pop(idx)
+                    else:
+                        self._free[idx] = (start + units, n - units)
+                    self._used[start] = units
+                    return start * self.unit
+        return None
+
+    def free(self, offset: int) -> None:
+        """Release a segment and merge with free neighbors."""
+        start = offset // self.unit
+        with self._lock:
+            units = self._used.pop(start, None)
+            if units is None:
+                raise ValueError(f"free of unallocated offset {offset}")
+            # sorted insert, then merge with at most the two adjacent
+            # neighbors — free sits on the device eviction path
+            idx = bisect.bisect_left(self._free, (start, units))
+            self._free.insert(idx, (start, units))
+            if idx + 1 < len(self._free) and \
+                    start + units == self._free[idx + 1][0]:
+                nxt = self._free.pop(idx + 1)
+                self._free[idx] = (start, units + nxt[1])
+            if idx > 0:
+                p_start, p_units = self._free[idx - 1]
+                if p_start + p_units == start:
+                    cur = self._free.pop(idx)
+                    self._free[idx - 1] = (p_start, p_units + cur[1])
+
+    def fragmentation(self) -> float:
+        """1 − largest_free/total_free (0 = one contiguous free block)."""
+        with self._lock:
+            total = sum(n for _, n in self._free)
+            if total == 0:
+                return 0.0
+            return 1.0 - max(n for _, n in self._free) / total

@@ -1,0 +1,165 @@
+"""Utility-layer tests: vpmap specs, cmd-line parsing/help, zone
+allocator (reference vpmap.c, cmd_line.c, zone_malloc.c)."""
+
+import pytest
+
+import parsec_tpu as parsec
+from parsec_tpu.utils import ZoneAllocator, cmd_line, mca_param, vpmap
+
+
+# ------------------------------------------------------------------ vpmap
+def test_vpmap_flat():
+    assert vpmap.parse("flat", 4) == [0, 0, 0, 0]
+
+
+def test_vpmap_nb():
+    assert vpmap.parse("nb:2", 5) == [0, 0, 1, 1, 2]
+
+
+def test_vpmap_list():
+    assert vpmap.parse("list:0,0,1,1", 4) == [0, 0, 1, 1]
+    with pytest.raises(ValueError):
+        vpmap.parse("list:0,2", 2)       # not dense
+    with pytest.raises(ValueError):
+        vpmap.parse("list:0", 2)         # too short
+
+
+def test_vpmap_file(tmp_path):
+    f = tmp_path / "vp.map"
+    f.write_text("2\n1  # second vp\n")
+    assert vpmap.parse(f"file:{f}", 3) == [0, 0, 1]
+    assert vpmap.parse(f"file:{f}", 5) == [0, 0, 1, 2, 2]
+
+
+def test_vpmap_scopes_stealing():
+    """Streams in different VPs must not steal across the boundary."""
+    mca_param.set("vpmap", "nb:2")
+    try:
+        c = parsec.init(nb_cores=4, scheduler="lfq")
+        vp_ids = [es.vp_id for es in c.streams]
+        assert vp_ids == [0, 0, 1, 1]
+        from parsec_tpu.sched.base import vp_peers
+        peers0 = vp_peers(c.streams[0])
+        assert all(s.vp_id == 0 for s in peers0)
+        parsec.fini(c)
+    finally:
+        mca_param.unset("vpmap")
+
+
+# --------------------------------------------------------------- cmd line
+def test_cmd_line_options():
+    rest = cmd_line.parse(["prog", "--sched", "spq", "--mca",
+                           "dtd.window_size", "64", "positional"])
+    try:
+        assert rest == ["prog", "positional"]
+        assert mca_param.get("sched") == "spq"
+        assert int(mca_param.get("dtd.window_size")) == 64
+    finally:
+        mca_param.unset("sched")
+        mca_param.unset("dtd.window_size")
+
+
+def test_cmd_line_help():
+    with pytest.raises(cmd_line.HelpRequested) as ei:
+        cmd_line.parse(["--help"])
+    assert "MCA parameters" in ei.value.text
+    assert "sched" in ei.value.text
+
+
+def test_cmd_line_missing_value():
+    with pytest.raises(ValueError):
+        cmd_line.parse(["--sched"])
+
+
+def test_init_with_argv():
+    ctx = parsec.init(nb_cores=2, argv=["app", "--vpmap", "flat", "x"])
+    try:
+        assert ctx.argv_rest == ["app", "x"]
+    finally:
+        parsec.fini(ctx)
+        mca_param.unset("vpmap")
+
+
+# ---------------------------------------------------------- zone allocator
+def test_zone_alloc_basic():
+    z = ZoneAllocator(4096, unit=512)
+    a = z.malloc(1000)          # 2 units
+    b = z.malloc(512)           # 1 unit
+    assert a == 0 and b == 1024
+    assert z.bytes_used() == 1536
+    z.free(a)
+    c = z.malloc(512)
+    assert c == 0               # first fit reuses the hole
+    assert z.bytes_free() == 4096 - 1024
+
+
+def test_zone_alloc_exhaustion_and_merge():
+    z = ZoneAllocator(2048, unit=512)
+    offs = [z.malloc(512) for _ in range(4)]
+    assert z.malloc(512) is None
+    z.free(offs[1])
+    z.free(offs[2])
+    assert z.fragmentation() == 0.0      # adjacent holes merged
+    assert z.malloc(1024) == 512         # fits the merged segment
+    z.free(offs[0])
+    z.free(offs[3])
+
+
+def test_zone_capacity_rounds_down():
+    z = ZoneAllocator(1000, unit=512)
+    assert z.capacity == 512            # partial trailing unit unusable
+    assert z.malloc(1024) is None
+    with pytest.raises(ValueError):
+        ZoneAllocator(100, unit=512)    # smaller than one unit
+
+
+def test_cmd_line_incomplete_mca_raises():
+    with pytest.raises(ValueError):
+        cmd_line.parse(["--mca", "dtd.window_size"])
+
+
+def test_vpmap_file_rejects_bad_sizes(tmp_path):
+    f = tmp_path / "vp.map"
+    f.write_text("0\n2\n")
+    with pytest.raises(ValueError):
+        vpmap.parse(f"file:{f}", 2)
+
+
+def test_dot_flag_writes_dag(tmp_path):
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl import ptg
+    path = tmp_path / "dag.dot"
+    mca_param.set("profiling.dot", str(path))
+    try:
+        ctx = parsec.init(nb_cores=2)
+        ctx.start()
+        S = LocalCollection("S", {("x",): 0})
+        tp = ptg.Taskpool("one", S=S)
+        T = tp.task_class(
+            "T", params=("i",), space=lambda g: ((0,),),
+            flows=[ptg.FlowSpec(
+                "X", ptg.RW,
+                ins=[ptg.In(data=lambda g, i: (g.S, ("x",)))],
+                outs=[ptg.Out(data=lambda g, i: (g.S, ("x",)))])])
+
+        @T.body
+        def b(task, x):
+            return x + 1
+
+        ctx.add_taskpool(tp)
+        assert ctx.wait(timeout=30)
+        parsec.fini(ctx)
+        text = path.read_text()
+        assert "digraph" in text and "T(0)" in text
+    finally:
+        mca_param.unset("profiling.dot")
+
+
+def test_zone_alloc_errors():
+    z = ZoneAllocator(1024)
+    with pytest.raises(ValueError):
+        z.free(0)
+    with pytest.raises(ValueError):
+        z.malloc(0)
+    with pytest.raises(ValueError):
+        ZoneAllocator(0)
