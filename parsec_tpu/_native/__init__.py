@@ -68,6 +68,37 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pgraph_run.restype = ctypes.c_int
     lib.pgraph_remaining.argtypes = [p]
     lib.pgraph_remaining.restype = u32
+    # foundation classes (reference parsec/class/*)
+    lib.plifo_new.argtypes = [u32]
+    lib.plifo_new.restype = p
+    lib.plifo_free.argtypes = [p]
+    lib.plifo_push.argtypes = [p, u64]
+    lib.plifo_push.restype = ctypes.c_int
+    lib.plifo_pop.argtypes = [p, ctypes.POINTER(u64)]
+    lib.plifo_pop.restype = ctypes.c_int
+    lib.plifo_size.argtypes = [p]
+    lib.plifo_size.restype = u32
+    lib.phash_new.argtypes = [u32]
+    lib.phash_new.restype = p
+    lib.phash_free.argtypes = [p]
+    lib.phash_insert.argtypes = [p, u64, u64]
+    lib.phash_insert.restype = ctypes.c_int
+    lib.phash_find.argtypes = [p, u64, ctypes.POINTER(u64)]
+    lib.phash_find.restype = ctypes.c_int
+    lib.phash_remove.argtypes = [p, u64, ctypes.POINTER(u64)]
+    lib.phash_remove.restype = ctypes.c_int
+    lib.phash_size.argtypes = [p]
+    lib.phash_size.restype = u64
+    lib.pmempool_new.argtypes = [u32, ctypes.c_int]
+    lib.pmempool_new.restype = p
+    lib.pmempool_free.argtypes = [p]
+    lib.pmempool_alloc.argtypes = [p, ctypes.c_int]
+    lib.pmempool_alloc.restype = p
+    lib.pmempool_release.argtypes = [p, ctypes.c_int, p]
+    lib.pmempool_outstanding.argtypes = [p]
+    lib.pmempool_outstanding.restype = u64
+    lib.pmempool_allocated.argtypes = [p]
+    lib.pmempool_allocated.restype = u64
     return lib
 
 

@@ -25,7 +25,9 @@
 #include <cstring>
 #include <deque>
 #include <mutex>
+#include <memory>
 #include <new>
+#include <shared_mutex>
 #include <thread>
 #include <unordered_map>
 #include <vector>
@@ -326,6 +328,294 @@ int pgraph_run(void* gp) {
 
 uint32_t pgraph_remaining(void* gp) {
   return static_cast<PGraph*>(gp)->remaining.load();
+}
+
+// ---------------------------------------------------------------------------
+// plifo: lock-free LIFO of uint64 items (reference parsec/class/lifo.h —
+// the basis of mempools and freelists). ABA protection: the head word
+// packs (node index : 32 | generation tag : 32) and nodes live in a
+// fixed pool, so a recycled index can't be mistaken for the old one
+// unless the 32-bit tag also wraps within one CAS window.
+// ---------------------------------------------------------------------------
+
+struct PlifoNode {
+  uint64_t item;
+  // relaxed atomic: a popper may read the next of a node it does not yet
+  // own; the stale value is discarded by the tag CAS, but the access
+  // itself must not be a C++ data race
+  std::atomic<uint32_t> next;
+};
+
+struct Plifo {
+  static constexpr uint32_t kNil = 0xffffffffu;
+  std::unique_ptr<PlifoNode[]> pool;
+  std::atomic<uint64_t> head{((uint64_t)kNil) << 32};  // (idx<<32 | tag)... see pack
+  std::atomic<uint64_t> free_head;
+  std::atomic<uint32_t> size{0};
+
+  static uint64_t pack(uint32_t idx, uint32_t tag) {
+    return ((uint64_t)idx << 32) | tag;
+  }
+  static uint32_t idx_of(uint64_t w) { return (uint32_t)(w >> 32); }
+  static uint32_t tag_of(uint64_t w) { return (uint32_t)w; }
+};
+
+void* plifo_new(uint32_t capacity) {
+  Plifo* l = new (std::nothrow) Plifo();
+  if (!l) return nullptr;
+  if (capacity == 0) capacity = 1;
+  l->pool.reset(new (std::nothrow) PlifoNode[capacity]);
+  if (!l->pool) {
+    delete l;
+    return nullptr;
+  }
+  // chain every node onto the free list
+  for (uint32_t i = 0; i < capacity; ++i)
+    l->pool[i].next.store((i + 1 < capacity) ? i + 1 : Plifo::kNil,
+                          std::memory_order_relaxed);
+  l->free_head.store(Plifo::pack(0, 0), std::memory_order_relaxed);
+  l->head.store(Plifo::pack(Plifo::kNil, 0), std::memory_order_relaxed);
+  return l;
+}
+
+void plifo_free(void* lp) { delete static_cast<Plifo*>(lp); }
+
+uint32_t plifo_size(void* lp) {
+  return static_cast<Plifo*>(lp)->size.load(std::memory_order_relaxed);
+}
+
+// internal: pop a node index off a packed stack head
+static uint32_t plifo_stack_pop(Plifo* l, std::atomic<uint64_t>& h) {
+  uint64_t old = h.load(std::memory_order_acquire);
+  while (true) {
+    uint32_t idx = Plifo::idx_of(old);
+    if (idx == Plifo::kNil) return Plifo::kNil;
+    uint64_t next = Plifo::pack(
+        l->pool[idx].next.load(std::memory_order_relaxed),
+        Plifo::tag_of(old) + 1);
+    if (h.compare_exchange_weak(old, next, std::memory_order_acq_rel))
+      return idx;
+  }
+}
+
+static void plifo_stack_push(Plifo* l, std::atomic<uint64_t>& h,
+                             uint32_t idx) {
+  uint64_t old = h.load(std::memory_order_acquire);
+  while (true) {
+    l->pool[idx].next.store(Plifo::idx_of(old), std::memory_order_relaxed);
+    uint64_t desired = Plifo::pack(idx, Plifo::tag_of(old) + 1);
+    if (h.compare_exchange_weak(old, desired, std::memory_order_acq_rel))
+      return;
+  }
+}
+
+int plifo_push(void* lp, uint64_t item) {
+  Plifo* l = static_cast<Plifo*>(lp);
+  uint32_t idx = plifo_stack_pop(l, l->free_head);
+  if (idx == Plifo::kNil) return -1;  // pool exhausted
+  l->pool[idx].item = item;
+  plifo_stack_push(l, l->head, idx);
+  l->size.fetch_add(1, std::memory_order_relaxed);
+  return 0;
+}
+
+int plifo_pop(void* lp, uint64_t* out) {
+  Plifo* l = static_cast<Plifo*>(lp);
+  uint32_t idx = plifo_stack_pop(l, l->head);
+  if (idx == Plifo::kNil) return 0;
+  *out = l->pool[idx].item;
+  plifo_stack_push(l, l->free_head, idx);
+  l->size.fetch_sub(1, std::memory_order_relaxed);
+  return 1;
+}
+
+// ---------------------------------------------------------------------------
+// phash: bucket-locked resizable hash table, uint64 key -> uint64 value
+// (reference parsec/class/parsec_hash_table.c: fine-grain bucket locks,
+// resize when the load factor exceeds a threshold). Readers/writers hold
+// the table lock shared + their bucket mutex; resize holds it unique.
+// ---------------------------------------------------------------------------
+
+struct PhashBucket {
+  std::mutex mu;
+  std::vector<std::pair<uint64_t, uint64_t>> items;
+};
+
+struct Phash {
+  std::shared_mutex table_mu;
+  std::vector<PhashBucket> buckets;
+  std::atomic<uint64_t> size{0};
+
+  static uint64_t mix(uint64_t k) {
+    k ^= k >> 33;
+    k *= 0xff51afd7ed558ccdull;
+    k ^= k >> 33;
+    return k;
+  }
+  PhashBucket& bucket(uint64_t key) {
+    return buckets[mix(key) & (buckets.size() - 1)];
+  }
+  void maybe_resize();
+};
+
+void Phash::maybe_resize() {
+  // amortized: grow ×4 when avg bucket chain exceeds 4
+  if (size.load(std::memory_order_relaxed) <= buckets.size() * 4) return;
+  std::unique_lock<std::shared_mutex> lk(table_mu);
+  if (size.load(std::memory_order_relaxed) <= buckets.size() * 4) return;
+  std::vector<PhashBucket> next(buckets.size() * 4);
+  for (auto& b : buckets)
+    for (auto& kv : b.items)
+      next[mix(kv.first) & (next.size() - 1)].items.push_back(kv);
+  buckets.swap(next);
+}
+
+void* phash_new(uint32_t nbuckets_hint) {
+  Phash* h = new (std::nothrow) Phash();
+  if (!h) return nullptr;
+  if (nbuckets_hint > (1u << 20)) nbuckets_hint = 1u << 20;  // sane cap;
+  // the table resizes itself past this anyway
+  uint32_t n = 16;
+  while (n < nbuckets_hint) n <<= 1;
+  try {
+    h->buckets = std::vector<PhashBucket>(n);
+  } catch (...) {
+    delete h;
+    return nullptr;
+  }
+  return h;
+}
+
+void phash_free(void* hp) { delete static_cast<Phash*>(hp); }
+
+uint64_t phash_size(void* hp) {
+  return static_cast<Phash*>(hp)->size.load(std::memory_order_relaxed);
+}
+
+int phash_insert(void* hp, uint64_t key, uint64_t val) {
+  Phash* h = static_cast<Phash*>(hp);
+  {
+    std::shared_lock<std::shared_mutex> tl(h->table_mu);
+    PhashBucket& b = h->bucket(key);
+    std::lock_guard<std::mutex> lk(b.mu);
+    for (auto& kv : b.items)
+      if (kv.first == key) {
+        kv.second = val;
+        return 1;  // replaced
+      }
+    b.items.emplace_back(key, val);
+    h->size.fetch_add(1, std::memory_order_relaxed);
+  }
+  h->maybe_resize();
+  return 0;
+}
+
+int phash_find(void* hp, uint64_t key, uint64_t* out) {
+  Phash* h = static_cast<Phash*>(hp);
+  std::shared_lock<std::shared_mutex> tl(h->table_mu);
+  PhashBucket& b = h->bucket(key);
+  std::lock_guard<std::mutex> lk(b.mu);
+  for (auto& kv : b.items)
+    if (kv.first == key) {
+      if (out) *out = kv.second;
+      return 1;
+    }
+  return 0;
+}
+
+int phash_remove(void* hp, uint64_t key, uint64_t* out) {
+  Phash* h = static_cast<Phash*>(hp);
+  std::shared_lock<std::shared_mutex> tl(h->table_mu);
+  PhashBucket& b = h->bucket(key);
+  std::lock_guard<std::mutex> lk(b.mu);
+  for (size_t i = 0; i < b.items.size(); ++i)
+    if (b.items[i].first == key) {
+      if (out) *out = b.items[i].second;
+      b.items[i] = b.items.back();
+      b.items.pop_back();
+      h->size.fetch_sub(1, std::memory_order_relaxed);
+      return 1;
+    }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// pmempool: per-thread freelists of fixed-size elements (reference
+// parsec/mempool.c: thread-owned freelists with cross-thread release —
+// an element released by another thread goes to the shared overflow).
+// ---------------------------------------------------------------------------
+
+struct Pmempool {
+  uint32_t elt_size;
+  int nthreads;
+  std::vector<std::vector<void*>> local;  // per-thread freelist
+  std::vector<std::mutex> local_mu;       // cross-thread release guard
+  std::atomic<uint64_t> outstanding{0};
+  std::atomic<uint64_t> allocated{0};
+};
+
+void* pmempool_new(uint32_t elt_size, int nthreads) {
+  if (elt_size == 0 || nthreads < 1) return nullptr;
+  Pmempool* p = new (std::nothrow) Pmempool();
+  if (!p) return nullptr;
+  p->elt_size = elt_size < 8 ? 8 : elt_size;
+  p->nthreads = nthreads;
+  try {
+    p->local = std::vector<std::vector<void*>>(nthreads);
+    p->local_mu = std::vector<std::mutex>(nthreads);
+  } catch (...) {
+    delete p;
+    return nullptr;
+  }
+  return p;
+}
+
+void pmempool_free(void* pp) {
+  Pmempool* p = static_cast<Pmempool*>(pp);
+  for (auto& fl : p->local)
+    for (void* e : fl) ::operator delete(e);
+  delete p;
+}
+
+void* pmempool_alloc(void* pp, int thread) {
+  Pmempool* p = static_cast<Pmempool*>(pp);
+  if (thread < 0 || thread >= p->nthreads) thread = 0;
+  void* e = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(p->local_mu[thread]);
+    auto& fl = p->local[thread];
+    if (!fl.empty()) {
+      e = fl.back();
+      fl.pop_back();
+    }
+  }
+  if (!e) {
+    e = ::operator new(p->elt_size, std::nothrow);
+    if (!e) return nullptr;
+    p->allocated.fetch_add(1, std::memory_order_relaxed);
+  }
+  p->outstanding.fetch_add(1, std::memory_order_relaxed);
+  return e;
+}
+
+void pmempool_release(void* pp, int thread, void* elt) {
+  Pmempool* p = static_cast<Pmempool*>(pp);
+  if (thread < 0 || thread >= p->nthreads) thread = 0;
+  {
+    std::lock_guard<std::mutex> lk(p->local_mu[thread]);
+    p->local[thread].push_back(elt);
+  }
+  p->outstanding.fetch_sub(1, std::memory_order_relaxed);
+}
+
+uint64_t pmempool_outstanding(void* pp) {
+  return static_cast<Pmempool*>(pp)->outstanding.load(
+      std::memory_order_relaxed);
+}
+
+uint64_t pmempool_allocated(void* pp) {
+  return static_cast<Pmempool*>(pp)->allocated.load(
+      std::memory_order_relaxed);
 }
 
 }  // extern "C"

@@ -29,11 +29,12 @@ def parse(spec: str, nb_cores: int) -> List[int]:
         return [i // size for i in range(nb_cores)]
     if spec.startswith("list:"):
         ids = [int(x) for x in spec[5:].split(",") if x.strip() != ""]
-        if len(ids) < nb_cores:
+        if len(ids) != nb_cores:
+            # truncating a longer map could silently drop whole VPs (or
+            # leave non-dense ids) — require an exact match
             raise ValueError(
                 f"vpmap list names {len(ids)} streams, context has "
                 f"{nb_cores}")
-        ids = ids[:nb_cores]
         _check_dense(ids)
         return ids
     if spec.startswith("file:"):

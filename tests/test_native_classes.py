@@ -1,0 +1,157 @@
+"""Native foundation-class tests (reference tests/class/{lifo,hash}.c —
+multithreaded stress of each container). ctypes releases the GIL per
+call, so Python threads genuinely contend inside the C++ structures."""
+
+import ctypes
+import threading
+
+import pytest
+
+from parsec_tpu import _native
+
+lib = _native.load()
+pytestmark = pytest.mark.skipif(lib is None,
+                                reason="native toolchain unavailable")
+
+
+# ------------------------------------------------------------------ LIFO
+def test_lifo_basic():
+    l = lib.plifo_new(16)
+    out = ctypes.c_uint64(0)
+    assert lib.plifo_pop(l, ctypes.byref(out)) == 0
+    assert lib.plifo_push(l, 41) == 0
+    assert lib.plifo_push(l, 42) == 0
+    assert lib.plifo_size(l) == 2
+    assert lib.plifo_pop(l, ctypes.byref(out)) == 1 and out.value == 42
+    assert lib.plifo_pop(l, ctypes.byref(out)) == 1 and out.value == 41
+    assert lib.plifo_pop(l, ctypes.byref(out)) == 0
+    lib.plifo_free(l)
+
+
+def test_lifo_capacity():
+    l = lib.plifo_new(2)
+    assert lib.plifo_push(l, 1) == 0
+    assert lib.plifo_push(l, 2) == 0
+    assert lib.plifo_push(l, 3) == -1        # pool exhausted
+    lib.plifo_free(l)
+
+
+def test_lifo_multithreaded_conservation():
+    """N threads push/pop concurrently; every pushed item is popped
+    exactly once (the reference lifo stress invariant)."""
+    nthreads, per = 8, 2000
+    l = lib.plifo_new(nthreads * per)
+    popped = [[] for _ in range(nthreads)]
+
+    def worker(t):
+        out = ctypes.c_uint64(0)
+        for i in range(per):
+            assert lib.plifo_push(l, t * per + i) == 0
+            if lib.plifo_pop(l, ctypes.byref(out)):
+                popped[t].append(out.value)
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(nthreads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    out = ctypes.c_uint64(0)
+    drained = []
+    while lib.plifo_pop(l, ctypes.byref(out)):
+        drained.append(out.value)
+    all_items = sorted(x for lst in popped for x in lst) + sorted(drained)
+    assert sorted(all_items) == list(range(nthreads * per))
+    lib.plifo_free(l)
+
+
+# ------------------------------------------------------------- hash table
+def test_hash_basic():
+    h = lib.phash_new(16)
+    out = ctypes.c_uint64(0)
+    assert lib.phash_insert(h, 7, 70) == 0
+    assert lib.phash_insert(h, 7, 71) == 1          # replace
+    assert lib.phash_find(h, 7, ctypes.byref(out)) == 1 and out.value == 71
+    assert lib.phash_find(h, 8, ctypes.byref(out)) == 0
+    assert lib.phash_remove(h, 7, ctypes.byref(out)) == 1 and out.value == 71
+    assert lib.phash_remove(h, 7, ctypes.byref(out)) == 0
+    assert lib.phash_size(h) == 0
+    lib.phash_free(h)
+
+
+def test_hash_resize_under_load():
+    """Insert far beyond the initial bucket hint — the resize path must
+    keep every entry reachable."""
+    h = lib.phash_new(16)
+    n = 20000
+    for k in range(n):
+        assert lib.phash_insert(h, k, k * 3) == 0
+    assert lib.phash_size(h) == n
+    out = ctypes.c_uint64(0)
+    for k in range(0, n, 97):
+        assert lib.phash_find(h, k, ctypes.byref(out)) == 1
+        assert out.value == k * 3
+    lib.phash_free(h)
+
+
+def test_hash_multithreaded_disjoint_keys():
+    h = lib.phash_new(64)
+    nthreads, per = 8, 4000
+
+    def worker(t):
+        out = ctypes.c_uint64(0)
+        base = t << 32
+        for i in range(per):
+            lib.phash_insert(h, base + i, i)
+        for i in range(per):
+            assert lib.phash_find(h, base + i, ctypes.byref(out)) == 1
+            assert out.value == i
+        for i in range(0, per, 2):
+            assert lib.phash_remove(h, base + i, None) == 1
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(nthreads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert lib.phash_size(h) == nthreads * per // 2
+    lib.phash_free(h)
+
+
+# ---------------------------------------------------------------- mempool
+def test_mempool_reuse():
+    p = lib.pmempool_new(128, 2)
+    a = lib.pmempool_alloc(p, 0)
+    b = lib.pmempool_alloc(p, 0)
+    assert a and b and a != b
+    assert lib.pmempool_outstanding(p) == 2
+    lib.pmempool_release(p, 0, a)
+    c = lib.pmempool_alloc(p, 0)
+    assert c == a                       # freelist reuse
+    assert lib.pmempool_allocated(p) == 2
+    lib.pmempool_release(p, 0, b)
+    lib.pmempool_release(p, 0, c)
+    assert lib.pmempool_outstanding(p) == 0
+    lib.pmempool_free(p)
+
+
+def test_mempool_cross_thread_release():
+    """Alloc on one thread, release on another (the reference's
+    cross-thread release path)."""
+    p = lib.pmempool_new(64, 4)
+    elts = [lib.pmempool_alloc(p, 0) for _ in range(100)]
+
+    def releaser():
+        for e in elts:
+            lib.pmempool_release(p, 3, e)
+
+    t = threading.Thread(target=releaser)
+    t.start()
+    t.join()
+    assert lib.pmempool_outstanding(p) == 0
+    # thread 3's freelist now serves its allocs without new memory
+    before = lib.pmempool_allocated(p)
+    again = [lib.pmempool_alloc(p, 3) for _ in range(100)]
+    assert lib.pmempool_allocated(p) == before
+    for e in again:
+        lib.pmempool_release(p, 3, e)
+    lib.pmempool_free(p)
