@@ -444,6 +444,10 @@ struct PhashBucket {
 struct Phash {
   std::shared_mutex table_mu;
   std::vector<PhashBucket> buckets;
+  // bucket count mirrored atomically: the resize fast-path check reads
+  // it without the table lock (buckets.size() itself would race with a
+  // concurrent swap under the unique lock)
+  std::atomic<uint64_t> nbuckets{0};
   std::atomic<uint64_t> size{0};
 
   static uint64_t mix(uint64_t k) {
@@ -460,7 +464,9 @@ struct Phash {
 
 void Phash::maybe_resize() {
   // amortized: grow ×4 when avg bucket chain exceeds 4
-  if (size.load(std::memory_order_relaxed) <= buckets.size() * 4) return;
+  if (size.load(std::memory_order_relaxed) <=
+      nbuckets.load(std::memory_order_relaxed) * 4)
+    return;
   std::unique_lock<std::shared_mutex> lk(table_mu);
   if (size.load(std::memory_order_relaxed) <= buckets.size() * 4) return;
   std::vector<PhashBucket> next(buckets.size() * 4);
@@ -468,6 +474,7 @@ void Phash::maybe_resize() {
     for (auto& kv : b.items)
       next[mix(kv.first) & (next.size() - 1)].items.push_back(kv);
   buckets.swap(next);
+  nbuckets.store(buckets.size(), std::memory_order_relaxed);
 }
 
 void* phash_new(uint32_t nbuckets_hint) {
@@ -483,6 +490,7 @@ void* phash_new(uint32_t nbuckets_hint) {
     delete h;
     return nullptr;
   }
+  h->nbuckets.store(n, std::memory_order_relaxed);
   return h;
 }
 

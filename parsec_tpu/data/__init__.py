@@ -21,5 +21,6 @@ from .matrix import (TiledMatrix, TwoDimBlockCyclic, SymTwoDimBlockCyclic,
 from .data import Data, DataCopy, CoherencyState
 from .arena import Arena, ArenaDatatype, ArenaRegistry
 from .redistribute import build_redistribute_ptg, insert_redistribute_dtd
+from .checkpoint import CheckpointManager
 from .matrix_ops import (build_apply, build_broadcast, build_map_operator,
                          build_reduce)

@@ -50,10 +50,15 @@ def parse(spec: str, nb_cores: int) -> List[int]:
                             f"got {size}")
                     sizes.append(size)
         ids = [vp for vp, size in enumerate(sizes) for _ in range(size)]
+        if len(ids) > nb_cores:
+            # truncation would silently drop whole VPs (same rule as
+            # list: specs)
+            raise ValueError(
+                f"vpmap file names {len(ids)} streams, context has "
+                f"{nb_cores}")
         if len(ids) < nb_cores:
             # remaining streams join a final VP (reference pads likewise)
             ids.extend([len(sizes)] * (nb_cores - len(ids)))
-        ids = ids[:nb_cores]
         _check_dense(ids)
         return ids
     raise ValueError(f"unknown vpmap spec {spec!r} "

@@ -1,0 +1,90 @@
+"""Checkpoint/resume tests: atomic versioned snapshots of collections,
+resume-and-continue of an iterative workload (beyond-reference subsystem;
+the reference has none — SURVEY §5)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import parsec_tpu as parsec
+from parsec_tpu.data import CheckpointManager, LocalCollection, TiledMatrix
+from parsec_tpu.dsl import ptg
+from parsec_tpu.algorithms.stencil import build_stencil_1d
+
+
+def test_save_restore_roundtrip(tmp_path, rng):
+    A = TiledMatrix.from_array(
+        rng.standard_normal((64, 64)).astype(np.float32), 16, 16, name="A")
+    X = LocalCollection("X", {(i,): float(i) for i in range(4)})
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(3, {"A": A, "X": X}, meta={"iter": 3})
+
+    A2 = TiledMatrix(64, 64, 16, 16, name="A2")
+    X2 = LocalCollection("X2", {(i,): None for i in range(4)})
+    meta = mgr.restore(3, {"A": A2, "X": X2})
+    assert meta == {"iter": 3}
+    np.testing.assert_array_equal(A2.to_array(), A.to_array())
+    assert [X2.data_of((i,)) for i in range(4)] == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_latest_step_and_prune(tmp_path):
+    X = LocalCollection("X", {(0,): 1})
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    assert mgr.latest_step() is None
+    for s in (1, 5, 9):
+        mgr.save(s, {"X": X})
+    assert mgr.latest_step() == 9
+    assert mgr.steps() == [1, 5, 9]
+    mgr.prune(keep=2)
+    assert mgr.steps() == [5, 9]
+
+
+def test_restore_missing_raises(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    with pytest.raises(FileNotFoundError):
+        mgr.restore(7, {})
+    X = LocalCollection("X", {(0,): 1})
+    mgr.save(1, {"X": X})
+    with pytest.raises(KeyError):
+        mgr.restore(1, {"Y": X})
+
+
+def test_no_partial_step_visible(tmp_path):
+    """A crash mid-save must not surface a step (atomicity): simulate by
+    creating a lingering tmp dir."""
+    X = LocalCollection("X", {(0,): 1})
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    os.makedirs(str(tmp_path / "c" / "step_4.tmp.0"))
+    mgr.save(2, {"X": X})
+    assert mgr.steps() == [2]
+
+
+def test_resume_and_continue_stencil(tmp_path, ctx):
+    """The canonical loop: run K1 sweeps, checkpoint, 'crash', resume
+    into fresh collections, run K2 more — result equals an uninterrupted
+    K1+K2 run."""
+    n, w = 12, 1.0 / 3.0
+    x0 = np.arange(n, dtype=np.float64)
+
+    # uninterrupted reference run: 6 sweeps
+    Xa = LocalCollection("Xa", {(i,): x0[i] for i in range(n)})
+    ctx.add_taskpool(build_stencil_1d(Xa, n, 6, w))
+    assert ctx.wait(timeout=60)
+
+    # interrupted run: 2 sweeps → checkpoint → resume → 4 sweeps
+    Xb = LocalCollection("Xb", {(i,): x0[i] for i in range(n)})
+    ctx.add_taskpool(build_stencil_1d(Xb, n, 2, w))
+    assert ctx.wait(timeout=60)
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(2, {"X": Xb}, meta={"sweeps_done": 2})
+
+    Xc = LocalCollection("Xc", {(i,): None for i in range(n)})
+    meta = mgr.restore(mgr.latest_step(), {"X": Xc})
+    assert meta["sweeps_done"] == 2
+    ctx.add_taskpool(build_stencil_1d(Xc, n, 4, w))
+    assert ctx.wait(timeout=60)
+
+    a = np.array([float(Xa.data_of((i,))) for i in range(n)])
+    c = np.array([float(Xc.data_of((i,))) for i in range(n)])
+    np.testing.assert_allclose(c, a, rtol=1e-5)
