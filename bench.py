@@ -4,9 +4,17 @@
 Matches BASELINE.md's target metric: "tiled POTRF/GEMM GFLOP/s per chip,
 >=65% of chip peak". Since the reference publishes no absolute numbers
 (BASELINE.md: "published: {}"), the baseline denominator is measured on
-the same chip: peak-proxy GEMM throughput (one large square matmul at the
-same dtype). vs_baseline = potrf_gflops / (0.65 * peak_proxy_gflops) —
-i.e. >= 1.0 means the north-star 65%-of-peak target is met.
+the same chip: peak-proxy GEMM throughput (chained large matmuls at the
+same dtype/precision). vs_baseline = potrf_gflops /
+(0.65 * peak_proxy_gflops) — i.e. >= 1.0 means the north-star
+65%-of-peak target is met.
+
+Measurement notes (axon-tunnel backend): ``block_until_ready`` does NOT
+block for remote executions and bulk array fetches cost seconds, so all
+forcing is done with device-side scalar reductions and the per-call link
+roundtrip latency is measured and subtracted. The SPD input is generated
+ON DEVICE (shipping a 1 GiB matrix through the link would dominate the
+run) and the full-matrix residual is computed on device too.
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "GFLOP/s", "vs_baseline": N, ...}
@@ -28,28 +36,25 @@ if _plat:
     jax.config.update("jax_platforms", _plat)
 
 
-def _spd_host(n, rng):
-    """Diagonally-dominant SPD matrix in O(n^2) host work (a dense
-    M @ M.T at bench sizes would cost minutes of host time)."""
-    import numpy as np
-    R = rng.standard_normal((n, n)).astype(np.float32)
-    A = 0.5 * (R + R.T)
-    A[np.diag_indices(n)] += 2.0 * n
-    return A
-
-
-def _measure_peak_gemm(jnp, jax, n=4096, dtype="float32", iters=8):
-    """Large square matmul GFLOP/s — the chip-peak proxy at this dtype."""
+def _measure_peak_gemm(jnp, jax, n=8192, dtype="float32", iters=64,
+                       latency_s=0.0):
+    """Large square matmul GFLOP/s — the chip-peak proxy at this dtype.
+    K chained matmuls inside one jitted call reduced to a scalar: forces
+    real execution on remote backends and amortizes the link roundtrip
+    (subtracted via ``latency_s``)."""
     a = jnp.ones((n, n), dtype=dtype)
     b = jnp.ones((n, n), dtype=dtype)
-    f = jax.jit(lambda x, y: x @ y)
-    f(a, b).block_until_ready()                      # compile
+
+    def chain(x, y):
+        def step(i, acc):
+            return jnp.matmul(acc, y) * (1.0 / n)    # keep values bounded
+        return jnp.sum(jax.lax.fori_loop(0, iters, step, x))
+
+    f = jax.jit(chain)
+    float(f(a, b))                                   # compile + warm
     t0 = time.perf_counter()
-    out = None
-    for _ in range(iters):
-        out = f(a, b)
-    out.block_until_ready()
-    dt = (time.perf_counter() - t0) / iters
+    float(f(a, b))
+    dt = max(time.perf_counter() - t0 - latency_s, 1e-9) / iters
     return 2.0 * n ** 3 / dt / 1e9
 
 
@@ -65,47 +70,93 @@ def main():
     backend = jax.default_backend()
     # Chip-sized problem on TPU; small on the CPU fallback path.
     if backend == "tpu":
-        N, NB = 16384, 1024
+        N, NB = 16384, 2048     # best measured tiling for the tile-dict
+                                # executor on this chip class
     else:
         N, NB = 1024, 128
+    NT = N // NB
 
-    rng = np.random.default_rng(0)
-    A_host = _spd_host(N, rng)
-    A = TiledMatrix.from_array(A_host, NB, NB, name="A")
-
+    # Plan over an empty TiledMatrix — the planner only needs the tile
+    # grid (tiles materialize lazily); the actual data is generated on
+    # device below.
+    A = TiledMatrix(N, N, NB, NB, name="A")
     tp = build_potrf(A)
     plan = plan_taskpool(tp)
     ex = WavefrontExecutor(plan)
+    slot_map = plan.slot_maps["A"]
 
-    stores = ex.make_stores()
-    fn = ex.jitted
+    def make_tiles_device(key):
+        """Diagonally-dominant SPD matrix as a tile dict, entirely on
+        device (the tile-dict executor form: per-wave work touches only
+        its tiles — no full-store copies)."""
+        R = jax.random.normal(key, (N, N), dtype=jnp.float32)
+        M = 0.5 * (R + R.T) + 2.0 * N * jnp.eye(N, dtype=jnp.float32)
+        t = M.reshape(NT, NB, NT, NB).transpose(0, 2, 1, 3)
+        return {("A", slot_map[(i, j)]): t[i, j]
+                for i in range(NT) for j in range(NT)}
+
+    tiles = jax.jit(make_tiles_device)(jax.random.PRNGKey(0))
+    jax.block_until_ready(tiles)
+
+    # link roundtrip latency: drifts on minute scales, so it is sampled
+    # IMMEDIATELY BEFORE each timed run and subtracted pairwise
+    lat_f = jax.jit(lambda x: x + 1.0)
+    float(lat_f(jnp.float32(0)))
+
+    # ONE compile of the DAG program. It returns (total, out_tiles):
+    # fetching only the scalar forces full execution (the sum covers
+    # every result tile, so no task is dead-code-eliminated) while the
+    # tiles stay on device for the residual check below — no second
+    # whole-DAG compile.
+    def potrf_run(ts):
+        out = ex.run_tile_dict(ts)
+        total = jnp.float32(0)
+        for v in out.values():
+            total = total + jnp.sum(v)
+        return total, out
+
+    red = jax.jit(potrf_run)
     t0 = time.perf_counter()
-    out = fn(stores)
-    for v in out.values():
-        v.block_until_ready()
+    total, out_tiles = red(tiles)
+    float(total)
     compile_s = time.perf_counter() - t0
 
-    iters = 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(stores)
-        for v in out.values():
-            v.block_until_ready()
-    dt = (time.perf_counter() - t0) / iters
+    iters = 5
+    samples, lats = [], []
+    for i in range(iters):
+        lat_i = _timed(lambda i=i: float(lat_f(jnp.float32(i))))
+        t0 = time.perf_counter()
+        total, out_tiles = red(tiles)
+        float(total)
+        samples.append(max(time.perf_counter() - t0 - lat_i, 1e-6))
+        lats.append(lat_i)
+    dt = sorted(samples)[iters // 2]
+    lat = sorted(lats)[iters // 2]
 
     gflops = potrf_flops(N) / dt / 1e9
 
-    # Correctness: L L^T == A on the leading tile block (full check on CPU).
-    ex.write_back(out)
-    L = np.tril(A.to_array().astype(np.float64))
-    if backend == "tpu":
-        k = min(4 * NB, N)
-        err = np.linalg.norm(L[:k, :k] @ L[:k, :k].T - A_host[:k, :k]) / \
-            np.linalg.norm(A_host[:k, :k])
-    else:
-        err = np.linalg.norm(L @ L.T - A_host) / np.linalg.norm(A_host)
+    # Correctness: full-matrix relative residual ||tril(L)·tril(L)ᵀ − A||
+    # on device over the already-computed result tiles; only the scalar
+    # crosses the link (assemble+norm only — no DAG re-trace).
+    def residual(out, ts0):
+        def assemble(d):
+            rows = [jnp.concatenate([d[("A", slot_map[(i, j)])]
+                                     for j in range(NT)], axis=1)
+                    for i in range(NT)]
+            return jnp.concatenate(rows, axis=0)
 
-    peak_proxy = _measure_peak_gemm(jnp, jax, dtype="float32")
+        L = jnp.tril(assemble(out))
+        A0 = assemble(ts0)
+        return jnp.linalg.norm(L @ L.T - A0) / jnp.linalg.norm(A0)
+
+    err = float(jax.jit(residual)(out_tiles, tiles))
+
+    if backend == "tpu":
+        peak_proxy = _measure_peak_gemm(jnp, jax, n=8192, iters=64,
+                                        dtype="float32", latency_s=lat)
+    else:   # CPU smoke path: keep the proxy seconds-scale
+        peak_proxy = _measure_peak_gemm(jnp, jax, n=1024, iters=8,
+                                        dtype="float32", latency_s=lat)
     target = 0.65 * peak_proxy
 
     print(json.dumps({
@@ -120,9 +171,17 @@ def main():
             "target_gflops_65pct_peak": round(target, 2),
             "compile_s": round(compile_s, 2),
             "run_s": round(dt, 4),
+            "link_latency_s": round(lat, 4),
+            "executor": "tile_dict",
             "rel_residual_check": float(f"{err:.3e}"),
         },
     }))
+
+
+def _timed(f):
+    t0 = time.perf_counter()
+    f()
+    return time.perf_counter() - t0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,43 @@
+"""Ex01: hello world — one task, no dependencies.
+
+Reference: examples/Ex00_StartStop.c + Ex01_HelloWorld.c — init the
+runtime, run a single task, tear down.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import parsec_tpu as parsec
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl import ptg
+
+
+def main():
+    ctx = parsec.init(argv=sys.argv[1:])
+    ctx.start()
+
+    S = LocalCollection("S", {("msg",): "hello"})
+    tp = ptg.Taskpool("hello", S=S)
+    T = tp.task_class(
+        "HELLO", params=("i",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, i: (g.S, ("msg",)))],
+            outs=[ptg.Out(data=lambda g, i: (g.S, ("msg",)))])])
+
+    @T.body_cpu
+    def hello(task, x):
+        print(f"{x} world from task {task!r}")
+        return x + " world"
+
+    ctx.add_taskpool(tp)
+    ctx.wait()
+    assert S.data_of(("msg",)) == "hello world"
+    parsec.fini(ctx)
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

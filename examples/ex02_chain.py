@@ -1,0 +1,53 @@
+"""Ex02: a dependency chain — T(i) feeds T(i+1).
+
+Reference: examples/Ex02_Chain.jdf — the minimal dataflow: one task
+class whose instances form a chain through a single RW flow.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import parsec_tpu as parsec
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl import ptg
+
+
+def build_chain(store, n):
+    tp = ptg.Taskpool("chain", N=n, S=store)
+    T = tp.task_class(
+        "T", params=("i",),
+        space=lambda g: ((i,) for i in range(g.N)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            tile=lambda g, i: (g.S, ("x",)),
+            ins=[ptg.In(data=lambda g, i: (g.S, ("x",)),
+                        guard=lambda g, i: i == 0),
+                 ptg.In(src=("T", lambda g, i: (i - 1,), "X"),
+                        guard=lambda g, i: i > 0)],
+            outs=[ptg.Out(dst=("T", lambda g, i: (i + 1,), "X"),
+                          guard=lambda g, i: i < g.N - 1),
+                  ptg.Out(data=lambda g, i: (g.S, ("x",)),
+                          guard=lambda g, i: i == g.N - 1)])])
+
+    @T.body
+    def step(task, x):
+        return x + 1
+
+    return tp
+
+
+def main():
+    n = 20
+    ctx = parsec.init(argv=sys.argv[1:])
+    ctx.start()
+    store = LocalCollection("S", {("x",): 0})
+    ctx.add_taskpool(build_chain(store, n))
+    ctx.wait()
+    print(f"chain of {n}: final value {store.data_of(('x',))}")
+    parsec.fini(ctx)
+
+
+if __name__ == "__main__":
+    main()

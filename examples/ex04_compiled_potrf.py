@@ -1,0 +1,42 @@
+"""Ex04: the compiled path — tiled Cholesky as ONE XLA program.
+
+The TPU-idiomatic execution of a task DAG: plan_taskpool levels the
+closed-form PTG DAG into waves, the executor batches same-class tasks
+into vmapped calls over stacked HBM tile stores, and jax.jit fuses the
+whole schedule. Compare with running the same taskpool on the host
+runtime (Ex02-style dynamic scheduling).
+"""
+
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np
+
+import parsec_tpu as parsec
+from parsec_tpu.algorithms import build_potrf
+from parsec_tpu.algorithms.potrf import potrf_flops
+from parsec_tpu.compiled import WavefrontExecutor, plan_taskpool
+from parsec_tpu.data import TiledMatrix
+
+
+def main():
+    n, nb = 1024, 128
+    rng = np.random.default_rng(0)
+    M = rng.standard_normal((n, n))
+    A_h = (M @ M.T + n * np.eye(n)).astype(np.float32)
+
+    A = TiledMatrix.from_array(A_h.copy(), nb, nb, name="A")
+    plan = plan_taskpool(build_potrf(A))
+    print(f"planned: {plan.n_tasks} tasks in {plan.n_waves} waves")
+    ex = WavefrontExecutor(plan)
+    dt = ex.run()                    # compile + run + write back
+    L = np.tril(A.to_array())
+    err = np.linalg.norm(L @ L.T - A_h) / np.linalg.norm(A_h)
+    print(f"POTRF {n} (nb={nb}): {potrf_flops(n)/dt/1e9:.1f} GF/s "
+          f"(incl. compile), rel err {err:.1e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,59 @@
+"""Ex05: a chain crossing rank boundaries over the loopback fabric.
+
+Reference: examples/Ex04 (MPI chain) — the same chain as Ex02, with
+tiles owner-placed on alternating ranks; every hop is a remote
+activation through the comm engine, and termination is detected by the
+distributed four-counter wave.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import parsec_tpu as parsec
+from parsec_tpu.comm.local import LocalCommEngine
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.termdet import FourCounterTermdet
+from ex02_chain import build_chain
+
+
+class AlternatingStore(LocalCollection):
+    def __init__(self, name, nranks):
+        super().__init__(name=name)
+        self.nranks = nranks
+
+    def rank_of(self, key):
+        return 0        # the single logical tile lives on rank 0
+
+
+def main():
+    nranks, n = 2, 12
+    engines = LocalCommEngine.make_fabric(nranks)
+    ctxs, stores = [], []
+    for r in range(nranks):
+        ctx = parsec.init(nb_cores=2, comm=engines[r])
+        store = AlternatingStore("S", nranks)
+        store.write_tile(("x",), 0)
+
+        # place T(i) on rank i % nranks: override the taskpool affinity
+        tp = build_chain(store, n)
+        tc = tp.get_task_class("T")
+        tc.affinity_rank = lambda locals: locals[0] % nranks
+        tp.monitor = FourCounterTermdet(comm=engines[r])
+        ctxs.append(ctx)
+        stores.append(store)
+        ctx.add_taskpool(tp)
+    for ctx in ctxs:
+        ctx.start()
+    for ctx in ctxs:
+        ctx.wait()
+    final_rank = (n - 1) % nranks
+    print(f"{nranks}-rank chain of {n}: final value "
+          f"{stores[final_rank].data_of(('x',))} on rank {final_rank}")
+    for ctx in ctxs:
+        parsec.fini(ctx)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,7 +17,8 @@ from __future__ import annotations
 
 from ..dsl import ptg
 from ..data.matrix import TiledMatrix
-from ..ops.tile_kernels import gemm_tile, potrf_tile, syrk_tile, trsm_tile
+from ..ops.tile_kernels import (gemm_tile, potrf_tile, syrk_tile, trsm_tile,
+                                trsm_tiles_wide)
 
 
 def build_potrf(A: TiledMatrix) -> ptg.Taskpool:
@@ -132,7 +133,11 @@ def build_potrf(A: TiledMatrix) -> ptg.Taskpool:
     def potrf_body(task, T):
         return potrf_tile(T)
 
-    @TRSM.body
+    # compiled-path batched form: every TRSM(m, k) of one wave shares the
+    # same factor L = POTRF(k).T, so the whole group is one wide-RHS
+    # solve (the executor verifies the shared-L grouping per wave)
+    @TRSM.body(batch_hook=lambda Ls, Cs: trsm_tiles_wide(Ls[0], Cs),
+               batch_hook_shared=("L",))
     def trsm_body(task, L, C):
         return trsm_tile(C, L)
 

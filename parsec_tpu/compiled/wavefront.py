@@ -262,14 +262,73 @@ class WavefrontExecutor:
         self.jitted = self.jax.jit(self.run_arrays)
 
     # -- body lookup ------------------------------------------------------
-    def _body(self, tc: PTGTaskClass) -> Callable:
+    def _raw_body(self, tc: PTGTaskClass) -> Callable:
+        chore = tc.chore_for(self.device_type) or \
+            tc.chore_for(DeviceType.CPU)
+        if chore is None:
+            raise ValueError(f"no body for {tc.name}")
+        return chore.hook
+
+    def _chore(self, tc: PTGTaskClass):
+        return tc.chore_for(self.device_type) or tc.chore_for(DeviceType.CPU)
+
+    def _hook_applies(self, chore, grp: WaveGroup) -> bool:
+        """A batch_hook may assume flows named in ``batch_hook_shared``
+        hold ONE tile across the whole group (e.g. the shared triangular
+        factor of a TRSM wave). Verify that from the planner's slot
+        indices — host-side, once per group — and fall back to vmap when
+        the grouping breaks the assumption (future leveling changes must
+        not silently mis-apply the hook)."""
+        if chore is None or chore.batch_hook is None:
+            return False
+        shared = getattr(chore, "batch_hook_shared", None) or ()
+        if not shared:
+            return True
+        in_fl = [f for f in grp.tc.flows
+                 if not f.is_ctl and (f.access & FlowAccess.READ)]
+        by_name = {f.name: slots for f, (_n, slots) in
+                   zip(in_fl, grp.in_slots)}
+        return all(len(np.unique(by_name[name])) == 1
+                   for name in shared if name in by_name)
+
+    def _body(self, tc: PTGTaskClass, batch: int,
+              grp: Optional[WaveGroup] = None) -> Callable:
+        """Batched body. Preference order: the chore's hand-written
+        ``batch_hook`` (class-specific batched reformulation, guarded by
+        its shared-flow assumption), then the batch == 1 unvmapped fast
+        path (batched cholesky/triangular-solve lower poorly on TPU and
+        diagonal-panel groups are singletons on the critical path), then
+        mechanical vmap."""
+        chore = self._chore(tc)
+        if grp is not None and self._hook_applies(chore, grp):
+            fn = self._vmapped.get((tc.name, "batch_hook"))
+            if fn is None:
+                bh = chore.batch_hook
+
+                def hooked(*tiles, _b=bh):
+                    outs = _b(*tiles)
+                    return outs if isinstance(outs, (tuple, list)) \
+                        else (outs,)
+
+                fn = self._vmapped[(tc.name, "batch_hook")] = hooked
+            return fn
+        if batch == 1:
+            fn = self._vmapped.get((tc.name, 1))
+            if fn is None:
+                body = self._raw_body(tc)
+
+                def one(*tiles, _b=body):
+                    outs = _b(None, *(t[0] for t in tiles))
+                    if not isinstance(outs, (tuple, list)):
+                        outs = (outs,)
+                    return tuple(o[None] for o in outs)
+
+                fn = one
+                self._vmapped[(tc.name, 1)] = fn
+            return fn
         fn = self._vmapped.get(tc.name)
         if fn is None:
-            chore = tc.chore_for(self.device_type) or \
-                tc.chore_for(DeviceType.CPU)
-            if chore is None:
-                raise ValueError(f"no body for {tc.name}")
-            body = chore.hook
+            body = self._raw_body(tc)
             fn = self.jax.vmap(lambda *tiles, _b=body: _b(None, *tiles))
             self._vmapped[tc.name] = fn
         return fn
@@ -281,6 +340,22 @@ class WavefrontExecutor:
         out = np.full(size, fill, dtype=np.int32)
         out[:len(idx)] = idx
         return out
+
+    def _exec_group(self, grp: WaveGroup, batch: int,
+                    inputs: List[Any]) -> List[Any]:
+        """Run one wave-group's batched body over gathered inputs and
+        return its validated per-write-flow stacked outputs (the shared
+        core of both executor forms)."""
+        outs = self._body(grp.tc, batch, grp)(*inputs)
+        out_fl = [f for f in grp.tc.flows
+                  if not f.is_ctl and (f.access & FlowAccess.WRITE)]
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        if len(outs) != len(out_fl):
+            raise ValueError(
+                f"{grp.tc.name}: body returned {len(outs)} outputs "
+                f"for {len(out_fl)} write flows")
+        return list(outs)
 
     # -- pure store-passing execution ------------------------------------
     def run_arrays(self, stores: Dict[str, Any]) -> Dict[str, Any]:
@@ -298,15 +373,7 @@ class WavefrontExecutor:
                 for (name, idx) in grp.in_slots:
                     gidx = self._pad(idx, Bp, 0)
                     inputs.append(snapshot[name][gidx])
-                outs = self._body(grp.tc)(*inputs)
-                out_fl = [f for f in grp.tc.flows
-                          if not f.is_ctl and (f.access & FlowAccess.WRITE)]
-                if not isinstance(outs, (tuple, list)):
-                    outs = (outs,)
-                if len(outs) != len(out_fl):
-                    raise ValueError(
-                        f"{grp.tc.name}: body returned {len(outs)} outputs "
-                        f"for {len(out_fl)} write flows")
+                outs = self._exec_group(grp, Bp, inputs)
                 for (name, idx), val in zip(grp.out_slots, outs):
                     dummy = stores[name].shape[0] - 1
                     sidx = self._pad(idx, Bp, dummy)
@@ -315,6 +382,47 @@ class WavefrontExecutor:
                 stores[name] = stores[name].at[sidx].set(
                     val.astype(stores[name].dtype))
         return stores
+
+    # -- tile-dict execution ---------------------------------------------
+    # The stacked-store form pays a full-store copy per wave for the
+    # functional scatter (dominant on bandwidth-limited chips). In the
+    # tile-dict form every tile is its own array: a wave stacks only the
+    # tiles of its batch, and "scatter" is dict rebinding — zero copies
+    # of untouched tiles. Preferred single-chip form; the stacked form
+    # remains the input to the SPMD mesh path (sharded along slots).
+
+    def make_tiles(self) -> Dict[Tuple[str, int], Any]:
+        jnp = self.jnp
+        tiles: Dict[Tuple[str, int], Any] = {}
+        for name, dc in self.plan.collections.items():
+            for key, slot in self.plan.slot_maps[name].items():
+                tiles[(name, slot)] = jnp.asarray(dc.data_of(key))
+        return tiles
+
+    def run_tile_dict(self, tiles: Dict[Tuple[str, int], Any]
+                      ) -> Dict[Tuple[str, int], Any]:
+        """Pure function tile-dict → tile-dict; jit for the fused form."""
+        tiles = dict(tiles)
+        for wave in self.plan.waves:
+            snapshot = tiles           # values are immutable jax arrays
+            updates: List[Tuple[Tuple[str, int], Any]] = []
+            for grp in wave:
+                B = len(grp.tasks)
+                inputs = [self.jnp.stack([snapshot[(name, int(s))]
+                                          for s in idx])
+                          for (name, idx) in grp.in_slots]
+                outs = self._exec_group(grp, B, inputs)
+                for (name, idx), val in zip(grp.out_slots, outs):
+                    for b, s in enumerate(idx):
+                        updates.append(((name, int(s)), val[b]))
+            for k, v in updates:
+                tiles[k] = v
+        return tiles
+
+    def write_back_tiles(self, tiles: Dict[Tuple[str, int], Any]) -> None:
+        for name, dc in self.plan.collections.items():
+            for key, slot in self.plan.slot_maps[name].items():
+                dc.write_tile(key, tiles[(name, slot)])
 
     # -- host-driven run --------------------------------------------------
     def make_stores(self) -> Dict[str, Any]:

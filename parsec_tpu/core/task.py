@@ -89,6 +89,15 @@ class Chore:
     # device-layer hints (reference gpu properties, jdf2c.c:6561-6590)
     weight: Optional[Callable[["Task"], float]] = None
     batchable: bool = True   # TPU: may be vmap-batched with same-class tasks
+    # Optional hand-written batched form used by the compiled executor in
+    # place of vmap(hook): ``batch_hook(*stacked_tiles) -> stacked outs``.
+    # For ops whose batched lowering is poor on TPU (triangular solves),
+    # a class-specific reformulation (e.g. one wide-RHS solve) is far
+    # faster than the mechanical vmap. ``batch_hook_shared`` names input
+    # flows the hook assumes hold ONE tile across the whole batch; the
+    # executor verifies this per group and falls back to vmap otherwise.
+    batch_hook: Optional[Callable[..., Any]] = None
+    batch_hook_shared: Optional[Sequence[str]] = None
 
 
 _task_counter = itertools.count()

@@ -51,6 +51,19 @@ def trsm_tile(B, L):
     return x.T.astype(B.dtype)
 
 
+def trsm_tiles_wide(L, Bs):
+    """Batched B_i ← B_i·L⁻ᵀ with a SHARED factor L, formulated as ONE
+    wide-RHS triangular solve: L · Y = [B₁ᵀ | B₂ᵀ | …]. On TPU this is
+    several times faster than vmapping per-tile solves (batched
+    triangular-solve lowering is poor); used as the TRSM batch_hook in
+    the compiled POTRF path."""
+    nbatch, nb, _ = Bs.shape
+    rhs = jnp.swapaxes(Bs, 1, 2).transpose(1, 0, 2).reshape(nb, nbatch * nb)
+    Y = jax.scipy.linalg.solve_triangular(
+        L.astype(jnp.float32), rhs.astype(jnp.float32), lower=True)
+    return Y.reshape(nb, nbatch, nb).transpose(1, 2, 0).astype(Bs.dtype)
+
+
 def potrf_tile(A):
     """A ← chol(A) lower (diagonal-tile Cholesky)."""
     return jnp.linalg.cholesky(A.astype(jnp.float32)).astype(A.dtype)
