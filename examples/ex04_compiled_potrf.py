@@ -7,10 +7,11 @@ whole schedule. Compare with running the same taskpool on the host
 runtime (Ex02-style dynamic scheduling).
 """
 
+import os
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

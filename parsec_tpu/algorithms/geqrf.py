@@ -32,7 +32,24 @@ def build_geqrf(A: TiledMatrix) -> ptg.Taskpool:
     MT, NT = A.mt, A.nt
     if MT < NT:
         raise ValueError("GEQRF needs MT >= NT (tall or square tile grid)")
-    tp = ptg.Taskpool("geqrf", A=A, MT=MT, NT=NT)
+    nb = A.nb
+    # Scratch collections give the orthogonal-factor flows tile
+    # placements so the compiled wavefront/tile-dict executors can run
+    # the DAG (values would otherwise flow only task→task); the host
+    # runtime ignores them. Qs holds the (nb,nb) diagonal factors keyed
+    # (k, 0); Q2s the (2nb,2nb) TSQRT factors keyed (m, k) — only the
+    # strictly-below-diagonal keys actually used, so the stacked store
+    # doesn't materialize (or copy per wave) the unused upper half.
+    Qs = TiledMatrix(NT * nb, nb, nb, nb, name=f"{A.name}_Qs")
+
+    class _TSQRTFactors(TiledMatrix):
+        def keys(self):
+            return [(m, k) for k in range(NT)
+                    for m in range(k + 1, MT)]
+
+    Q2s = _TSQRTFactors(MT * 2 * nb, NT * 2 * nb, 2 * nb, 2 * nb,
+                        name=f"{A.name}_Q2s")
+    tp = ptg.Taskpool("geqrf", A=A, MT=MT, NT=NT, Qs=Qs, Q2s=Q2s)
 
     GEQRT = tp.task_class(
         "GEQRT", params=("k",),
@@ -49,6 +66,7 @@ def build_geqrf(A: TiledMatrix) -> ptg.Taskpool:
                             guard=lambda g, k: k > 0)]),
             ptg.FlowSpec(
                 "Q", ptg.WRITE,
+                tile=lambda g, k: (g.Qs, (k, 0)),
                 outs=[ptg.Out(dst=("UNMQR",
                                lambda g, k: [(k, n)
                                              for n in range(k + 1, g.NT)],
@@ -90,6 +108,7 @@ def build_geqrf(A: TiledMatrix) -> ptg.Taskpool:
                             guard=lambda g, m, k: k > 0)]),
             ptg.FlowSpec(
                 "Q2", ptg.WRITE,
+                tile=lambda g, m, k: (g.Q2s, (m, k)),
                 outs=[ptg.Out(dst=("TSMQR",
                                lambda g, m, k: [(m, n, k)
                                                 for n in range(k + 1, g.NT)],
@@ -110,6 +129,7 @@ def build_geqrf(A: TiledMatrix) -> ptg.Taskpool:
         flows=[
             ptg.FlowSpec(
                 "Q", ptg.READ,
+                tile=lambda g, k, n: (g.Qs, (k, 0)),
                 ins=[ptg.In(src=("GEQRT", lambda g, k, n: (k,), "Q"))]),
             ptg.FlowSpec(
                 "C", ptg.RW,
@@ -136,7 +156,9 @@ def build_geqrf(A: TiledMatrix) -> ptg.Taskpool:
         flows=[
             ptg.FlowSpec(
                 "Q2", ptg.READ,
-                ins=[ptg.In(src=("TSQRT", lambda g, m, n, k: (m, k), "Q2"))]),
+                tile=lambda g, m, n, k: (g.Q2s, (m, k)),
+                ins=[ptg.In(src=("TSQRT", lambda g, m, n, k: (m, k),
+                                 "Q2"))]),
             # running row-k tile C(k,n), reduced down the column
             ptg.FlowSpec(
                 "C1", ptg.RW,

@@ -235,12 +235,14 @@ def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
 class WavefrontExecutor:
     """Executes a :class:`WavefrontPlan` on the TPU.
 
-    Two modes:
-    - :meth:`run_arrays` — pure function ``{name: stacked} → {name:
-      stacked}``; traceable, so wrapping it in ``jax.jit`` compiles the
-      ENTIRE DAG into one XLA program (used by bench + __graft_entry__).
-    - :meth:`run` — host-driven: converts collections to stacked stores,
-      applies ``run_arrays`` (optionally jitted), writes tiles back.
+    Two executable forms, both pure and jittable end-to-end:
+    - :meth:`run_tile_dict` — every tile its own array; preferred
+      single-chip form (no per-wave full-store copies; used by bench).
+    - :meth:`run_arrays` — stacked ``{name: store}`` form; the input to
+      the SPMD mesh path (sharded along the slot axis; used by
+      __graft_entry__ and compiled.spmd).
+    - :meth:`run` — host-driven wrapper: collections → stacked stores →
+      ``run_arrays`` → write back.
 
     Batch padding: every group's gather/scatter indices are padded to the
     next power of two; scatter padding lands in a dummy slot appended to
@@ -263,11 +265,27 @@ class WavefrontExecutor:
 
     # -- body lookup ------------------------------------------------------
     def _raw_body(self, tc: PTGTaskClass) -> Callable:
+        """The host body adapted to the executor's calling convention:
+        the executor gathers only READ flows, while host bodies take
+        every non-CTL flow in declaration order (WRITE-only flows are
+        placeholder arguments) — rebuild the full argument list with
+        None in the WRITE-only slots."""
         chore = tc.chore_for(self.device_type) or \
             tc.chore_for(DeviceType.CPU)
         if chore is None:
             raise ValueError(f"no body for {tc.name}")
-        return chore.hook
+        body = chore.hook
+        nonctl = [f for f in tc.flows if not f.is_ctl]
+        if all(f.access & FlowAccess.READ for f in nonctl):
+            return body
+        reads = [bool(f.access & FlowAccess.READ) for f in nonctl]
+
+        def adapted(task, *read_vals, _b=body, _reads=tuple(reads)):
+            it = iter(read_vals)
+            args = [next(it) if r else None for r in _reads]
+            return _b(task, *args)
+
+        return adapted
 
     def _chore(self, tc: PTGTaskClass):
         return tc.chore_for(self.device_type) or tc.chore_for(DeviceType.CPU)
@@ -305,10 +323,8 @@ class WavefrontExecutor:
             if fn is None:
                 bh = chore.batch_hook
 
-                def hooked(*tiles, _b=bh):
-                    outs = _b(*tiles)
-                    return outs if isinstance(outs, (tuple, list)) \
-                        else (outs,)
+                def hooked(*tiles, _b=bh, _tc=tc):
+                    return self._normalize_outs(_tc, _b(*tiles))
 
                 fn = self._vmapped[(tc.name, "batch_hook")] = hooked
             return fn
@@ -317,10 +333,9 @@ class WavefrontExecutor:
             if fn is None:
                 body = self._raw_body(tc)
 
-                def one(*tiles, _b=body):
-                    outs = _b(None, *(t[0] for t in tiles))
-                    if not isinstance(outs, (tuple, list)):
-                        outs = (outs,)
+                def one(*tiles, _b=body, _tc=tc):
+                    outs = self._normalize_outs(
+                        _tc, _b(None, *(t[0] for t in tiles)))
                     return tuple(o[None] for o in outs)
 
                 fn = one
@@ -341,21 +356,34 @@ class WavefrontExecutor:
         out[:len(idx)] = idx
         return out
 
+    @staticmethod
+    def _normalize_outs(tc: PTGTaskClass, outs) -> tuple:
+        """Body returns → tuple ordered by WRITE-flow declaration order.
+        Bodies may return a dict keyed by flow name (the host runtime
+        convention), a tuple/list, or a single value."""
+        out_fl = [f for f in tc.flows
+                  if not f.is_ctl and (f.access & FlowAccess.WRITE)]
+        if isinstance(outs, dict):
+            missing = [f.name for f in out_fl if f.name not in outs]
+            if missing:
+                raise ValueError(
+                    f"{tc.name}: body dict missing outputs {missing}")
+            return tuple(outs[f.name] for f in out_fl)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        if len(outs) != len(out_fl):
+            raise ValueError(
+                f"{tc.name}: body returned {len(outs)} outputs "
+                f"for {len(out_fl)} write flows")
+        return tuple(outs)
+
     def _exec_group(self, grp: WaveGroup, batch: int,
                     inputs: List[Any]) -> List[Any]:
         """Run one wave-group's batched body over gathered inputs and
         return its validated per-write-flow stacked outputs (the shared
         core of both executor forms)."""
         outs = self._body(grp.tc, batch, grp)(*inputs)
-        out_fl = [f for f in grp.tc.flows
-                  if not f.is_ctl and (f.access & FlowAccess.WRITE)]
-        if not isinstance(outs, (tuple, list)):
-            outs = (outs,)
-        if len(outs) != len(out_fl):
-            raise ValueError(
-                f"{grp.tc.name}: body returned {len(outs)} outputs "
-                f"for {len(out_fl)} write flows")
-        return list(outs)
+        return list(self._normalize_outs(grp.tc, outs))
 
     # -- pure store-passing execution ------------------------------------
     def run_arrays(self, stores: Dict[str, Any]) -> Dict[str, Any]:
