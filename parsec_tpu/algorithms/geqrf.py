@@ -49,6 +49,7 @@ def build_geqrf(A: TiledMatrix) -> ptg.Taskpool:
 
     Q2s = _TSQRTFactors(MT * 2 * nb, NT * 2 * nb, 2 * nb, 2 * nb,
                         name=f"{A.name}_Q2s")
+    Qs.scratch = Q2s.scratch = True   # intra-DAG temporaries only
     tp = ptg.Taskpool("geqrf", A=A, MT=MT, NT=NT, Qs=Qs, Q2s=Q2s)
 
     GEQRT = tp.task_class(

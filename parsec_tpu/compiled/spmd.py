@@ -9,9 +9,10 @@ wavefront program over the mesh — XLA's SPMD partitioner inserts the
 collectives (all-gathers / collective-permutes riding ICI) that the
 reference implements by hand as activation trees + one-sided transfers.
 
-Owner-computes refinement (block-cyclic rank-grouped slot order so
-gathers become neighbor ppermutes) is planned; this round establishes the
-correct sharded execution path.
+Owner-computes refinement: distributed collections emit rank-grouped
+slot orders (TiledMatrix.tile_index), so sharding the slot axis places
+each tile's slot on (or near) its owner device and the partitioner's
+collectives carry only true dataflow.
 """
 
 from __future__ import annotations
@@ -70,5 +71,7 @@ def run_sharded(executor, mesh=None, n_devices: Optional[int] = None,
         v.block_until_ready()
     clipped = {k: v[:orig_sizes[k]] for k, v in out.items()}
     for name, dc in executor.plan.collections.items():
+        if getattr(dc, "scratch", False):
+            continue      # intra-DAG temporaries: no host write-back
         dc.from_stacked(clipped[name][:-1], executor.plan.slot_maps[name])
     return clipped

@@ -319,15 +319,8 @@ class WavefrontExecutor:
         mechanical vmap."""
         chore = self._chore(tc)
         if grp is not None and self._hook_applies(chore, grp):
-            fn = self._vmapped.get((tc.name, "batch_hook"))
-            if fn is None:
-                bh = chore.batch_hook
-
-                def hooked(*tiles, _b=bh, _tc=tc):
-                    return self._normalize_outs(_tc, _b(*tiles))
-
-                fn = self._vmapped[(tc.name, "batch_hook")] = hooked
-            return fn
+            # raw hook: _exec_group normalizes every body's outputs
+            return chore.batch_hook
         if batch == 1:
             fn = self._vmapped.get((tc.name, 1))
             if fn is None:
@@ -423,8 +416,13 @@ class WavefrontExecutor:
         jnp = self.jnp
         tiles: Dict[Tuple[str, int], Any] = {}
         for name, dc in self.plan.collections.items():
+            scratch = getattr(dc, "scratch", False)
             for key, slot in self.plan.slot_maps[name].items():
-                tiles[(name, slot)] = jnp.asarray(dc.data_of(key))
+                if scratch:   # factor scratch: device zeros, no host read
+                    tiles[(name, slot)] = jnp.zeros((dc.mb, dc.nb),
+                                                    dc.dtype)
+                else:
+                    tiles[(name, slot)] = jnp.asarray(dc.data_of(key))
         return tiles
 
     def run_tile_dict(self, tiles: Dict[Tuple[str, int], Any]
@@ -449,6 +447,8 @@ class WavefrontExecutor:
 
     def write_back_tiles(self, tiles: Dict[Tuple[str, int], Any]) -> None:
         for name, dc in self.plan.collections.items():
+            if getattr(dc, "scratch", False):
+                continue      # nobody reads factor scratch after the run
             for key, slot in self.plan.slot_maps[name].items():
                 dc.write_tile(key, tiles[(name, slot)])
 
@@ -457,6 +457,10 @@ class WavefrontExecutor:
         jnp = self.jnp
         stores = {}
         for name, dc in self.plan.collections.items():
+            if getattr(dc, "scratch", False):
+                n = len(self.plan.slot_maps[name])
+                stores[name] = jnp.zeros((n + 1, dc.mb, dc.nb), dc.dtype)
+                continue
             arr, _ = dc.to_stacked()
             dummy = jnp.zeros((1,) + arr.shape[1:], dtype=arr.dtype)
             stores[name] = jnp.concatenate([arr, dummy], axis=0)
@@ -464,6 +468,8 @@ class WavefrontExecutor:
 
     def write_back(self, stores: Dict[str, Any]) -> None:
         for name, dc in self.plan.collections.items():
+            if getattr(dc, "scratch", False):
+                continue
             dc.from_stacked(stores[name][:-1], self.plan.slot_maps[name])
 
     def run(self, jit: bool = True) -> float:

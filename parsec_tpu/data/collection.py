@@ -18,6 +18,11 @@ _dc_ids = itertools.count(1)
 class DataCollection:
     """Base collection vtable (parsec_data_collection_t analog)."""
 
+    #: scratch collections carry intra-DAG temporaries (e.g. QR factor
+    #: tiles); compiled executors neither read their host tiles nor
+    #: write results back
+    scratch = False
+
     def __init__(self, name: str = "dc", nodes: int = 1, myrank: int = 0):
         self.name = name
         self.dc_id = next(_dc_ids)

@@ -191,8 +191,19 @@ class TiledMatrix(DataCollection):
 
     # -- stacked device representation -----------------------------------
     def tile_index(self) -> Dict[Tuple[int, int], int]:
-        """Stable (i, j) → slot mapping for the stacked representation."""
-        return {k: s for s, k in enumerate(sorted(self.keys()))}
+        """Stable (i, j) → slot mapping for the stacked representation.
+
+        Owner-computes slot order: with a multi-node distribution, tiles
+        owned by the same rank occupy a CONTIGUOUS slot range (ranks in
+        order). Sharding the slot axis of the stacked store over a mesh
+        then places each tile on (or near) its owner device, so the SPMD
+        partitioner's collectives carry only the dataflow the reference
+        sends as remote deps — the "How to Scale Your Model" recipe
+        applied to the block-cyclic layout."""
+        keys = sorted(self.keys())
+        if self.dist.nodes > 1:
+            keys.sort(key=lambda k: (self.rank_of(k),) + tuple(k))
+        return {k: s for s, k in enumerate(keys)}
 
     def to_stacked(self, device=None):
         """All tiles stacked into one (ntiles, mb, nb) jax.Array resident
