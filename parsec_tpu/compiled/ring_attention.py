@@ -45,12 +45,18 @@ def _online_softmax_step(q_blk, k_cur, v_cur, acc, m, l, scale):
     return acc_new, m_new, l_new
 
 
-def ring_attention(q, k, v, mesh, axis: str = "seq"):
+def ring_attention(q, k, v, mesh, axis: str = "seq",
+                   kv_chunk: Optional[int] = None):
     """Multi-head attention with the sequence sharded over mesh ``axis``.
 
     ``q/k/v``: float arrays of shape ``(S, H, dh)`` (sequence-major) laid
     out ``PartitionSpec(axis)`` over ``mesh``. Returns the attention
     output in the same layout. Full (non-causal) attention.
+
+    ``kv_chunk``: fold each visiting KV block in chunks of this many
+    keys (flash-attention-style inner loop) — peak score memory drops
+    from O(Sb²) to O(Sb·kv_chunk) per head, which is what lets a single
+    chip run long blocks. Must divide the per-device block length.
     """
     import jax
     import jax.numpy as jnp
@@ -67,6 +73,30 @@ def ring_attention(q, k, v, mesh, axis: str = "seq"):
         qh = jnp.swapaxes(q_blk, 0, 1).astype(jnp.float32)
         kh = jnp.swapaxes(k_blk, 0, 1).astype(jnp.float32)
         vh = jnp.swapaxes(v_blk, 0, 1).astype(jnp.float32)
+        Sb = qh.shape[1]
+
+        def fold_block(k_cur, v_cur, acc, m, l):
+            if kv_chunk is None or kv_chunk >= Sb:
+                return _online_softmax_step(qh, k_cur, v_cur, acc, m, l,
+                                            scale)
+            if Sb % kv_chunk:
+                raise ValueError(
+                    f"kv_chunk={kv_chunk} must divide block length {Sb}")
+            nch = Sb // kv_chunk
+            # chunk axis leads so scan consumes chunks directly as xs
+            kc = jnp.moveaxis(
+                k_cur.reshape(k_cur.shape[0], nch, kv_chunk, -1), 1, 0)
+            vc = jnp.moveaxis(
+                v_cur.reshape(v_cur.shape[0], nch, kv_chunk, -1), 1, 0)
+
+            def chunk_step(carry, kv):
+                acc, m, l = carry
+                acc, m, l = _online_softmax_step(
+                    qh, kv[0], kv[1], acc, m, l, scale)
+                return (acc, m, l), None
+
+            (acc, m, l), _ = lax.scan(chunk_step, (acc, m, l), (kc, vc))
+            return acc, m, l
 
         def step(carry, _):
             # permute first, fold second: the local block is folded
@@ -75,16 +105,14 @@ def ring_attention(q, k, v, mesh, axis: str = "seq"):
             k_cur, v_cur, acc, m, l = carry
             k_cur = lax.ppermute(k_cur, axis, perm)
             v_cur = lax.ppermute(v_cur, axis, perm)
-            acc, m, l = _online_softmax_step(qh, k_cur, v_cur, acc, m, l,
-                                             scale)
+            acc, m, l = fold_block(k_cur, v_cur, acc, m, l)
             return (k_cur, v_cur, acc, m, l), None
 
         # fold the resident block, then rotate n-1 times; the init state
         # derives from qh so it carries the same varying manual axes as
         # the loop outputs (JAX ≥0.8 shard_map typing)
-        acc0, m0, l0 = _online_softmax_step(
-            qh, kh, vh, qh * 0.0, qh[..., 0] * 0.0 - jnp.inf,
-            qh[..., 0] * 0.0, scale)
+        acc0, m0, l0 = fold_block(
+            kh, vh, qh * 0.0, qh[..., 0] * 0.0 - jnp.inf, qh[..., 0] * 0.0)
         (k_f, v_f, acc, m, l), _ = lax.scan(
             step, (kh, vh, acc0, m0, l0), None, length=n - 1)
         out = acc / l[..., None]

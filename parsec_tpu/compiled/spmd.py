@@ -71,7 +71,7 @@ def run_sharded(executor, mesh=None, n_devices: Optional[int] = None,
         v.block_until_ready()
     clipped = {k: v[:orig_sizes[k]] for k, v in out.items()}
     for name, dc in executor.plan.collections.items():
-        if getattr(dc, "scratch", False):
+        if dc.scratch:
             continue      # intra-DAG temporaries: no host write-back
         dc.from_stacked(clipped[name][:-1], executor.plan.slot_maps[name])
     return clipped

@@ -416,7 +416,7 @@ class WavefrontExecutor:
         jnp = self.jnp
         tiles: Dict[Tuple[str, int], Any] = {}
         for name, dc in self.plan.collections.items():
-            scratch = getattr(dc, "scratch", False)
+            scratch = dc.scratch
             for key, slot in self.plan.slot_maps[name].items():
                 if scratch:   # factor scratch: device zeros, no host read
                     tiles[(name, slot)] = jnp.zeros((dc.mb, dc.nb),
@@ -447,7 +447,7 @@ class WavefrontExecutor:
 
     def write_back_tiles(self, tiles: Dict[Tuple[str, int], Any]) -> None:
         for name, dc in self.plan.collections.items():
-            if getattr(dc, "scratch", False):
+            if dc.scratch:
                 continue      # nobody reads factor scratch after the run
             for key, slot in self.plan.slot_maps[name].items():
                 dc.write_tile(key, tiles[(name, slot)])
@@ -457,7 +457,7 @@ class WavefrontExecutor:
         jnp = self.jnp
         stores = {}
         for name, dc in self.plan.collections.items():
-            if getattr(dc, "scratch", False):
+            if dc.scratch:
                 n = len(self.plan.slot_maps[name])
                 stores[name] = jnp.zeros((n + 1, dc.mb, dc.nb), dc.dtype)
                 continue
@@ -468,7 +468,7 @@ class WavefrontExecutor:
 
     def write_back(self, stores: Dict[str, Any]) -> None:
         for name, dc in self.plan.collections.items():
-            if getattr(dc, "scratch", False):
+            if dc.scratch:
                 continue
             dc.from_stacked(stores[name][:-1], self.plan.slot_maps[name])
 

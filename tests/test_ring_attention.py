@@ -51,6 +51,27 @@ def test_ring_attention_long_sequence(rng, mesh8):
                                rtol=2e-4, atol=2e-4)
 
 
+def test_ring_attention_kv_chunked(rng, mesh8):
+    """Flash-style inner chunking must be numerically identical."""
+    import jax
+    q, k, v = _qkv(rng, S=128, H=4, dh=16)
+    qs, ks, vs = _shard_seq(mesh8, q, k, v)
+    out = jax.jit(lambda a, b, c: ring_attention(a, b, c, mesh8,
+                                                 kv_chunk=4))(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(dense_attention(q, k, v)),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_kv_chunk_must_divide(rng, mesh8):
+    q, k, v = _qkv(rng, S=64, H=2, dh=8)
+    qs, ks, vs = _shard_seq(mesh8, q, k, v)
+    import jax
+    with pytest.raises(ValueError):
+        jax.jit(lambda a, b, c: ring_attention(
+            a, b, c, mesh8, kv_chunk=3))(qs, ks, vs)
+
+
 def test_ulysses_matches_dense(rng, mesh8):
     import jax
     q, k, v = _qkv(rng)
