@@ -31,11 +31,22 @@ import math
 from typing import Optional
 
 
-def _online_softmax_step(q_blk, k_cur, v_cur, acc, m, l, scale):
+_MASKED = -1e30      # finite "minus infinity": keeps exp() NaN-free when
+                     # an entire row is masked (fully-future KV blocks)
+
+
+def _online_softmax_step(q_blk, k_cur, v_cur, acc, m, l, scale,
+                         qpos=None, kpos=None):
+    """One online-softmax fold. ``qpos``/``kpos``: global sequence
+    positions of the query/key rows — when given, causal masking
+    (key position ≤ query position) is applied."""
     import jax.numpy as jnp
 
     s = jnp.matmul(q_blk, jnp.swapaxes(k_cur, -1, -2),
                    preferred_element_type=jnp.float32) * scale
+    if qpos is not None:
+        allowed = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(allowed, s, _MASKED)
     m_new = jnp.maximum(m, s.max(axis=-1))
     p = jnp.exp(s - m_new[..., None])
     corr = jnp.exp(m - m_new)
@@ -46,17 +57,23 @@ def _online_softmax_step(q_blk, k_cur, v_cur, acc, m, l, scale):
 
 
 def ring_attention(q, k, v, mesh, axis: str = "seq",
-                   kv_chunk: Optional[int] = None):
+                   kv_chunk: Optional[int] = None,
+                   causal: bool = False):
     """Multi-head attention with the sequence sharded over mesh ``axis``.
 
     ``q/k/v``: float arrays of shape ``(S, H, dh)`` (sequence-major) laid
     out ``PartitionSpec(axis)`` over ``mesh``. Returns the attention
-    output in the same layout. Full (non-causal) attention.
+    output in the same layout.
 
     ``kv_chunk``: fold each visiting KV block in chunks of this many
     keys (flash-attention-style inner loop) — peak score memory drops
     from O(Sb²) to O(Sb·kv_chunk) per head, which is what lets a single
     chip run long blocks. Must divide the per-device block length.
+
+    ``causal``: apply causal masking over GLOBAL sequence positions —
+    each device masks the visiting KV block against its query block's
+    position range, so fully-future blocks contribute nothing while the
+    ring still rotates uniformly.
     """
     import jax
     import jax.numpy as jnp
@@ -74,11 +91,16 @@ def ring_attention(q, k, v, mesh, axis: str = "seq",
         kh = jnp.swapaxes(k_blk, 0, 1).astype(jnp.float32)
         vh = jnp.swapaxes(v_blk, 0, 1).astype(jnp.float32)
         Sb = qh.shape[1]
+        my = lax.axis_index(axis)
+        qpos = my * Sb + jnp.arange(Sb) if causal else None
 
-        def fold_block(k_cur, v_cur, acc, m, l):
+        def fold_block(k_cur, v_cur, acc, m, l, kv_owner):
+            # positions are always threaded; masking is keyed on qpos
+            # (None in non-causal mode) so XLA DCEs the unused kpos
+            kpos = kv_owner * Sb + jnp.arange(Sb)
             if kv_chunk is None or kv_chunk >= Sb:
                 return _online_softmax_step(qh, k_cur, v_cur, acc, m, l,
-                                            scale)
+                                            scale, qpos, kpos)
             if Sb % kv_chunk:
                 raise ValueError(
                     f"kv_chunk={kv_chunk} must divide block length {Sb}")
@@ -89,32 +111,49 @@ def ring_attention(q, k, v, mesh, axis: str = "seq",
             vc = jnp.moveaxis(
                 v_cur.reshape(v_cur.shape[0], nch, kv_chunk, -1), 1, 0)
 
-            def chunk_step(carry, kv):
+            def chunk_step(carry, xs):
                 acc, m, l = carry
+                kcur, vcur, kp = xs
                 acc, m, l = _online_softmax_step(
-                    qh, kv[0], kv[1], acc, m, l, scale)
+                    qh, kcur, vcur, acc, m, l, scale, qpos, kp)
                 return (acc, m, l), None
 
-            (acc, m, l), _ = lax.scan(chunk_step, (acc, m, l), (kc, vc))
+            (acc, m, l), _ = lax.scan(
+                chunk_step, (acc, m, l),
+                (kc, vc, kpos.reshape(nch, kv_chunk)))
             return acc, m, l
 
-        def step(carry, _):
+        def step(carry, t):
             # permute first, fold second: the local block is folded
             # before the loop, so exactly n-1 rotations happen — no
             # wasted final ppermute (XLA can't peel a scan iteration)
             k_cur, v_cur, acc, m, l = carry
             k_cur = lax.ppermute(k_cur, axis, perm)
             v_cur = lax.ppermute(v_cur, axis, perm)
-            acc, m, l = fold_block(k_cur, v_cur, acc, m, l)
+            # after t+1 rotations, the resident block came from rank
+            # (my - t - 1) mod n — its global positions drive the mask
+            kv_owner = (my - t - 1) % n
+            if causal:
+                # fully-future blocks contribute nothing: skip their fold
+                # (local compute only — the ppermute above stays uniform
+                # across devices, so the ring itself is unaffected)
+                acc, m, l = lax.cond(
+                    kv_owner <= my,
+                    lambda op: fold_block(*op),
+                    lambda op: (op[2], op[3], op[4]),
+                    (k_cur, v_cur, acc, m, l, kv_owner))
+            else:
+                acc, m, l = fold_block(k_cur, v_cur, acc, m, l, kv_owner)
             return (k_cur, v_cur, acc, m, l), None
 
         # fold the resident block, then rotate n-1 times; the init state
         # derives from qh so it carries the same varying manual axes as
         # the loop outputs (JAX ≥0.8 shard_map typing)
         acc0, m0, l0 = fold_block(
-            kh, vh, qh * 0.0, qh[..., 0] * 0.0 - jnp.inf, qh[..., 0] * 0.0)
+            kh, vh, qh * 0.0, qh[..., 0] * 0.0 - jnp.inf,
+            qh[..., 0] * 0.0, my)
         (k_f, v_f, acc, m, l), _ = lax.scan(
-            step, (kh, vh, acc0, m0, l0), None, length=n - 1)
+            step, (kh, vh, acc0, m0, l0), jnp.arange(n - 1))
         out = acc / l[..., None]
         return jnp.swapaxes(out, 0, 1).astype(q_blk.dtype)
 
@@ -164,17 +203,20 @@ def ulysses_attention(q, k, v, mesh, axis: str = "seq"):
     return fn(q, k, v)
 
 
-def dense_attention(q, k, v):
+def dense_attention(q, k, v, causal: bool = False):
     """Unsharded reference: softmax(QKᵀ/√dh)·V per head; q/k/v (S, H, dh)."""
     import jax
     import jax.numpy as jnp
 
+    S = q.shape[0]
     scale = 1.0 / math.sqrt(q.shape[-1])
     qh = jnp.swapaxes(q, 0, 1).astype(jnp.float32)
     kh = jnp.swapaxes(k, 0, 1).astype(jnp.float32)
     vh = jnp.swapaxes(v, 0, 1).astype(jnp.float32)
     s = jnp.matmul(qh, jnp.swapaxes(kh, -1, -2),
                    preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, _MASKED)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.matmul(p, vh, preferred_element_type=jnp.float32)
     return jnp.swapaxes(out, 0, 1).astype(q.dtype)

@@ -72,6 +72,21 @@ def test_ring_attention_kv_chunk_must_divide(rng, mesh8):
             a, b, c, mesh8, kv_chunk=3))(qs, ks, vs)
 
 
+@pytest.mark.parametrize("chunk", [None, 4])
+def test_ring_attention_causal(rng, mesh8, chunk):
+    """Causal masking over global positions, with and without the
+    flash-style inner chunking."""
+    import jax
+    q, k, v = _qkv(rng, S=64, H=4, dh=16)
+    qs, ks, vs = _shard_seq(mesh8, q, k, v)
+    out = jax.jit(lambda a, b, c: ring_attention(
+        a, b, c, mesh8, kv_chunk=chunk, causal=True))(qs, ks, vs)
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(dense_attention(q, k, v, causal=True)),
+        rtol=2e-4, atol=2e-4)
+
+
 def test_ulysses_matches_dense(rng, mesh8):
     import jax
     q, k, v = _qkv(rng)
