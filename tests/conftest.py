@@ -19,6 +19,10 @@ if not os.environ.get("PARSEC_TEST_TPU"):
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
+else:
+    # numpy-comparison tests assume f32 accuracy; TPU matmuls default to
+    # bf16 MXU passes (~1e-2 rel err), so force the 6-pass f32 emulation
+    os.environ.setdefault("PARSEC_MCA_ops_matmul_precision", "highest")
 
 import numpy as np
 import pytest
