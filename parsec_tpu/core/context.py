@@ -325,7 +325,16 @@ class Context:
             dev = self.devices.device_for(chore.device_type, task)
             if dev is None:
                 continue
-            rc = dev.execute(es, task, chore)
+            rc = None
+            try:
+                rc = dev.execute(es, task, chore)
+            finally:
+                if rc != HookReturn.ASYNC:
+                    # async devices keep their in-flight unit until the
+                    # manager completes the task (release_load); every
+                    # other outcome — including a raising hook — must
+                    # release here or the device leaks load forever
+                    dev.release_load()
             if rc == HookReturn.NEXT:
                 task.chore_mask &= ~(1 << i)
                 continue

@@ -38,38 +38,42 @@ class Device:
     def execute(self, es, task: Task, chore: Chore) -> HookReturn:
         raise NotImplementedError
 
+    def release_load(self) -> None:
+        """Release the in-flight work unit ``Registry.device_for`` added.
+        The context releases it automatically when ``execute`` returns
+        anything but ASYNC; async devices own the unit until their
+        manager completes the task and MUST call this then."""
+        with self._lock:
+            self.load = max(0.0, self.load - 1.0)
+
     def _run_hook(self, task: Task, chore: Chore) -> HookReturn:
         """Run the functional body and normalize outputs into
         ``task.output`` keyed by output-flow name."""
         t0 = time.perf_counter()
-        try:
-            inputs = task.input_values()
-            result = chore.hook(task, *inputs)
-            out_flows = task.task_class.output_flows
-            if result is None:
-                outs = {}
-            elif isinstance(result, dict):
-                outs = result
-            elif isinstance(result, (tuple, list)):
-                if len(result) != len(out_flows):
-                    raise ValueError(
-                        f"{task!r}: body returned {len(result)} values for "
-                        f"{len(out_flows)} output flows")
-                outs = {f.name: v for f, v in zip(out_flows, result)}
-            else:
-                if len(out_flows) != 1:
-                    raise ValueError(
-                        f"{task!r}: single return value but {len(out_flows)} "
-                        f"output flows")
-                outs = {out_flows[0].name: result}
-            task.output.update(outs)
-            with self._lock:
-                self.stats["tasks"] += 1
-                self.stats["exec_s"] += time.perf_counter() - t0
-            return HookReturn.DONE
-        finally:
-            with self._lock:
-                self.load = max(0.0, self.load - 1.0)
+        inputs = task.input_values()
+        result = chore.hook(task, *inputs)
+        out_flows = task.task_class.output_flows
+        if result is None:
+            outs = {}
+        elif isinstance(result, dict):
+            outs = result
+        elif isinstance(result, (tuple, list)):
+            if len(result) != len(out_flows):
+                raise ValueError(
+                    f"{task!r}: body returned {len(result)} values for "
+                    f"{len(out_flows)} output flows")
+            outs = {f.name: v for f, v in zip(out_flows, result)}
+        else:
+            if len(out_flows) != 1:
+                raise ValueError(
+                    f"{task!r}: single return value but {len(out_flows)} "
+                    f"output flows")
+            outs = {out_flows[0].name: result}
+        task.output.update(outs)
+        with self._lock:
+            self.stats["tasks"] += 1
+            self.stats["exec_s"] += time.perf_counter() - t0
+        return HookReturn.DONE
 
     def dump_statistics(self) -> Dict:
         return dict(self.stats, name=self.name, index=self.index)
@@ -119,8 +123,8 @@ class Registry:
                 best, best_score = dev, score
         if best is not None:
             with best._lock:
-                best.load += 1.0       # in-flight work unit; released by
-        return best                    # _task_done after the body runs
+                best.load += 1.0       # in-flight unit; the context
+        return best                    # releases it (see release_load)
 
     def by_type(self, device_type: DeviceType) -> List[Device]:
         return [d for d in self.devices if d.device_type & device_type]

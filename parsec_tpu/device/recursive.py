@@ -19,13 +19,14 @@ class RecursiveDevice(Device):
     name = "recursive"
 
     def execute(self, es, task: Task, chore: Chore) -> HookReturn:
-        try:
-            child = chore.hook(task, *task.input_values())
-            if not isinstance(child, Taskpool):
-                raise TypeError("recursive chore must return a Taskpool")
-        finally:
-            with self._lock:
-                self.load = max(0.0, self.load - 1.0)
+        # exceptions below propagate with rc unset → the context's
+        # finally releases the in-flight unit; on the successful ASYNC
+        # return we release here, as soon as the child is enqueued,
+        # rather than holding the slot for the child's whole runtime
+        child = chore.hook(task, *task.input_values())
+        if not isinstance(child, Taskpool):
+            raise TypeError("recursive chore must return a Taskpool")
+        self.release_load()
         ctx = self.registry.context
 
         def _child_done(tp, _task=task) -> None:

@@ -1,0 +1,164 @@
+"""Async task-state contract tests (SURVEY §7 hard parts: AGAIN/ASYNC
+rescheduling, scheduling.c:485-535): a chore may return AGAIN (resource
+busy — reschedule with demoted priority) or ASYNC (a device manager
+completes the task later on another thread)."""
+
+import threading
+import time
+
+import pytest
+
+import parsec_tpu as parsec
+from parsec_tpu.core.task import Chore, DeviceType, HookReturn
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.device.base import Device
+from parsec_tpu.dsl import ptg
+
+
+class AsyncDevice(Device):
+    """Device whose execute returns ASYNC and completes the task from a
+    manager thread shortly after (the CUDA-manager-thread shape,
+    device_cuda_module.c:2573)."""
+
+    device_type = DeviceType.TPU      # claims the accelerator slot
+    name = "async-test"
+
+    def __init__(self, context_getter, delay=0.01):
+        super().__init__()
+        self.weight = 1000.0          # win device selection
+        self._get_ctx = context_getter
+        self._delay = delay
+        self.completed = []
+
+    def execute(self, es, task, chore):
+        def finish():
+            time.sleep(self._delay)
+            inputs = task.input_values()
+            task.output.update({
+                f.name: chore.hook(task, *inputs)
+                for f in task.task_class.output_flows})
+            self.completed.append(repr(task))
+            self.release_load()       # async devices own the unit
+            self._get_ctx().complete_task(None, task)
+
+        threading.Thread(target=finish, daemon=True).start()
+        return HookReturn.ASYNC
+
+
+def _chain(store, n):
+    tp = ptg.Taskpool("chain", N=n, S=store)
+    T = tp.task_class(
+        "T", params=("i",),
+        space=lambda g: ((i,) for i in range(g.N)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, i: (g.S, ("x",)),
+                        guard=lambda g, i: i == 0),
+                 ptg.In(src=("T", lambda g, i: (i - 1,), "X"),
+                        guard=lambda g, i: i > 0)],
+            outs=[ptg.Out(dst=("T", lambda g, i: (i + 1,), "X"),
+                          guard=lambda g, i: i < g.N - 1),
+                  ptg.Out(data=lambda g, i: (g.S, ("x",)),
+                          guard=lambda g, i: i == g.N - 1)])])
+
+    @T.body
+    def body(task, x):
+        return x + 1
+    return tp
+
+
+def test_async_device_completes_chain():
+    """A chain where every task completes asynchronously on the device
+    manager thread; release_deps must fire from there and the chain must
+    still terminate."""
+    ctx = parsec.init(nb_cores=2)
+    try:
+        dev = AsyncDevice(lambda: ctx)
+        ctx.devices.add(dev)
+        ctx.start()
+        store = LocalCollection("S", {("x",): 0})
+        ctx.add_taskpool(_chain(store, 15))
+        assert ctx.wait(timeout=30)
+        assert store.data_of(("x",)) == 15
+        assert len(dev.completed) == 15
+    finally:
+        parsec.fini(ctx)
+
+
+def test_again_reschedules_with_demotion():
+    """A chore that returns AGAIN twice before running must be
+    rescheduled (priority demoted each time) and finally complete."""
+    ctx = parsec.init(nb_cores=2)
+    try:
+        ctx.start()
+        store = LocalCollection("S", {("x",): 0})
+        tp = ptg.Taskpool("again", S=store)
+        attempts = []
+
+        T = tp.task_class(
+            "T", params=("i",), space=lambda g: ((0,),),
+            flows=[ptg.FlowSpec(
+                "X", ptg.RW,
+                ins=[ptg.In(data=lambda g, i: (g.S, ("x",)))],
+                outs=[ptg.Out(data=lambda g, i: (g.S, ("x",)))])])
+
+        # a raw chore returning AGAIN until the third attempt
+        def flaky_hook(task, x):
+            attempts.append(task.priority)
+            if len(attempts) < 3:
+                return HookReturn.AGAIN
+            return 41 + len(attempts) - 2
+
+        class AgainDevice(Device):
+            device_type = DeviceType.CPU
+            name = "again-test"
+
+            def execute(self, es, task, chore):
+                r = chore.hook(task, *task.input_values())
+                if r == HookReturn.AGAIN:
+                    return HookReturn.AGAIN
+                task.output["X"] = r
+                return HookReturn.DONE
+
+        dev = AgainDevice()
+        dev.weight = 1000.0
+        ctx.devices.add(dev)
+        T.add_chore(Chore(DeviceType.CPU, flaky_hook, batchable=False))
+        ctx.add_taskpool(tp)
+        assert ctx.wait(timeout=30)
+        assert store.data_of(("x",)) == 42
+        assert len(attempts) == 3
+        # each AGAIN demotes priority (scheduling.c:496-527 analog)
+        assert attempts[0] > attempts[1] > attempts[2]
+    finally:
+        parsec.fini(ctx)
+
+
+def test_next_incarnation_fallback():
+    """A chore whose evaluate() vetoes must fall through to the next
+    incarnation (chore_mask walk, scheduling.c:124-203)."""
+    ctx = parsec.init(nb_cores=2)
+    try:
+        ctx.start()
+        store = LocalCollection("S", {("x",): 0})
+        tp = ptg.Taskpool("fallback", S=store)
+        T = tp.task_class(
+            "T", params=("i",), space=lambda g: ((0,),),
+            flows=[ptg.FlowSpec(
+                "X", ptg.RW,
+                ins=[ptg.In(data=lambda g, i: (g.S, ("x",)))],
+                outs=[ptg.Out(data=lambda g, i: (g.S, ("x",)))])])
+
+        @T.body(evaluate=lambda task: False)      # always vetoed
+        def never(task, x):
+            return -1
+
+        @T.body_cpu
+        def fallback(task, x):
+            return 7
+
+        ctx.add_taskpool(tp)
+        assert ctx.wait(timeout=30)
+        assert store.data_of(("x",)) == 7
+    finally:
+        parsec.fini(ctx)

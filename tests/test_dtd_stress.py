@@ -34,8 +34,11 @@ def test_dtd_insertion_throughput(ctx):
     tp.wait()
     total = sum(C.data_of((i,)) for i in range(64))
     assert total == n
+    # insert_s includes window-throttled execution of most tasks, so the
+    # floor is a gross-pathology guard, not a benchmark (loaded CI
+    # machines must not flake it)
     rate = n / insert_s
-    assert rate > 1000, f"insertion rate collapsed: {rate:.0f} tasks/s"
+    assert rate > 100, f"insertion rate collapsed: {rate:.0f} tasks/s"
 
 
 def test_dtd_deep_chain(ctx):
