@@ -66,10 +66,13 @@ class Trace:
 
     def install(self, context) -> "Trace":
         """Subscribe to the context's PINS chains (task_profiler module
-        analog, mca/pins/task_profiler)."""
+        analog, mca/pins/task_profiler) and, when a comm engine is
+        attached, its per-message instrumentation (msg_size events)."""
         self.add_keyword("task", info_schema={"class": "str", "locals": "list"})
         context.trace = self
         context.pins.register(PinsEvent.EXEC_BEGIN, self.task_begin)
+        if context.comm is not None:
+            context.comm.install_trace(self)
         return self
 
     # -- export -----------------------------------------------------------
