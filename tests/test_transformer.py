@@ -10,7 +10,7 @@ from parsec_tpu.data import LocalCollection
 from parsec_tpu.dsl import ptg
 
 
-def _setup(rng, H=2, T=3, TS=8, DH=4, F=16):
+def _arrays(rng, H, T, TS, DH, F):
     D = H * DH
     q = rng.standard_normal((H, T * TS, DH)).astype(np.float32)
     k = rng.standard_normal((H, T * TS, DH)).astype(np.float32)
@@ -18,6 +18,11 @@ def _setup(rng, H=2, T=3, TS=8, DH=4, F=16):
     Wo = (rng.standard_normal((D, D)) / np.sqrt(D)).astype(np.float32)
     W1 = (rng.standard_normal((D, F)) / np.sqrt(D)).astype(np.float32)
     W2 = (rng.standard_normal((F, D)) / np.sqrt(F)).astype(np.float32)
+    return q, k, v, Wo, W1, W2
+
+
+def _setup(rng, H=2, T=3, TS=8, DH=4, F=16):
+    q, k, v, Wo, W1, W2 = _arrays(rng, H, T, TS, DH, F)
     Qc = LocalCollection("Q", {(h, i): q[h, i * TS:(i + 1) * TS]
                                for h in range(H) for i in range(T)})
     Kc = LocalCollection("K", {(h, i): k[h, i * TS:(i + 1) * TS]
@@ -49,22 +54,20 @@ def test_transformer_distributed_ring(rng):
     """The streaming-attention chain across TWO ranks: KV tiles are
     owner-placed alternately, so each ATT hop's state activation crosses
     the comm engine — ring attention as distributed dataflow."""
-    import parsec_tpu as parsec
     from parsec_tpu.comm.local import LocalCommEngine
     from parsec_tpu.termdet import FourCounterTermdet
 
     H, T, TS, DH, F = 2, 4, 8, 4, 16
-    D = H * DH
-    q = rng.standard_normal((H, T * TS, DH)).astype(np.float32)
-    k = rng.standard_normal((H, T * TS, DH)).astype(np.float32)
-    v = rng.standard_normal((H, T * TS, DH)).astype(np.float32)
-    Wo = (rng.standard_normal((D, D)) / np.sqrt(D)).astype(np.float32)
-    W1 = (rng.standard_normal((D, F)) / np.sqrt(D)).astype(np.float32)
-    W2 = (rng.standard_normal((F, D)) / np.sqrt(F)).astype(np.float32)
+    q, k, v, Wo, W1, W2 = _arrays(rng, H, T, TS, DH, F)
     ref = reference_block(q, k, v, Wo, W1, W2)
 
     class RingStore(LocalCollection):
         """KV tile (h, j) owned by rank j % 2 (the ring layout)."""
+
+        def __init__(self, name, init, myrank):
+            super().__init__(name=name, init=init)
+            self.myrank = myrank
+            self.nodes = 2
 
         def rank_of(self, key):
             return key[1] % 2
@@ -74,11 +77,11 @@ def test_transformer_distributed_ring(rng):
     for r in range(2):
         c = parsec.init(nb_cores=2, comm=engines[r])
         Qc = RingStore("Q", {(h, i): q[h, i * TS:(i + 1) * TS]
-                             for h in range(H) for i in range(T)})
+                             for h in range(H) for i in range(T)}, r)
         Kc = RingStore("K", {(h, j): k[h, j * TS:(j + 1) * TS]
-                             for h in range(H) for j in range(T)})
+                             for h in range(H) for j in range(T)}, r)
         Vc = RingStore("V", {(h, j): v[h, j * TS:(j + 1) * TS]
-                             for h in range(H) for j in range(T)})
+                             for h in range(H) for j in range(T)}, r)
         Y = LocalCollection("Y", {(i,): None for i in range(T)})
         tp = build_transformer_block(Qc, Kc, Vc, Y, H, T, TS, DH,
                                      Wo, W1, W2)
