@@ -20,7 +20,10 @@ way the reference's iterators_checker PINS module does at runtime.
 Dependency counting uses the mask strategy with one bit per consumer flow
 (a JDF flow has exactly one active input dependency per task instance, so
 flow-granular bits are sufficient and duplicate activations are caught —
-reference mask mode, parsec.c:1601).
+reference mask mode, parsec.c:1601). Exception: classes with a CTL-gather
+flow (``In(gather=True)``) use counter mode — N producers feed one flow,
+so the per-flow bit cannot count them and duplicate detection is traded
+away exactly as in the reference's counter mode (parsec.c:1554).
 
 Example (tiled Cholesky's POTRF class)::
 
@@ -54,7 +57,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 from ..core.future import DataCopyFuture
 from ..core.reshape import compose_specs
 from ..core.task import Chore, DeviceType, Flow, FlowAccess, Task
-from ..core.taskpool import DEPS_MASK, DataRef, SuccessorRef, TaskClass
+from ..core.taskpool import DEPS_COUNTER, DEPS_MASK, DataRef, \
+    SuccessorRef, TaskClass
 from ..core.taskpool import Taskpool as CoreTaskpool
 
 READ = FlowAccess.READ
@@ -78,12 +82,19 @@ class In:
     ``reshape`` (core.reshape.ReshapeSpec) converts the incoming value to
     this consumer's datatype/layout — the JDF ``[type = ...]`` annotation
     (reshape promises, parsec_reshape.c).
+
+    ``gather=True`` (CTL flows only): ``src``'s params_fn returns a LIST
+    of producer coordinates and the flow waits for ALL of them — the
+    reference's CTL-gather fan-in (tests/dsl/ptg/controlgather/
+    ctlgat.jdf, PARSEC_HAS_CTL_GATHER). A class with a gather flow uses
+    counter-mode dependency tracking.
     """
     src: Optional[Tuple[str, Callable, str]] = None
     data: Optional[Callable] = None
     new: Optional[Callable] = None
     guard: Optional[Callable] = None
     reshape: Optional[Any] = None
+    gather: bool = False
 
     def active(self, g, params) -> bool:
         return self.guard is None or bool(self.guard(g, *params))
@@ -136,7 +147,20 @@ class PTGTaskClass(TaskClass):
                  space: Callable, affinity: Optional[Callable],
                  priority: Optional[Callable]):
         flows = [Flow(s.name, s.access) for s in specs]
-        super().__init__(name, tc_id, params, flows, deps_mode=DEPS_MASK)
+        for s in specs:
+            for d in s.ins:
+                if d.gather and not (s.access & FlowAccess.CTL):
+                    raise ValueError(
+                        f"{name}.{s.name}: gather ins are CTL-only (data "
+                        f"fan-in needs one flow per producer)")
+                if d.gather and d.src is None:
+                    raise ValueError(
+                        f"{name}.{s.name}: gather requires a src "
+                        f"producer list")
+        # gather fan-in needs counting, not one-bit-per-flow masking
+        mode = DEPS_COUNTER if any(d.gather for s in specs
+                                   for d in s.ins) else DEPS_MASK
+        super().__init__(name, tc_id, params, flows, deps_mode=mode)
         self.tp = tp
         self.specs = {s.name: s for s in specs}
         self.spec_list = specs
@@ -147,6 +171,11 @@ class PTGTaskClass(TaskClass):
         self.iterate_successors = self._iterate_successors
         self.deps_goal = self._deps_goal
         self.data_lookup = self._data_lookup
+        # deps_goal runs once per ARRIVING activation (activate_dep), so
+        # gather classes would re-enumerate their N-element target list
+        # N times without this (the reference computes goals once per
+        # task instance); the closed form is pure, so cache per locals
+        self._goal_cache: Dict[Tuple[int, ...], int] = {}
 
     # -- body decorators --------------------------------------------------
     def body(self, fn: Callable = None, device: DeviceType = DeviceType.ALL,
@@ -179,10 +208,36 @@ class PTGTaskClass(TaskClass):
                 f"{len(active)} active input deps (guards must be disjoint)")
         return active[0] if active else None
 
+    @staticmethod
+    def _coord_set(targets) -> set:
+        """Normalize a gather target list to a set of coordinate tuples
+        (accepts generators; duplicates collapse — each producer sends
+        exactly one activation, so a duplicated coordinate must not
+        inflate the goal into an unreachable count)."""
+        return {tuple(x) if isinstance(x, (tuple, list)) else (x,)
+                for x in targets}
+
     def _deps_goal(self, locals) -> int:
-        """Mask of flow bits fed by *task* sources (collection reads and
-        NEW are resolved locally at prepare_input, not counted)."""
+        """Mask of flow bits (mask mode) or count (counter mode, used by
+        CTL-gather classes) of *task*-fed deps; collection reads and NEW
+        are resolved locally at prepare_input, not counted."""
         g = self.tp.g
+        if self.deps_mode == DEPS_COUNTER:
+            key = tuple(locals)
+            cached = self._goal_cache.get(key)
+            if cached is not None:
+                return cached
+            count = 0
+            for f in self.flows:
+                dep = self._active_in(g, self.specs[f.name], locals)
+                if dep is None or dep.src is None:
+                    continue
+                if dep.gather:
+                    count += len(self._coord_set(dep.src[1](g, *locals)))
+                else:
+                    count += 1
+            self._goal_cache[key] = count
+            return count
         mask = 0
         for f in self.flows:
             dep = self._active_in(g, self.specs[f.name], locals)
@@ -355,6 +410,10 @@ def check_taskpool(tp: Taskpool, nb_ranks: int = 1) -> None:
     exists: Dict[str, set] = {tc.name: set(tc.enumerate_space())
                               for tc in tp.task_classes}
     incoming: Dict[Tuple[str, Tuple], int] = {}
+    # counter-mode consumers additionally track WHICH producer fed them
+    # how many times — a duplicate edge compensated by a missing one
+    # passes a bare count but breaks the gather barrier at runtime
+    incoming_pairs: Dict[Tuple[str, Tuple], Dict[Tuple, int]] = {}
     for tc in tp.task_classes:
         for p in tc.enumerate_space():
             task = Task(tp, tc, p)
@@ -376,18 +435,57 @@ def check_taskpool(tp: Taskpool, nb_ranks: int = 1) -> None:
                         f"{ref.flow_name}: consumer declares no task input")
                 src_cls, src_params_fn, src_flow = dep.src
                 sp = src_params_fn(g, *ref.locals)
-                sp = tuple(sp) if isinstance(sp, (tuple, list)) else (sp,)
-                if src_cls != tc.name or tuple(sp) != tuple(p):
-                    raise AssertionError(
-                        f"{ref.task_class.name}{ref.locals}.{ref.flow_name} "
-                        f"expects {src_cls}{sp}, got {tc.name}{p}")
+                if dep.gather:
+                    members = PTGTaskClass._coord_set(sp)
+                    if src_cls != tc.name or tuple(p) not in members:
+                        raise AssertionError(
+                            f"{ref.task_class.name}{ref.locals}."
+                            f"{ref.flow_name}: gather over {src_cls} does "
+                            f"not name {tc.name}{p}")
+                else:
+                    sp = tuple(sp) if isinstance(sp, (tuple, list)) else (sp,)
+                    if src_cls != tc.name or tuple(sp) != tuple(p):
+                        raise AssertionError(
+                            f"{ref.task_class.name}{ref.locals}."
+                            f"{ref.flow_name} expects {src_cls}{sp}, "
+                            f"got {tc.name}{p}")
                 k = (ref.task_class.name, ref.locals)
-                incoming[k] = incoming.get(k, 0) | (1 << ref.dep_index)
+                if ref.task_class.deps_mode == DEPS_COUNTER:
+                    incoming[k] = incoming.get(k, 0) + 1
+                    pk = (tc.name, tuple(p), ref.flow_name)
+                    pairs = incoming_pairs.setdefault(k, {})
+                    pairs[pk] = pairs.get(pk, 0) + 1
+                else:
+                    incoming[k] = incoming.get(k, 0) | (1 << ref.dep_index)
     for tc in tp.task_classes:
         for p in tc.enumerate_space():
             goal = tc.deps_goal(p)
             got = incoming.get((tc.name, p), 0)
             if got != goal:
+                kind = "count" if tc.deps_mode == DEPS_COUNTER else "mask"
                 raise AssertionError(
-                    f"{tc.name}{p}: goal mask {goal:b} but incoming deps "
-                    f"{got:b}")
+                    f"{tc.name}{p}: goal {kind} {goal} but incoming deps "
+                    f"{got}")
+            if tc.deps_mode != DEPS_COUNTER:
+                continue
+            # every expected producer must feed EXACTLY once
+            expected: Dict[Tuple, int] = {}
+            for f in tc.flows:
+                dep = tc._active_in(g, tc.specs[f.name], p)
+                if dep is None or dep.src is None:
+                    continue
+                src_cls, src_params_fn, _sf = dep.src
+                if dep.gather:
+                    for coord in PTGTaskClass._coord_set(
+                            src_params_fn(g, *p)):
+                        expected[(src_cls, coord, f.name)] = 1
+                else:
+                    sp = src_params_fn(g, *p)
+                    sp = tuple(sp) if isinstance(sp, (tuple, list)) else (sp,)
+                    key = (src_cls, sp, f.name)
+                    expected[key] = expected.get(key, 0) + 1
+            got_pairs = incoming_pairs.get((tc.name, p), {})
+            if got_pairs != expected:
+                raise AssertionError(
+                    f"{tc.name}{p}: producer multiplicity mismatch — "
+                    f"expected {expected}, got {got_pairs}")

@@ -1,0 +1,84 @@
+"""CTL-gather tests (reference tests/dsl/ptg/controlgather/ctlgat.jdf,
+PARSEC_HAS_CTL_GATHER): one task fans in control deps from N producers
+through a single CTL flow."""
+
+import threading
+
+import pytest
+
+import parsec_tpu as parsec
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl import ptg
+
+
+def _gather_tp(store, n):
+    """W(i) each bump their own slot, then GATHER(0) runs after ALL of
+    them (a barrier expressed as dataflow)."""
+    tp = ptg.Taskpool("ctlgat", N=n, S=store)
+    tp.task_class(
+        "W", params=("i",),
+        space=lambda g: ((i,) for i in range(g.N)),
+        flows=[
+            ptg.FlowSpec(
+                "X", ptg.RW,
+                ins=[ptg.In(data=lambda g, i: (g.S, (i,)))],
+                outs=[ptg.Out(data=lambda g, i: (g.S, (i,)))]),
+            ptg.FlowSpec(
+                "C", ptg.CTL,
+                outs=[ptg.Out(dst=("GATHER", lambda g, i: (0,), "C"))]),
+        ])
+    tp.task_class(
+        "GATHER", params=("j",),
+        space=lambda g: ((0,),),
+        flows=[
+            ptg.FlowSpec(
+                "C", ptg.CTL,
+                ins=[ptg.In(src=("W", lambda g, j: [(i,) for i in
+                                                    range(g.N)], "C"),
+                            gather=True)]),
+            ptg.FlowSpec(
+                "R", ptg.WRITE,
+                outs=[ptg.Out(data=lambda g, j: (g.S, ("sum",)))]),
+        ])
+
+    @tp.get_task_class("W").body_cpu
+    def w_body(task, x):
+        return x + 1
+
+    @tp.get_task_class("GATHER").body_cpu
+    def gather_body(task, r):
+        # by the gather contract every W has completed and written back
+        return {"R": sum(store.data_of((i,))
+                         for i in range(tp.g.N))}
+
+    return tp
+
+
+def test_ctl_gather_checker():
+    store = LocalCollection("S", {(i,): 0 for i in range(6)})
+    store.write_tile(("sum",), None)
+    tp = _gather_tp(store, 6)
+    assert tp.get_task_class("GATHER").deps_mode == "counter"
+    assert tp.get_task_class("GATHER").deps_goal((0,)) == 6
+    ptg.check_taskpool(tp)
+
+
+@pytest.mark.parametrize("n", [1, 7, 32])
+def test_ctl_gather_runs_after_all(ctx, n):
+    store = LocalCollection("S", {(i,): 10 * i for i in range(n)})
+    store.write_tile(("sum",), None)
+    ctx.add_taskpool(_gather_tp(store, n))
+    assert ctx.wait(timeout=60)
+    assert store.data_of(("sum",)) == sum(10 * i + 1 for i in range(n))
+
+
+def test_gather_on_data_flow_rejected():
+    store = LocalCollection("S", {(0,): 0})
+    tp = ptg.Taskpool("bad", S=store)
+    with pytest.raises(ValueError, match="CTL-only"):
+        tp.task_class(
+            "B", params=("i",), space=lambda g: ((0,),),
+            flows=[ptg.FlowSpec(
+                "X", ptg.RW,
+                ins=[ptg.In(src=("B", lambda g, i: [(0,)], "X"),
+                            gather=True)])])
