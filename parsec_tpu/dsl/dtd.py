@@ -339,6 +339,13 @@ class Taskpool(CoreTaskpool):
                 seen_tiles[tile] = fname
                 with tile.lock:
                     writer = tile.last_writer
+                    # capture the writer's flow ATOMICALLY with the
+                    # writer: the completer clears both under this lock
+                    # (retire, step 1) BEFORE publishing done (step 2),
+                    # so re-reading it later could yield None for a
+                    # writer whose done flag we still observe False —
+                    # the successor would then receive a None value
+                    writer_flow = tile.last_writer_flow
                     holder = tile.holder_rank
                 if holder is None:
                     holder = a.collection.rank_of(a.key)
@@ -350,7 +357,7 @@ class Taskpool(CoreTaskpool):
                                                locals=task.locals,
                                                flow_name=fname, value=None,
                                                priority=priority)
-                            ref.src_flow = tile.last_writer_flow
+                            ref.src_flow = writer_flow
                             writer.dsl["succ"].append(ref)
                             goal += 1
                             linked = True
@@ -419,6 +426,8 @@ class Taskpool(CoreTaskpool):
             seen.add(tile)
             with tile.lock:
                 writer = tile.last_writer
+                # atomic with the writer — see the local-insert path
+                writer_flow = tile.last_writer_flow
                 holder = tile.holder_rank
             if holder is None:
                 holder = a.collection.rank_of(a.key)
@@ -430,7 +439,7 @@ class Taskpool(CoreTaskpool):
                         if not writer.dsl["done"]:
                             writer.dsl["succ"].append(
                                 ("remote", target_rank, seq, fname,
-                                 tile.last_writer_flow, priority))
+                                 writer_flow, priority))
                             sent = True
                     if not sent and holder == my_rank:
                         self._send_value(target_rank, seq, fname,

@@ -529,7 +529,9 @@ class _Parser:
             self.expect("OP", ")")
             return DepTarget(new=e)
         call = self.parse_call_ref(allow_flow=True,
-                                   allow_range=(direction == "out"))
+                                   allow_range=True)   # ranged IN = CTL
+                                                       # gather (checked
+                                                       # semantically)
         return DepTarget(call=call)
 
     def parse_call_ref(self, allow_flow: bool,
@@ -621,6 +623,12 @@ def _sanity_check(jdf: JdfFile) -> None:
                         continue
                     c = target.call
                     if c.is_task_ref:
+                        if d.direction == "in" and f.access != "CTL" and \
+                                any(isinstance(a, tuple) for a in c.args):
+                            raise JDFSemanticError(
+                                f"JDF:{d.line}: {tc.name}.{f.name}: ranged "
+                                f"input deps (CTL gather) are only allowed "
+                                f"on CTL flows")
                         if c.name not in class_names:
                             raise JDFSemanticError(
                                 f"{tc.name}.{f.name}: unknown task class "
@@ -869,6 +877,15 @@ class CompiledJDF:
             return ptg.In(new=lambda g, *p: ev.eval(e, p), guard=gfn)
         c = target.call
         if c.is_task_ref:
+            if any(isinstance(a, tuple) for a in c.args):
+                # ranged IN dep = CTL gather (ctlgat.jdf syntax:
+                # `CTL C <- C W(0 .. N-1)`): wait for every producer in
+                # the expanded range
+                def gather_fn(g, *p, _c=c):
+                    return _expand_args(ev, _c, p)
+                return ptg.In(src=(c.name, gather_fn, c.flow),
+                              guard=gfn, gather=True)
+
             def params_fn(g, *p, _c=c):
                 env = ev.env(p)
                 return tuple(eval(a.code(), env)

@@ -213,7 +213,10 @@ class PTGTaskClass(TaskClass):
         """Normalize a gather target list to a set of coordinate tuples
         (accepts generators; duplicates collapse — each producer sends
         exactly one activation, so a duplicated coordinate must not
-        inflate the goal into an unreachable count)."""
+        inflate the goal into an unreachable count). A bare tuple means
+        ONE coordinate, matching the Out-dst convention."""
+        if isinstance(targets, tuple):
+            targets = [targets]
         return {tuple(x) if isinstance(x, (tuple, list)) else (x,)
                 for x in targets}
 

@@ -72,6 +72,80 @@ def test_ctl_gather_runs_after_all(ctx, n):
     assert store.data_of(("sum",)) == sum(10 * i + 1 for i in range(n))
 
 
+CTLGAT_JDF = """
+N [ type = int ]
+S [ type = collection ]
+
+W(i)
+  i = 0 .. N-1
+  RW X <- S(i)
+       -> S(i)
+  CTL C -> C GATHER(0)
+BODY
+  X = X + 1
+END
+
+GATHER(j)
+  j = 0 .. 0
+  CTL C <- C W(0 .. N-1)
+  WRITE R -> S(N)
+BODY
+  R = 1
+END
+"""
+
+
+def test_ctl_gather_from_jdf(ctx):
+    """The ctlgat.jdf syntax: a ranged IN dep on a CTL flow compiles to
+    a gather barrier."""
+    from parsec_tpu.dsl.jdf import compile_jdf
+    n = 9
+    store = LocalCollection("S", {(i,): 0 for i in range(n + 1)})
+    tp = compile_jdf(CTLGAT_JDF, name="ctlgat").taskpool(N=n, S=store)
+    assert tp.get_task_class("GATHER").deps_goal((0,)) == n
+    ptg.check_taskpool(tp)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=60)
+    assert store.data_of((n,)) == 1                     # barrier fired
+    assert all(store.data_of((i,)) == 1 for i in range(n))
+
+
+def test_jdf_ranged_in_on_data_flow_rejected():
+    """Ranged IN on a non-CTL flow must fail at compile time with line
+    info (like the rest of the JDF semantic checks)."""
+    from parsec_tpu.dsl.jdf import JDFSemanticError, compile_jdf
+    bad = """
+N [ type = int ]
+S [ type = collection ]
+
+W(i)
+  i = 0 .. N-1
+  RW X <- S(i)
+       -> X G(0)
+BODY
+  X = X
+END
+
+G(j)
+  j = 0 .. 0
+  RW X <- X W(0 .. N-1)
+BODY
+  X = X
+END
+"""
+    with pytest.raises(JDFSemanticError, match="CTL"):
+        compile_jdf(bad)
+
+
+def test_gather_bare_tuple_is_one_coordinate():
+    """A gather params_fn returning a bare tuple names ONE producer
+    (the Out-dst convention), not one producer per element."""
+    from parsec_tpu.dsl.ptg import PTGTaskClass
+    assert PTGTaskClass._coord_set((1, 2)) == {(1, 2)}
+    assert PTGTaskClass._coord_set([(1, 2), (3, 4)]) == {(1, 2), (3, 4)}
+    assert PTGTaskClass._coord_set([1, 2]) == {(1,), (2,)}
+
+
 def test_gather_on_data_flow_rejected():
     store = LocalCollection("S", {(0,): 0})
     tp = ptg.Taskpool("bad", S=store)
