@@ -26,6 +26,7 @@ import math
 
 from ..dsl import ptg
 from ..data.collection import DataCollection
+from ..ops.tile_kernels import matmul_precision
 
 
 def build_transformer_block(Qc: DataCollection, Kc: DataCollection,
@@ -131,13 +132,15 @@ def build_transformer_block(Qc: DataCollection, Kc: DataCollection,
     @ATT.body
     def att_body(task, Q, K, V, S):
         acc, m, l = S
-        s = jnp.matmul(Q, K.T, preferred_element_type=jnp.float32) * scale
+        s = jnp.matmul(Q, K.T, preferred_element_type=jnp.float32,
+               precision=matmul_precision()) * scale
         m_new = jnp.maximum(m, s.max(axis=-1))
         p = jnp.exp(s - m_new[:, None])
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=-1)
         acc_new = acc * corr[:, None] + jnp.matmul(
-            p, V, preferred_element_type=jnp.float32)
+            p, V, preferred_element_type=jnp.float32,
+            precision=matmul_precision())
         return {"S": (acc_new, m_new, l_new)}
 
     @NORM.body
@@ -151,11 +154,15 @@ def build_transformer_block(Qc: DataCollection, Kc: DataCollection,
 
     @FFN.body
     def ffn_body(task, X):
-        a = jnp.matmul(X, Wo, preferred_element_type=jnp.float32)
-        hdn = jnp.maximum(jnp.matmul(a, W1,
-                                     preferred_element_type=jnp.float32), 0.0)
+        prec = matmul_precision()
+        a = jnp.matmul(X, Wo, preferred_element_type=jnp.float32,
+                       precision=prec)
+        hdn = jnp.maximum(
+            jnp.matmul(a, W1, preferred_element_type=jnp.float32,
+                       precision=prec), 0.0)
         return {"X": a + jnp.matmul(hdn, W2,
-                                    preferred_element_type=jnp.float32)}
+                                    preferred_element_type=jnp.float32,
+                                    precision=prec)}
 
     return tp
 

@@ -41,9 +41,11 @@ def _online_softmax_step(q_blk, k_cur, v_cur, acc, m, l, scale,
     positions of the query/key rows — when given, causal masking
     (key position ≤ query position) is applied."""
     import jax.numpy as jnp
+    from ..ops.tile_kernels import matmul_precision
 
     s = jnp.matmul(q_blk, jnp.swapaxes(k_cur, -1, -2),
-                   preferred_element_type=jnp.float32) * scale
+                   preferred_element_type=jnp.float32,
+                   precision=matmul_precision()) * scale
     if qpos is not None:
         allowed = qpos[:, None] >= kpos[None, :]
         s = jnp.where(allowed, s, _MASKED)
@@ -52,7 +54,8 @@ def _online_softmax_step(q_blk, k_cur, v_cur, acc, m, l, scale,
     corr = jnp.exp(m - m_new)
     l_new = l * corr + p.sum(axis=-1)
     acc_new = acc * corr[..., None] + jnp.matmul(
-        p, v_cur, preferred_element_type=jnp.float32)
+        p, v_cur, preferred_element_type=jnp.float32,
+        precision=matmul_precision())
     return acc_new, m_new, l_new
 
 
@@ -186,11 +189,14 @@ def ulysses_attention(q, k, v, mesh, axis: str = "seq"):
                                tiled=True)
             return jnp.swapaxes(x, 0, 1).astype(jnp.float32)  # [H/n, S, dh]
 
+        from ..ops.tile_kernels import matmul_precision
         qh, kh, vh = fwd(q_blk), fwd(k_blk), fwd(v_blk)
         s = jnp.matmul(qh, jnp.swapaxes(kh, -1, -2),
-                       preferred_element_type=jnp.float32) * scale
+                       preferred_element_type=jnp.float32,
+                       precision=matmul_precision()) * scale
         p = jax.nn.softmax(s, axis=-1)
-        out = jnp.matmul(p, vh, preferred_element_type=jnp.float32)
+        out = jnp.matmul(p, vh, preferred_element_type=jnp.float32,
+                         precision=matmul_precision())
         # inverse: gather heads, scatter sequence
         out = jnp.swapaxes(out, 0, 1)                         # [S, H/n, dh]
         out = lax.all_to_all(out, axis, split_axis=0, concat_axis=1,
@@ -209,14 +215,17 @@ def dense_attention(q, k, v, causal: bool = False):
     import jax.numpy as jnp
 
     S = q.shape[0]
+    from ..ops.tile_kernels import matmul_precision
     scale = 1.0 / math.sqrt(q.shape[-1])
     qh = jnp.swapaxes(q, 0, 1).astype(jnp.float32)
     kh = jnp.swapaxes(k, 0, 1).astype(jnp.float32)
     vh = jnp.swapaxes(v, 0, 1).astype(jnp.float32)
     s = jnp.matmul(qh, jnp.swapaxes(kh, -1, -2),
-                   preferred_element_type=jnp.float32) * scale
+                   preferred_element_type=jnp.float32,
+                   precision=matmul_precision()) * scale
     if causal:
         s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, _MASKED)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.matmul(p, vh, preferred_element_type=jnp.float32)
+    out = jnp.matmul(p, vh, preferred_element_type=jnp.float32,
+                     precision=matmul_precision())
     return jnp.swapaxes(out, 0, 1).astype(q.dtype)

@@ -21,9 +21,15 @@ mca_param.register("ops.matmul_precision", "default",
                    help="MXU precision for tile matmuls: default|high|highest")
 
 
-def _prec():
+def matmul_precision():
+    """The configured MXU precision for tile matmuls (None = TPU-native
+    bf16 passes; 'highest' = 6-pass f32 emulation). Public so non-LA
+    bodies (attention, FFN, ring attention) honor the same knob."""
     p = str(mca_param.get("ops.matmul_precision", "default"))
     return None if p == "default" else p
+
+
+_prec = matmul_precision
 
 
 def gemm_tile(C, A, B, alpha=1.0, beta=1.0, ta=False, tb=False):

@@ -20,7 +20,10 @@ def _qkv(rng, S=64, H=8, dh=16):
 @pytest.fixture(scope="module")
 def mesh8():
     import jax
-    assert len(jax.devices()) >= 8, "conftest forces an 8-device CPU mesh"
+    if len(jax.devices()) < 8:
+        # conftest forces an 8-device CPU mesh, but PARSEC_TEST_TPU runs
+        # see the single real chip — mesh tests don't apply there
+        pytest.skip("needs 8 devices (virtual CPU mesh)")
     return make_mesh(8, axis="seq")
 
 
