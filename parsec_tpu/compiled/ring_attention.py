@@ -46,11 +46,19 @@ def _online_softmax_step(q_blk, k_cur, v_cur, acc, m, l, scale,
     s = jnp.matmul(q_blk, jnp.swapaxes(k_cur, -1, -2),
                    preferred_element_type=jnp.float32,
                    precision=matmul_precision()) * scale
+    allowed = None
     if qpos is not None:
         allowed = qpos[:, None] >= kpos[None, :]
         s = jnp.where(allowed, s, _MASKED)
     m_new = jnp.maximum(m, s.max(axis=-1))
     p = jnp.exp(s - m_new[..., None])
+    if allowed is not None:
+        # a fully-masked row would give m_new == _MASKED and p == 1 for
+        # every masked entry (uniform attention over forbidden keys);
+        # zeroing masked p makes the helper safe standalone even though
+        # callers currently fold the resident diagonal block first and
+        # skip fully-future blocks
+        p = jnp.where(allowed, p, 0.0)
     corr = jnp.exp(m - m_new)
     l_new = l * corr + p.sum(axis=-1)
     acc_new = acc * corr[..., None] + jnp.matmul(

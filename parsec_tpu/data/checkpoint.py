@@ -124,18 +124,30 @@ class CheckpointManager:
         if os.path.isdir(final):
             # another save of the same step (or another rank finishing
             # first): merge our files into it
-            for f in os.listdir(tmp):
-                os.replace(os.path.join(tmp, f), os.path.join(final, f))
-            shutil.rmtree(tmp, ignore_errors=True)
+            self._merge_into(tmp, final)
         else:
             try:
                 os.replace(tmp, final)
             except OSError:
-                for f in os.listdir(tmp):
-                    os.replace(os.path.join(tmp, f),
-                               os.path.join(final, f))
-                shutil.rmtree(tmp, ignore_errors=True)
+                self._merge_into(tmp, final)
         return final
+
+    def _merge_into(self, tmp: str, final: str) -> None:
+        """Move tmp's files into ``final``, the done.rank sentinel LAST:
+        a crash mid-merge must never leave the sentinel visible without
+        this rank's full .npz/meta payload (is_complete would report a
+        step that restore() silently under-populates)."""
+        sentinel = f"done.rank{self.my_rank}"
+        # a prior save of the same step may have left OUR sentinel in
+        # final — drop it first, or a crash mid-merge leaves the stale
+        # sentinel vouching for a mix of old and new payload files
+        try:
+            os.remove(os.path.join(final, sentinel))
+        except FileNotFoundError:
+            pass
+        for f in sorted(os.listdir(tmp), key=lambda f: f == sentinel):
+            os.replace(os.path.join(tmp, f), os.path.join(final, f))
+        shutil.rmtree(tmp, ignore_errors=True)
 
     # ----------------------------------------------------------- restore
     def restore(self, step: int, collections: Dict[str, Any]) -> Dict:

@@ -79,6 +79,12 @@ class TaskProfiler(PinsModule):
         super().uninstall()
         if self._installed_trace and self.context.trace is self.trace:
             self.context.trace = None   # stop task_complete recording too
+            # Trace.install also hooked the comm engine's msg-size
+            # instrumentation — detach it, or the engine keeps recording
+            # into the dead trace after fini
+            if (self.context.comm is not None and
+                    getattr(self.context.comm, "_trace", None) is self.trace):
+                self.context.comm.install_trace(None)
 
     def report(self) -> Dict[str, Any]:
         return self.trace.counts()
