@@ -1,0 +1,184 @@
+"""Activate→data latency pingpong over the socket comm engine.
+
+The reference measures comm latency with ``tests/apps/pingpong`` (a
+2-rank JDF bouncing a tile back and forth) and instruments per-message
+timelines that ``check-comms.py`` asserts on. This module is the TPU
+build's equivalent: a chain taskpool whose steps alternate ownership
+between two ranks, so EVERY hop is one remote activation carrying the
+payload — p50 hop time IS the "remote_dep p50 activate→data latency" of
+BASELINE.md (eager inline path below ``comm.eager_limit``, registered-
+memory GET/PUT rendezvous above it).
+
+Run as a harness (spawns its own 2 ranks):
+
+    from parsec_tpu.comm.pingpong import measure_latency
+    stats = measure_latency(payload_bytes=1024, hops=200)
+    # {'p50_us': ..., 'p90_us': ..., 'path': 'eager', ...}
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import socket
+import time
+from typing import Dict
+
+import numpy as np
+
+
+def _free_port_base(n_ranks: int = 2, tries: int = 64) -> int:
+    """A base port with ``n_ranks`` consecutive bindable ports — actually
+    verified by binding each one (racy-but-rare: released before use)."""
+    rng = np.random.default_rng()
+    for _ in range(tries):
+        base = 21000 + int(rng.integers(0, 20000))
+        socks = []
+        try:
+            for r in range(n_ranks):
+                s = socket.socket()
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", base + r))
+                socks.append(s)
+            return base
+        except OSError:
+            continue
+        finally:
+            for s in socks:
+                s.close()
+    raise RuntimeError("no free consecutive port range found")
+
+
+class _AlternatingVec:
+    """1-D scalar-tile collection alternating ownership by index."""
+
+    def __init__(self, n: int, nb_ranks: int, my_rank: int,
+                 payload_f32: int):
+        self.n = n
+        self.nb_ranks = nb_ranks
+        self.my_rank = my_rank
+        self.dc_id = 11
+        self.payload_f32 = payload_f32
+        self.v = {}
+        if self.rank_of((0,)) == my_rank:
+            self.v[0] = np.zeros(payload_f32, dtype=np.float32)
+
+    def _k(self, key):
+        return key[0] if isinstance(key, (tuple, list)) else key
+
+    def rank_of(self, key):
+        return self._k(key) % self.nb_ranks
+
+    def data_of(self, key):
+        return self.v[self._k(key)]
+
+    def write_tile(self, key, value):
+        self.v[self._k(key)] = value
+
+
+def _build_chain(hops: int, A):
+    from ..dsl import ptg
+
+    tp = ptg.Taskpool("pingpong", N=hops, A=A)
+    tp.task_class(
+        "HOP", params=("k",),
+        space=lambda g: ((k,) for k in range(g.N)),
+        affinity=lambda g, k: (g.A, (k,)),
+        flows=[ptg.FlowSpec(
+            "T", ptg.RW,
+            ins=[ptg.In(data=lambda g, k: (g.A, (0,)),
+                        guard=lambda g, k: k == 0),
+                 ptg.In(src=("HOP", lambda g, k: (k - 1,), "T"),
+                        guard=lambda g, k: k > 0)],
+            outs=[ptg.Out(dst=("HOP", lambda g, k: (k + 1,), "T"),
+                          guard=lambda g, k: k < g.N - 1),
+                  ptg.Out(data=lambda g, k: (g.A, (g.N - 1,)),
+                          guard=lambda g, k: k == g.N - 1)])])
+
+    hop_times = []
+
+    # batchable=False: the timestamp side effect must run per execution
+    # on the host — a jit-cached body would stamp only at trace time
+    @tp.task_class_by_name("HOP").body(batchable=False)
+    def hop_body(task, T):
+        hop_times.append(time.perf_counter())
+        return T + 1.0
+
+    return tp, hop_times
+
+
+def _rank_main(rank: int, nb_ranks: int, base_port: int, hops: int,
+               payload_f32: int, eager_limit: int, q) -> None:
+    try:
+        from ..comm.socket_engine import SocketCommEngine
+        from ..core import context as ctx_mod
+        from ..utils import mca_param
+
+        mca_param.set("comm.eager_limit", eager_limit)
+        engine = SocketCommEngine(rank, nb_ranks, base_port=base_port)
+        ctx = ctx_mod.init(nb_cores=1, comm=engine)
+        A = _AlternatingVec(hops, nb_ranks, rank, payload_f32)
+        tp, hop_times = _build_chain(hops, A)
+        ctx.add_taskpool(tp)
+        t0 = time.perf_counter()
+        ctx.start()         # enables the comm thread; hop stamps carry
+        ok = ctx.wait(timeout=300)   # the per-hop timing signal
+        t1 = time.perf_counter()
+        engine.sync()
+        ctx.fini()
+        if not ok:
+            raise RuntimeError(f"rank {rank}: pingpong did not terminate")
+        # per-hop latency from consecutive local execution stamps: my
+        # hops run every 2nd step, so consecutive stamps span exactly
+        # one round trip (out + back) = 2 hops
+        stamps = np.asarray(hop_times)
+        rtt = np.diff(stamps)
+        q.put((rank, "ok", {"total_s": t1 - t0,
+                            "hop_us": (rtt / 2 * 1e6).tolist()}))
+    except BaseException as exc:  # noqa: BLE001 — report to parent
+        import traceback
+        q.put((rank, "error", f"{exc}\n{traceback.format_exc()}"))
+
+
+def measure_latency(payload_bytes: int = 1024, hops: int = 200,
+                    eager_limit: int = 256 * 1024,
+                    timeout: float = 300.0) -> Dict:
+    """Spawn 2 ranks, bounce a ``payload_bytes`` array ``hops`` times,
+    return percentile activate→data latencies in microseconds."""
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    base_port = _free_port_base()
+    payload_f32 = max(payload_bytes // 4, 1)
+    procs = [ctx.Process(target=_rank_main,
+                         args=(r, 2, base_port, hops, payload_f32,
+                               eager_limit, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(2):
+            rank, status, payload = q.get(timeout=timeout)
+            if status != "ok":
+                raise RuntimeError(f"rank {rank} failed:\n{payload}")
+            results[rank] = payload
+    finally:
+        for p in procs:
+            p.join(timeout=10.0)
+            if p.is_alive():
+                p.terminate()
+
+    # drop each rank's warmup hops (connection + first-touch costs)
+    # BEFORE concatenating — rank 1's warmup sits mid-array otherwise
+    per_rank = [r["hop_us"][2:] if len(r["hop_us"]) > 4 else r["hop_us"]
+                for r in results.values()]
+    hop_us = np.asarray(sum(per_rank, []))
+    real_bytes = payload_f32 * 4
+    return {
+        "payload_bytes": real_bytes,
+        "path": "eager" if real_bytes <= eager_limit else "rendezvous",
+        "hops": hops,
+        "p50_us": float(np.percentile(hop_us, 50)),
+        "p90_us": float(np.percentile(hop_us, 90)),
+        "p99_us": float(np.percentile(hop_us, 99)),
+        "total_s": max(r["total_s"] for r in results.values()),
+    }

@@ -52,6 +52,8 @@ mca_param.register("comm.wireup_timeout_s", 30.0,
                    help="seconds to wait for the full mesh to connect")
 
 _HDR = struct.Struct("!Q")     # frame length prefix
+_U32 = struct.Struct("!I")     # pickle-section length prefix
+_WAKE_PEER = -1                # selector data tag of the self-pipe
 
 
 class _WaveState:
@@ -102,8 +104,24 @@ class SocketCommEngine(CommEngine):
         # counters live in the base ``stats`` dict (record_msg)
         self._stats = {"frames_sent": 0, "frames_recv": 0, "bytes_sent": 0,
                        "bytes_recv": 0, "gets": 0, "puts": 0}
+        # self-pipe: workers posting commands interrupt the comm thread's
+        # selector block so sends don't wait out the poll timeout (the
+        # reference relies on MPI progress being driven by the same
+        # thread that dequeues — here the selector needs an explicit kick)
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, _WAKE_PEER)
         if nb_ranks > 1:
             self._wireup()
+
+    def _post_cmd(self, cmd: Tuple) -> None:
+        """Enqueue a command for the comm thread and kick its selector."""
+        self._cmd_q.put(cmd)
+        try:
+            self._wake_w.send(b"x")
+        except (BlockingIOError, OSError):
+            pass      # pipe full = wakeup already pending
 
     # ------------------------------------------------------------- wireup
     def _wireup(self) -> None:
@@ -161,6 +179,12 @@ class SocketCommEngine(CommEngine):
     def enable(self) -> None:
         super().enable()
         if self.nb_ranks > 1 and self._thread is None:
+            if self._wake_r.fileno() < 0:     # re-enable after disable()
+                self._wake_r, self._wake_w = socket.socketpair()
+                self._wake_r.setblocking(False)
+                self._wake_w.setblocking(False)
+                self._sel.register(self._wake_r, selectors.EVENT_READ,
+                                   _WAKE_PEER)
             self._stop.clear()
             for peer, s in self._socks.items():
                 self._sel.register(s, selectors.EVENT_READ, peer)
@@ -172,6 +196,10 @@ class SocketCommEngine(CommEngine):
     def disable(self) -> None:
         super().disable()
         self._stop.set()
+        try:
+            self._wake_w.send(b"x")   # kick the selector out of its block
+        except (BlockingIOError, OSError):
+            pass
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
@@ -181,19 +209,41 @@ class SocketCommEngine(CommEngine):
             except OSError:
                 pass
         self._socks.clear()
+        # release the wakeup pair — engines are created per run, and
+        # leaked fd pairs add up in long-lived parents (harness loops)
+        try:
+            self._sel.unregister(self._wake_r)
+        except (KeyError, ValueError):
+            pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
 
     # --------------------------------------------- comm thread (funnelled)
     def _comm_main(self) -> None:
         """remote_dep_dequeue_main analog: the only thread touching
         sockets. Each iteration drains the command queue (with per-peer
         aggregation) then progresses receives."""
+        from ..utils import binding
+        binding.bind_comm_thread()        # remote_dep_bind_thread analog
         while not self._stop.is_set():
             queued = self._drain_commands()
             flushed = self._flush_sends()
-            received = self._progress_recv(
-                0.002 if not (queued or flushed) else 0.0)
-            if not queued and not flushed and not received:
-                time.sleep(0.0005)
+            # the selector IS the idle wait: peers' data and the
+            # command self-pipe both wake it immediately, so a longer
+            # block costs no latency (only bounds _stop polling) —
+            # UNLESS outbound bytes are stuck behind a full kernel
+            # buffer: the selector only watches reads, so keep the
+            # retry cadence short until the tx drains
+            if queued or flushed:
+                block = 0.0
+            elif any(self._txbuf.values()):
+                block = 0.0005
+            else:
+                block = 0.01
+            self._progress_recv(block)
         # drain: flush whatever is still queued so peers aren't cut off
         deadline = time.monotonic() + 2.0
         while time.monotonic() < deadline:
@@ -238,12 +288,30 @@ class SocketCommEngine(CommEngine):
     def _send_frame(self, dst: int, tag: int, msg: Any) -> None:
         """Queue one frame on the peer's outbound buffer (comm thread
         only). Non-blocking sends prevent the head-of-line deadlock of two
-        ranks pushing large frames at each other with full TCP buffers."""
+        ranks pushing large frames at each other with full TCP buffers.
+
+        Wire format (raw-bytes framing for array payloads — the
+        reference's datatype pack path, parsec_comm_engine.h:113-183):
+        ``!Q total_len``, ``!I pickle_len``, the protocol-5 pickle, then
+        each out-of-band buffer as ``!Q len`` + raw bytes. Contiguous
+        numpy array payloads travel as raw memory (one memcpy into the
+        tx buffer) instead of being re-serialized through the pickle
+        stream."""
+        bufs: List[pickle.PickleBuffer] = []
         payload = pickle.dumps((int(tag), self.rank, msg),
-                               protocol=pickle.HIGHEST_PROTOCOL)
-        self._txbuf[dst] += _HDR.pack(len(payload)) + payload
+                               protocol=5, buffer_callback=bufs.append)
+        raws = [b.raw() for b in bufs]
+        total = _U32.size + len(payload) + sum(
+            _HDR.size + r.nbytes for r in raws)
+        out = self._txbuf[dst]
+        out += _HDR.pack(total)
+        out += _U32.pack(len(payload))
+        out += payload
+        for r in raws:
+            out += _HDR.pack(r.nbytes)
+            out += r
         self._stats["frames_sent"] += 1
-        self._stats["bytes_sent"] += _HDR.size + len(payload)
+        self._stats["bytes_sent"] += _HDR.size + total
 
     def _flush_sends(self) -> int:
         """Push queued outbound bytes as far as the kernel accepts."""
@@ -268,6 +336,12 @@ class SocketCommEngine(CommEngine):
         for key, _mask in events:
             peer = key.data
             s = key.fileobj
+            if peer == _WAKE_PEER:
+                try:
+                    s.recv(4096)      # drain wakeup tokens
+                except (BlockingIOError, OSError):
+                    pass
+                continue
             try:
                 chunk = s.recv(1 << 20)
             except BlockingIOError:
@@ -288,9 +362,28 @@ class SocketCommEngine(CommEngine):
                 (ln,) = _HDR.unpack_from(buf, 0)
                 if len(buf) < _HDR.size + ln:
                     break
-                payload = bytes(buf[_HDR.size:_HDR.size + ln])
+                # bytearray: arrays reconstructed over the out-of-band
+                # views must be writable (bodies may update in place)
+                frame = bytearray(buf[_HDR.size:_HDR.size + ln])
                 del buf[:_HDR.size + ln]
-                tag, src, msg = pickle.loads(payload)
+                (plen,) = _U32.unpack_from(frame, 0)
+                off = _U32.size
+                payload = frame[off:off + plen]
+                off += plen
+                # out-of-band buffers: zero-copy views into ``frame`` for
+                # payloads that dominate the frame; smaller ones are
+                # copied out so a retained array doesn't pin an entire
+                # aggregated multi-payload frame in memory
+                views: List[Any] = []
+                while off < len(frame):
+                    (bl,) = _HDR.unpack_from(frame, off)
+                    off += _HDR.size
+                    if 2 * bl >= len(frame):
+                        views.append(memoryview(frame)[off:off + bl])
+                    else:
+                        views.append(bytearray(frame[off:off + bl]))
+                    off += bl
+                tag, src, msg = pickle.loads(payload, buffers=views)
                 self._stats["frames_recv"] += 1
                 self._stats["bytes_recv"] += _HDR.size + ln
                 self._dispatch(tag, src, msg)
@@ -318,18 +411,41 @@ class SocketCommEngine(CommEngine):
             # thread — handler state (waves, barriers, pending gets) is
             # single-threaded by construction, like the funnelled reference
             if self._thread is not None:
-                self._cmd_q.put(("self", tag, msg))
+                self._post_cmd(("self", tag, msg))
             else:
                 self._dispatch(tag, self.rank, msg)
             return
-        self._cmd_q.put(("am", tag, dst_rank, msg))
+        self._post_cmd(("am", tag, dst_rank, msg))
 
     # ----------------------------------------------------------- one-sided
+    @staticmethod
+    def wire_value(value: Any) -> Any:
+        """Snapshot device-resident values (jax.Array) to host numpy at
+        the comm boundary — the calling worker thread pays the D2H sync,
+        not the comm thread, and the wire then ships raw array bytes.
+        (Reference: datatype pack/unpack, parsec_comm_engine.h:113-183.)
+        numpy arrays, scalars and containers pass through."""
+        import numpy as np
+        if value is None or isinstance(
+                value, (bool, int, float, complex, str, bytes, bytearray,
+                        np.ndarray, np.generic)):
+            return value
+        if isinstance(value, tuple):
+            return tuple(SocketCommEngine.wire_value(v) for v in value)
+        if isinstance(value, list):
+            return [SocketCommEngine.wire_value(v) for v in value]
+        if isinstance(value, dict):
+            return {k: SocketCommEngine.wire_value(v)
+                    for k, v in value.items()}
+        if hasattr(value, "__array__"):     # jax.Array et al.
+            return np.asarray(value)
+        return value
+
     def mem_register(self, buffer: Any) -> int:
         with self._mem_lock:
             h = (self.rank << 48) | self._mem_next
             self._mem_next += 1
-            self._mem[h] = buffer
+            self._mem[h] = self.wire_value(buffer)
             return h
 
     def mem_unregister(self, handle: int) -> None:
@@ -370,7 +486,7 @@ class SocketCommEngine(CommEngine):
         msg = {"taskpool": tp.name, "class": ref.task_class.name,
                "locals": tuple(ref.locals), "flow": ref.flow_name,
                "dep_index": ref.dep_index, "priority": ref.priority}
-        value = ref.value
+        value = self.wire_value(ref.value)
         nbytes = self.payload_bytes(value)
         eager_limit = int(mca_param.get("comm.eager_limit", 256 * 1024))
         if value is not None and nbytes > eager_limit:
@@ -379,7 +495,7 @@ class SocketCommEngine(CommEngine):
         else:
             msg["value"] = value
         self.record_msg("sent", "activate", target_rank, nbytes)
-        self._cmd_q.put(("activate", target_rank, msg))
+        self._post_cmd(("activate", target_rank, msg))
         monitor.outgoing_message_end(target_rank)
 
     def install_activate_handler(self, context) -> None:

@@ -252,6 +252,8 @@ class Context:
 
     # --------------------------------------------------------- worker loop
     def _worker_main(self, es: ExecutionStream) -> None:
+        from ..utils import binding
+        binding.bind_worker(es.th_id)     # best-effort (-b analog)
         backoff_min = int(mca_param.get("runtime.backoff_min_us", 50)) / 1e6
         backoff_max = int(mca_param.get("runtime.backoff_max_us", 2000)) / 1e6
         backoff = backoff_min
@@ -272,9 +274,18 @@ class Context:
                 task = self.scheduler.select(es)
             if task is None:
                 es.stats["starved"] += 1
-                time.sleep(backoff)
-                backoff = min(backoff * 2, backoff_max)
-                continue
+                # event-driven wakeup: schedule() sets _work_evt, so a
+                # starved worker parks until new work instead of sleeping
+                # through the latency path (the reference wakes workers
+                # from remote_dep delivery the same way). Clear-then-
+                # reselect avoids the lost-wakeup race; the timeout only
+                # bounds termdet/shutdown polling.
+                self._work_evt.clear()
+                task = self.scheduler.select(es)
+                if task is None:
+                    self._work_evt.wait(timeout=backoff)
+                    backoff = min(backoff * 2, backoff_max)
+                    continue
             backoff = backoff_min
             es.stats["selected"] += 1
             try:

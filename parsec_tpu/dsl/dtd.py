@@ -593,12 +593,18 @@ class Taskpool(CoreTaskpool):
             with tile.lock:
                 holder = tile.holder_rank
             if holder == my_rank and owner != my_rank:
-                # writeback to the owner (parsec_dtd_data_flush)
+                # writeback to the owner (parsec_dtd_data_flush); device
+                # values snapshot to host HERE (worker thread) so the
+                # comm thread never pays a D2H sync mid-progress
+                value = tile.collection.data_of(tile.key)
+                to_wire = getattr(comm, "wire_value", None)
+                if to_wire is not None:
+                    value = to_wire(value)
                 comm.send_am(
                     AMTag.DTD_CONTROL, owner,
                     {"taskpool": self.name, "op": "flush",
                      "dc_id": tile.collection.dc_id, "key": tile.key,
-                     "value": tile.collection.data_of(tile.key),
+                     "value": value,
                      "src": my_rank})
                 sent += 1
         with self._flush_cv:

@@ -1,0 +1,84 @@
+"""Best-effort thread→core binding (parsec_hwloc.c / bindthread.c analog).
+
+The reference binds worker threads to cores from the ``-b`` binding
+specification (parsec_parse_binding_parameter, parsec.c:2313-2519) and
+the comm thread to its own core (remote_dep.c:645,
+remote_dep_bind_thread). Python threads share the GIL, but OS-level
+affinity still matters for the comm thread (keeps it off the cores the
+GIL-released native/XLA work runs on) and for NUMA locality of worker
+stacks. No hwloc in this environment: Linux ``sched_setaffinity`` on the
+calling thread (tid 0) is the whole mechanism, and every call is
+best-effort — failure is recorded, never raised.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+from . import mca_param
+from .debug import debug_verbose
+
+mca_param.register("runtime.bind_workers", 0,
+                   help="bind worker thread i to core binding[i % n] "
+                        "(parsec -b analog; 0 = no binding)")
+mca_param.register("runtime.binding_list", "",
+                   help="comma-separated core list for worker binding "
+                        "(empty = all cores in os order)")
+mca_param.register("comm.bind_core", -1,
+                   help="core to bind the comm thread to "
+                        "(remote_dep_bind_thread analog; -1 = none)")
+
+
+def available_cores() -> Sequence[int]:
+    try:
+        return sorted(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return []
+
+
+def _binding_list() -> Sequence[int]:
+    spec = str(mca_param.get("runtime.binding_list", "") or "")
+    if spec:
+        try:
+            return [int(x) for x in spec.split(",") if x.strip() != ""]
+        except ValueError:
+            debug_verbose(1, "binding", "bad binding list %r", spec)
+    return list(available_cores())
+
+
+def bind_current_thread(core: int) -> bool:
+    """Pin the calling thread to ``core``. Best effort: returns False on
+    any failure (non-Linux, cgroup-restricted, bad core id)."""
+    try:
+        os.sched_setaffinity(0, {int(core)})
+        return True
+    except (AttributeError, OSError, ValueError):
+        return False
+
+
+def bind_worker(th_id: int) -> Optional[int]:
+    """Bind worker ``th_id`` per the MCA binding params. Returns the core
+    bound to, or None when binding is off/unavailable."""
+    if not int(mca_param.get("runtime.bind_workers", 0)):
+        return None
+    cores = _binding_list()
+    if not cores:
+        return None
+    core = cores[th_id % len(cores)]
+    if bind_current_thread(core):
+        debug_verbose(3, "binding", "worker %d bound to core %d",
+                      th_id, core)
+        return core
+    return None
+
+
+def bind_comm_thread() -> Optional[int]:
+    """Bind the calling (comm) thread to ``comm.bind_core``."""
+    core = int(mca_param.get("comm.bind_core", -1))
+    if core < 0:
+        return None
+    if bind_current_thread(core):
+        debug_verbose(3, "binding", "comm thread bound to core %d", core)
+        return core
+    return None

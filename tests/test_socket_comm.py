@@ -219,6 +219,68 @@ def scenario_potrf(ctx, engine, rank, nb_ranks, n=192, nb=32):
     return len(list(A.local_keys()))
 
 
+def scenario_jax_values(ctx, engine, rank, nb_ranks, n=4096):
+    """Bodies produce device-resident jax.Arrays that cross rank
+    boundaries: the engine must snapshot them to host numpy at the comm
+    boundary (wire_value) on both the eager and rendezvous paths without
+    hanging on a surprise sync. Reference capability: datatype
+    pack/unpack of device buffers (parsec_comm_engine.h:113-183)."""
+    import jax.numpy as jnp
+    from parsec_tpu.dsl import ptg
+    from parsec_tpu.utils import mca_param
+    mca_param.set("comm.eager_limit", 1024)   # n floats >> 1 KiB → rdv
+
+    A = _DistVec(3, nb_ranks, rank)
+    tp = ptg.Taskpool("jaxval", A=A, N=n)
+    tp.task_class(
+        "SRC", params=("k",),
+        space=lambda g: ((0,),),
+        affinity=lambda g, k: (g.A, (0,)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, k: (g.A, (0,)))],
+            outs=[ptg.Out(dst=("MID", lambda g, k: (0,), "X"))])])
+    tp.task_class(
+        "MID", params=("k",),
+        space=lambda g: ((0,),),
+        affinity=lambda g, k: (g.A, (1,)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(src=("SRC", lambda g, k: (0,), "X"))],
+            outs=[ptg.Out(dst=("DST", lambda g, k: (0,), "X"))])])
+    tp.task_class(
+        "DST", params=("k",),
+        space=lambda g: ((0,),),
+        affinity=lambda g, k: (g.A, (2,)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(src=("MID", lambda g, k: (0,), "X"))],
+            outs=[ptg.Out(data=lambda g, k: (g.A, (2,)))])])
+
+    @tp.task_class_by_name("SRC").body(batchable=False)
+    def src_body(task, X):
+        # rendezvous-sized DEVICE array leaves this rank
+        return jnp.full((n,), 2.0, dtype=jnp.float32)
+
+    @tp.task_class_by_name("MID").body(batchable=False)
+    def mid_body(task, X):
+        assert isinstance(X, np.ndarray), type(X)   # host numpy on arrival
+        # eager-sized device scalar result (below the eager limit)
+        return jnp.sum(X[:64])
+
+    @tp.task_class_by_name("DST").body(batchable=False)
+    def dst_body(task, X):
+        assert isinstance(X, (np.ndarray, np.generic, float)), type(X)
+        return np.float32(X)
+
+    ctx.add_taskpool(tp)
+    ctx.start()
+    assert ctx.wait(timeout=60), f"rank {rank}: jaxval did not terminate"
+    if A.rank_of((2,)) == rank:
+        assert float(A.v[2]) == 128.0, A.v
+    return engine.wire_stats()["frames_sent"]
+
+
 # ----------------------------------------------------------------- tests
 
 def test_chain_2ranks():
@@ -237,3 +299,11 @@ def test_rendezvous_2ranks():
 
 def test_potrf_2ranks():
     _run_ranks("scenario_potrf", 2)
+
+
+def test_jax_values_2ranks():
+    _run_ranks("scenario_jax_values", 2)
+
+
+def test_jax_values_3ranks():
+    _run_ranks("scenario_jax_values", 3)

@@ -163,3 +163,30 @@ def test_zone_alloc_errors():
         z.malloc(0)
     with pytest.raises(ValueError):
         ZoneAllocator(0)
+
+
+def test_thread_binding_best_effort():
+    """binding.py: bind/unbind is best-effort and reversible; bad cores
+    and disabled params return None/False instead of raising."""
+    import threading
+    from parsec_tpu.utils import binding, mca_param
+
+    cores = binding.available_cores()
+    assert cores, "sched_getaffinity should work on linux"
+    assert binding.bind_worker(0) is None          # disabled by default
+    assert binding.bind_comm_thread() is None      # disabled by default
+    assert binding.bind_current_thread(10 ** 6) is False
+
+    mca_param.set("runtime.bind_workers", 1)
+    try:
+        got = {}
+
+        def run():
+            got["core"] = binding.bind_worker(3)
+
+        t = threading.Thread(target=run)
+        t.start()
+        t.join()
+        assert got["core"] == cores[3 % len(cores)]
+    finally:
+        mca_param.set("runtime.bind_workers", 0)
