@@ -5,16 +5,31 @@ Matches BASELINE.md's target metric: "tiled POTRF/GEMM GFLOP/s per chip,
 >=65% of chip peak". Since the reference publishes no absolute numbers
 (BASELINE.md: "published: {}"), the baseline denominator is measured on
 the same chip: peak-proxy GEMM throughput (chained large matmuls at the
-same dtype/precision). vs_baseline = potrf_gflops /
-(0.65 * peak_proxy_gflops) — i.e. >= 1.0 means the north-star
-65%-of-peak target is met.
+same dtype/precision — method unchanged from round 1). vs_baseline =
+potrf_gflops / (0.65 * peak_proxy_gflops) — i.e. >= 1.0 means the
+north-star 65%-of-peak target is met.
+
+Flagship path: the left-looking POTRF taskpool (build_potrf_left —
+CTL-gather UPDATE fan-in) lowered by the panel-fused executor
+(compiled.panels) onto Aᵀ-dense storage; planning/leveling/hazard checks
+come from the standard wavefront planner. N=40960, NB=1024 — chosen so
+the matrix (+donated output) fits v5e HBM with the update matmuls deep
+enough to bury the serial diagonal-factorization cost.
+
+Also emitted in ``detail``:
+- ``latency``: remote_dep p50/p90 activate→data latency over the socket
+  comm engine (2-rank pingpong, eager + rendezvous) — BASELINE.md's
+  second metric.
+- ``rel_residual_check``: random-probe residual ‖(LLᵀ−A)x‖/‖Ax‖
+  computed on device block-wise (a dense residual at N=40960 would not
+  fit HBM). Matmuls run at the TPU-native default precision (single-pass
+  bf16 on the MXU) — same knob as round 1; set
+  PARSEC_MCA_ops_matmul_precision=highest for f32-exact kernels.
 
 Measurement notes (axon-tunnel backend): ``block_until_ready`` does NOT
-block for remote executions and bulk array fetches cost seconds, so all
-forcing is done with device-side scalar reductions and the per-call link
-roundtrip latency is measured and subtracted. The SPD input is generated
-ON DEVICE (shipping a 1 GiB matrix through the link would dominate the
-run) and the full-matrix residual is computed on device too.
+block for remote executions and bulk fetches cost seconds, so forcing is
+done with device-side scalar reductions; the per-call link roundtrip
+latency is sampled immediately before each timed run and subtracted.
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "GFLOP/s", "vs_baseline": N, ...}
@@ -36,12 +51,18 @@ if _plat:
     jax.config.update("jax_platforms", _plat)
 
 
+def _timed(f):
+    t0 = time.perf_counter()
+    f()
+    return time.perf_counter() - t0
+
+
 def _measure_peak_gemm(jnp, jax, n=8192, dtype="float32", iters=64,
                        latency_s=0.0):
     """Large square matmul GFLOP/s — the chip-peak proxy at this dtype.
     K chained matmuls inside one jitted call reduced to a scalar: forces
     real execution on remote backends and amortizes the link roundtrip
-    (subtracted via ``latency_s``)."""
+    (subtracted via ``latency_s``). Method identical to round 1."""
     a = jnp.ones((n, n), dtype=dtype)
     b = jnp.ones((n, n), dtype=dtype)
 
@@ -52,10 +73,30 @@ def _measure_peak_gemm(jnp, jax, n=8192, dtype="float32", iters=64,
 
     f = jax.jit(chain)
     float(f(a, b))                                   # compile + warm
-    t0 = time.perf_counter()
-    float(f(a, b))
-    dt = max(time.perf_counter() - t0 - latency_s, 1e-9) / iters
-    return 2.0 * n ** 3 / dt / 1e9
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(f(a, b))
+        ts.append(max(time.perf_counter() - t0 - latency_s, 1e-9) / iters)
+    return 2.0 * n ** 3 / sorted(ts)[1] / 1e9
+
+
+def _measure_latency():
+    """BASELINE's second metric: p50 activate→data latency over the
+    socket comm engine, eager + rendezvous paths."""
+    from parsec_tpu.comm.pingpong import measure_latency
+    out = {}
+    try:
+        r = measure_latency(payload_bytes=1024, hops=200)
+        out["eager_1k_p50_us"] = round(r["p50_us"], 1)
+        out["eager_1k_p90_us"] = round(r["p90_us"], 1)
+        r = measure_latency(payload_bytes=1 << 20, hops=60,
+                            eager_limit=64 * 1024)
+        out["rdv_1M_p50_us"] = round(r["p50_us"], 1)
+        out["rdv_1M_p90_us"] = round(r["p90_us"], 1)
+    except Exception as exc:  # noqa: BLE001 — never sink the main metric
+        out["error"] = str(exc)[:200]
+    return out
 
 
 def main():
@@ -63,93 +104,126 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    from parsec_tpu.algorithms.potrf import build_potrf, potrf_flops
-    from parsec_tpu.compiled.wavefront import WavefrontExecutor, plan_taskpool
+    from parsec_tpu.algorithms.potrf import build_potrf_left, potrf_flops
+    from parsec_tpu.compiled.panels import PanelExecutor
+    from parsec_tpu.compiled.wavefront import plan_taskpool
     from parsec_tpu.data.matrix import TiledMatrix
 
     backend = jax.default_backend()
-    # Chip-sized problem on TPU; small on the CPU fallback path.
     if backend == "tpu":
-        N, NB = 16384, 2048     # best measured tiling for the tile-dict
-                                # executor on this chip class
+        N, NB = 40960, 1024
     else:
         N, NB = 1024, 128
+    N = int(os.environ.get("PARSEC_BENCH_N", N))
+    NB = int(os.environ.get("PARSEC_BENCH_NB", NB))
     NT = N // NB
 
     # Plan over an empty TiledMatrix — the planner only needs the tile
-    # grid (tiles materialize lazily); the actual data is generated on
-    # device below.
+    # grid; data is generated on device in the executor's Aᵀ layout.
     A = TiledMatrix(N, N, NB, NB, name="A")
-    tp = build_potrf(A)
+    tp = build_potrf_left(A)
+    t0 = time.perf_counter()
     plan = plan_taskpool(tp)
-    ex = WavefrontExecutor(plan)
-    slot_map = plan.slot_maps["A"]
+    ex = PanelExecutor(plan)
+    plan_s = time.perf_counter() - t0
 
-    def make_tiles_device(key):
-        """Diagonally-dominant SPD matrix as a tile dict, entirely on
-        device (the tile-dict executor form: per-wave work touches only
-        its tiles — no full-store copies)."""
-        R = jax.random.normal(key, (N, N), dtype=jnp.float32)
-        M = 0.5 * (R + R.T) + 2.0 * N * jnp.eye(N, dtype=jnp.float32)
-        t = M.reshape(NT, NB, NT, NB).transpose(0, 2, 1, 3)
-        return {("A", slot_map[(i, j)]): t[i, j]
-                for i in range(NT) for j in range(NT)}
+    def gen_row(key, i):
+        """Block-row i of the Aᵀ-dense SPD input, generated on device
+        from a per-row key. Row-parametric so the residual check can
+        regenerate one 192 MB row at a time instead of holding a second
+        N×N copy next to the factor (which OOMs the v5e)."""
+        Ri = jax.random.normal(jax.random.fold_in(key, i), (NB, N),
+                               dtype=jnp.float32)
+        return Ri.at[:, i * NB:(i + 1) * NB].add(
+            2.0 * N * jnp.eye(NB, dtype=jnp.float32))
 
-    tiles = jax.jit(make_tiles_device)(jax.random.PRNGKey(0))
-    jax.block_until_ready(tiles)
+    def gen_state(key):
+        """Diagonally-dominant SPD matrix, Aᵀ-dense, entirely on device.
+        Only the upper triangle of D (= lower of A) plus the averaged
+        diagonal blocks are read by the DAG — the fuser symmetrizes
+        diag blocks 0.5·(B+Bᵀ) at their point of use, and the residual
+        check models exactly that matrix."""
+        return {"D": jnp.concatenate(
+            [gen_row(key, i) for i in range(NT)], axis=0)}
 
-    # link roundtrip latency: drifts on minute scales, so it is sampled
-    # IMMEDIATELY BEFORE each timed run and subtracted pairwise
+    gen_j = jax.jit(gen_state)
+
+    def run(state):
+        out = ex.run_state(state)
+        return jnp.sum(out["D"]), out
+
+    red = jax.jit(run, donate_argnums=0)
+
     lat_f = jax.jit(lambda x: x + 1.0)
     float(lat_f(jnp.float32(0)))
 
-    # ONE compile of the DAG program. It returns (total, out_tiles):
-    # fetching only the scalar forces full execution (the sum covers
-    # every result tile, so no task is dead-code-eliminated) while the
-    # tiles stay on device for the residual check below — no second
-    # whole-DAG compile.
-    def potrf_run(ts):
-        out = ex.run_tile_dict(ts)
-        total = jnp.float32(0)
-        for v in out.values():
-            total = total + jnp.sum(v)
-        return total, out
-
-    red = jax.jit(potrf_run)
     t0 = time.perf_counter()
-    total, out_tiles = red(tiles)
-    float(total)
+    tot, out = red(gen_j(jax.random.PRNGKey(0)))
+    float(tot)
     compile_s = time.perf_counter() - t0
+    del out
 
     iters = 5
     samples, lats = [], []
     for i in range(iters):
+        state = gen_j(jax.random.PRNGKey(0))
+        jax.block_until_ready(state)
         lat_i = _timed(lambda i=i: float(lat_f(jnp.float32(i))))
         t0 = time.perf_counter()
-        total, out_tiles = red(tiles)
-        float(total)
+        tot, out = red(state)
+        float(tot)
         samples.append(max(time.perf_counter() - t0 - lat_i, 1e-6))
         lats.append(lat_i)
+        if i < iters - 1:
+            del out          # keep HBM headroom for the next gen
     dt = sorted(samples)[iters // 2]
     lat = sorted(lats)[iters // 2]
-
     gflops = potrf_flops(N) / dt / 1e9
 
-    # Correctness: full-matrix relative residual ||tril(L)·tril(L)ᵀ − A||
-    # on device over the already-computed result tiles; only the scalar
-    # crosses the link (assemble+norm only — no DAG re-trace).
-    def residual(out, ts0):
-        def assemble(d):
-            rows = [jnp.concatenate([d[("A", slot_map[(i, j)])]
-                                     for j in range(NT)], axis=1)
-                    for i in range(NT)]
-            return jnp.concatenate(rows, axis=0)
+    # Correctness: random-probe residual ‖(LLᵀ−A₀)x‖/‖A₀x‖ over the
+    # final factor, where A₀ is EXACTLY the matrix the DAG factors:
+    # strict-lower blocks read from the stored triangle (upper of D),
+    # diagonal blocks symmetrized 0.5·(B+Bᵀ) as the fuser does. Computed
+    # block-row-wise — no N×N temporaries (a dense triu/mirror at
+    # N=40960 would add ~19 GiB and OOM the v5e right after the timed
+    # runs). Only the scalar crosses the link.
+    def residual(out, key):
+        Lt = out["D"]                   # Lᵀ in the upper block triangle
+        s = 8
+        x = jax.random.normal(jax.random.fold_in(key, NT + 1), (N, s),
+                              jnp.float32)
 
-        L = jnp.tril(assemble(out))
-        A0 = assemble(ts0)
-        return jnp.linalg.norm(L @ L.T - A0) / jnp.linalg.norm(A0)
+        def blk(i):
+            return slice(i * NB, (i + 1) * NB)
 
-    err = float(jax.jit(residual)(out_tiles, tiles))
+        # y = A0 @ x, accumulated per regenerated block-row j of D0
+        # (same values as the timed input, one row at a time — a full
+        # second N×N copy next to the factor would OOM the chip): diag
+        # averaged, strict-lower blocks Dj[:, i>j]ᵀ plus their
+        # mirrored-upper contribution
+        y = jnp.zeros((N, s), jnp.float32)
+        for j in range(NT):
+            Dj = gen_row(key, j)
+            d = Dj[:, blk(j)]
+            yj = 0.5 * (d + d.T) @ x[blk(j)]
+            if j < NT - 1:
+                tail = Dj[:, (j + 1) * NB:]
+                yj = yj + tail @ x[(j + 1) * NB:]
+                y = y.at[(j + 1) * NB:].add(tail.T @ x[blk(j)])
+            y = y.at[blk(j)].add(yj)
+
+        # z = Lᵀ x ; y2 = L z — Lt's diag blocks are exactly upper-
+        # triangular (chol zeroes the strict lower), and only the upper
+        # block triangle of Lt is ever read
+        zs = [Lt[blk(j), j * NB:] @ x[j * NB:] for j in range(NT)]
+        z = jnp.concatenate(zs, axis=0)
+        y2 = jnp.concatenate(
+            [Lt[0:(i + 1) * NB, blk(i)].T @ z[0:(i + 1) * NB]
+             for i in range(NT)], axis=0)
+        return jnp.linalg.norm(y2 - y) / jnp.linalg.norm(y)
+
+    err = float(jax.jit(residual)(out, jax.random.PRNGKey(0)))
+    del out
 
     # latency drifts on minute scales: re-sample immediately before the
     # peak-proxy timed run rather than reusing the POTRF-loop median
@@ -163,6 +237,8 @@ def main():
                                         dtype="float32", latency_s=lat_peak)
     target = 0.65 * peak_proxy
 
+    latency = _measure_latency()
+
     print(json.dumps({
         "metric": "tiled_potrf_gflops_per_chip",
         "value": round(gflops, 2),
@@ -171,21 +247,17 @@ def main():
         "detail": {
             "backend": backend, "n": N, "tile": NB,
             "n_tasks": plan.n_tasks, "n_waves": plan.n_waves,
+            "taskpool": tp.name, "executor": "panel_fused",
             "peak_proxy_gemm_gflops": round(peak_proxy, 2),
             "target_gflops_65pct_peak": round(target, 2),
+            "plan_s": round(plan_s, 2),
             "compile_s": round(compile_s, 2),
             "run_s": round(dt, 4),
             "link_latency_s": round(lat, 4),
-            "executor": "tile_dict",
             "rel_residual_check": float(f"{err:.3e}"),
+            "latency": latency,
         },
     }))
-
-
-def _timed(f):
-    t0 = time.perf_counter()
-    f()
-    return time.perf_counter() - t0
 
 
 if __name__ == "__main__":

@@ -175,9 +175,357 @@ def build_potrf(A: TiledMatrix) -> ptg.Taskpool:
     def gemm_body(task, A_, B_, C):
         return gemm_tile(C, A_, B_, alpha=-1.0, beta=1.0, tb=True)
 
+    tp.wave_fuser = _potrf_wave_fuser
     return tp
+
+
+def _fuser_helpers(geom):
+    import jax.numpy as jnp
+    from ..ops.tile_kernels import matmul_precision
+
+    prec = matmul_precision()
+
+    def mm(a, b):
+        return jnp.matmul(a, b, preferred_element_type=jnp.float32,
+                          precision=prec)
+
+    def tile_chol(blk):
+        if mca_param.get("potrf.blocked_tile_chol", 1):
+            return potrf_tile_blocked(blk)
+        return potrf_tile(blk)
+
+    return jnp, mm, tile_chol
+
+
+def _potrf_wave_fuser(wave, geom):
+    """Lower one right-looking POTRF wave to Aᵀ-dense ops
+    (compiled.panels contract).
+
+    ASAP leveling makes every wave one of three shapes per step k —
+    [POTRF(k)], [TRSM(·,k)], [SYRK(·,k) (+GEMM(·,·,k))]. In the
+    transposed store, block-column panels of A are leading-dim row
+    slices, so the TRSM panel solve and every trailing strip are
+    contiguous reads/writes. The shapes are verified from the actual
+    task lists (never wave-index arithmetic); unrecognized waves return
+    None.
+    """
+    jnp, mm, tile_chol = _fuser_helpers(geom)
+    names = sorted(g.tc.name for g in wave)
+    mb, nb = geom.mb, geom.nb
+
+    if names == ["POTRF"]:
+        (grp,) = wave
+        if len(grp.tasks) != 1:
+            return None
+        (k,) = grp.tasks[0]
+
+        def do_potrf(st, k=k):
+            D = st["D"]
+            r, c = geom.rows(k), geom.cols(k)
+            # diag tile of Aᵀ = (A[k,k])ᵀ, symmetric → chol directly;
+            # store Lᵀ (upper) back
+            st["D"] = D.at[c, r].set(tile_chol(D[c, r]).T)
+            return st
+
+        return do_potrf
+
+    if names == ["TRSM"]:
+        (grp,) = wave
+        ks = {t[1] for t in grp.tasks}
+        if len(ks) != 1:
+            return None
+        k = ks.pop()
+        ms = sorted(t[0] for t in grp.tasks)
+        if ms != list(range(ms[0], ms[0] + len(ms))):
+            return None        # rows must be one contiguous panel
+
+        def do_trsm(st, k=k, lo=ms[0], hi=ms[-1] + 1):
+            from ..ops.tile_kernels import tri_inv_tile
+            D = st["D"]
+            c = geom.cols(k)
+            # Lᵀ[k,k] stored upper → recover L, invert once per wave
+            inv = tri_inv_tile(D[c, geom.rows(k)].T)
+            # C ← C·L⁻ᵀ transposed: Cᵀ ← L⁻¹·Cᵀ, one contiguous row panel
+            st["D"] = D.at[c, lo * mb:hi * mb].set(
+                mm(inv, D[c, lo * mb:hi * mb]))
+            return st
+
+        return do_trsm
+
+    if names in (["SYRK"], ["GEMM", "SYRK"]):
+        syrk = next(g for g in wave if g.tc.name == "SYRK")
+        ks = {t[1] for t in syrk.tasks}
+        gemm = next((g for g in wave if g.tc.name == "GEMM"), None)
+        if gemm is not None:
+            ks |= {t[2] for t in gemm.tasks}
+        if len(ks) != 1:
+            return None
+        k = ks.pop()
+        rows = sorted(t[0] for t in syrk.tasks)
+        lo, hi = rows[0], rows[-1] + 1
+        if rows != list(range(lo, hi)):
+            return None
+        want = {(m, n) for m in range(lo, hi) for n in range(lo, m)}
+        have = {(m, n) for (m, n, _k) in (gemm.tasks if gemm else [])}
+        if want != have:
+            return None        # trailing block-triangle must be complete
+
+        def do_trailing(st, k=k, lo=lo, hi=hi):
+            # strip j updates A[j.., j] — in Aᵀ: row panel j, trailing
+            # columns; SYRK (diag tile) + GEMM (below) together, never
+            # touching strictly-upper tiles
+            D = st["D"]
+            Pt = D[geom.cols(k), lo * mb:hi * mb]     # (nb, R) = panelᵀ
+            for j in range(lo, hi):
+                pj = Pt[:, (j - lo) * mb:(j - lo + 1) * mb]
+                old = D[geom.cols(j), j * mb:hi * mb]
+                D = D.at[geom.cols(j), j * mb:hi * mb].set(
+                    old - mm(pj.T, Pt[:, (j - lo) * mb:]))
+            st["D"] = D
+            return st
+
+        return do_trailing
+
+    return None
 
 
 def potrf_flops(n: int) -> float:
     """Useful FLOPs of an n×n Cholesky (LAPACK count)."""
     return n ** 3 / 3.0 + n ** 2 / 2.0 + n / 6.0
+
+
+def build_potrf_left(A: TiledMatrix) -> ptg.Taskpool:
+    """Left-looking tiled Cholesky (LAPACK-style blocked ``potrf``).
+
+    The right-looking :func:`build_potrf` spreads a tile's updates over
+    k-indexed SYRK/GEMM chains; this variant concentrates them: each
+    tile receives ALL its k<j contributions in a single ``UPDATE`` task
+    that CTL-gathers its producer TRSMs (the reference's CTL-gather
+    fan-in, tests/dsl/ptg/controlgather/ctlgat.jdf) and reads their
+    written-back tiles from the collection inside the body — the same
+    direct-memory pattern reference JDF bodies use for gathered
+    operands. ASAP leveling then yields exactly three waves per step k
+    ([UPDATE(·,k)], [POTRF(k)], [TRSM(·,k)]), and the panel fuser turns
+    each UPDATE wave into ONE dense matmul over all previously factored
+    panels — the MXU-optimal schedule (measured ~98-106 TF/s/chip vs
+    ~68 for the fused right-looking form at N=32768-40960).
+
+    Single-process taskpool: UPDATE bodies read sibling tiles straight
+    from the collection, which owner-computes distribution does not
+    provide across ranks — use :func:`build_potrf` for distributed runs.
+    """
+    NT = A.nt
+    if A.mt != A.nt:
+        raise ValueError("POTRF needs a square tile grid")
+    if getattr(A, "dist", None) is not None and \
+            getattr(A.dist, "nb_ranks", 1) > 1:
+        raise ValueError("build_potrf_left is single-process; use "
+                         "build_potrf for distributed runs")
+    tp = ptg.Taskpool("potrf_left", A=A, NT=NT)
+
+    def _gathered(g, m, k):
+        """Producer TRSMs whose tiles UPDATE(m, k) reads: row m and
+        row k, all columns j < k."""
+        seen = []
+        for row in (m, k):
+            for j in range(k):
+                if (row, j) not in seen:
+                    seen.append((row, j))
+        return seen
+
+    UPDATE = tp.task_class(
+        "UPDATE", params=("m", "k"),
+        space=lambda g: ((m, k) for k in range(1, g.NT)
+                         for m in range(k, g.NT)),
+        affinity=lambda g, m, k: (g.A, (m, k)),
+        priority=lambda g, m, k: 2 * (g.NT - k) ** 2 - m + 1,
+        flows=[
+            ptg.FlowSpec(
+                "G", ptg.CTL,
+                ins=[ptg.In(src=("TRSM", _gathered, "G"), gather=True)]),
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                tile=lambda g, m, k: (g.A, (m, k)),
+                ins=[ptg.In(data=lambda g, m, k: (g.A, (m, k)))],
+                outs=[ptg.Out(dst=("POTRF", lambda g, m, k: (k,), "T"),
+                              guard=lambda g, m, k: m == k),
+                      ptg.Out(dst=("TRSM", lambda g, m, k: (m, k), "C"),
+                              guard=lambda g, m, k: m > k)])])
+
+    POTRF = tp.task_class(
+        "POTRF", params=("k",),
+        space=lambda g: ((k,) for k in range(g.NT)),
+        affinity=lambda g, k: (g.A, (k, k)),
+        priority=lambda g, k: 3 * (g.NT - k) ** 2,
+        flows=[ptg.FlowSpec(
+            "T", ptg.RW,
+            tile=lambda g, k: (g.A, (k, k)),
+            ins=[ptg.In(data=lambda g, k: (g.A, (k, k)),
+                        guard=lambda g, k: k == 0),
+                 ptg.In(src=("UPDATE", lambda g, k: (k, k), "C"),
+                        guard=lambda g, k: k > 0)],
+            outs=[ptg.Out(dst=("TRSM",
+                               lambda g, k: [(m, k)
+                                             for m in range(k + 1, g.NT)],
+                               "L")),
+                  ptg.Out(data=lambda g, k: (g.A, (k, k)))])])
+
+    TRSM = tp.task_class(
+        "TRSM", params=("m", "k"),
+        space=lambda g: ((m, k) for k in range(g.NT)
+                         for m in range(k + 1, g.NT)),
+        affinity=lambda g, m, k: (g.A, (m, k)),
+        priority=lambda g, m, k: 2 * (g.NT - k) ** 2 - m,
+        flows=[
+            ptg.FlowSpec(
+                "L", ptg.READ,
+                tile=lambda g, m, k: (g.A, (k, k)),
+                ins=[ptg.In(src=("POTRF", lambda g, m, k: (k,), "T"))]),
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                tile=lambda g, m, k: (g.A, (m, k)),
+                ins=[ptg.In(data=lambda g, m, k: (g.A, (m, k)),
+                            guard=lambda g, m, k: k == 0),
+                     ptg.In(src=("UPDATE", lambda g, m, k: (m, k), "C"),
+                            guard=lambda g, m, k: k > 0)],
+                outs=[ptg.Out(data=lambda g, m, k: (g.A, (m, k)))]),
+            ptg.FlowSpec(
+                "G", ptg.CTL,
+                outs=[ptg.Out(
+                    dst=("UPDATE",
+                         lambda g, m, k: sorted(
+                             {(m, kk) for kk in range(k + 1, m + 1)} |
+                             {(m2, m) for m2 in range(m, g.NT)}),
+                         "G"))])])
+
+    # the CTL-gather contract guarantees every gathered TRSM has written
+    # its tile back before the UPDATE body runs, so direct collection
+    # reads are safe (single process)
+    @UPDATE.body(batchable=False)
+    def update_body(task, C):
+        import numpy as np
+        g = task.taskpool.g
+        m, k = task.locals
+        acc = np.asarray(C, dtype=np.float32).copy()
+        for j in range(k):
+            Lm = np.asarray(g.A.data_of((m, j)), dtype=np.float32)
+            Lk = np.asarray(g.A.data_of((k, j)), dtype=np.float32)
+            acc -= Lm @ Lk.T
+        return acc.astype(np.asarray(C).dtype)
+
+    @POTRF.body
+    def potrf_body(task, T):
+        return potrf_tile(T)
+
+    @TRSM.body(batchable=False)
+    def trsm_body(task, L, C):
+        return {"C": trsm_tile(C, L)}
+
+    tp.wave_fuser = _potrf_left_wave_fuser
+    tp.requires_fuser = True     # compiled per-tile executors can't feed
+    #                              the UPDATE body's collection reads
+    return tp
+
+
+def _potrf_left_wave_fuser(wave, geom):
+    """Lower one left-looking POTRF wave to Aᵀ-dense ops.
+
+    Wave shapes per step k: [UPDATE(·,k)] → one matmul applying every
+    prior panel's contribution to block-column k; [POTRF(k)] → diagonal
+    chol (inverse stashed in the carry); [TRSM(·,k)] → one panel solve
+    via the stashed inverse."""
+    jnp, mm, tile_chol = _fuser_helpers(geom)
+    names = sorted(g.tc.name for g in wave)
+    mb, nb = geom.mb, geom.nb
+
+    if names == ["UPDATE"]:
+        (grp,) = wave
+        ks = {t[1] for t in grp.tasks}
+        if len(ks) != 1:
+            return None
+        k = ks.pop()
+        ms = sorted(t[0] for t in grp.tasks)
+        lo, hi = ms[0], ms[-1] + 1
+        if ms != list(range(lo, hi)) or lo != k:
+            return None
+
+        def do_update(st, k=k, hi=hi):
+            # carry the updated row panel to the POTRF/TRSM waves of
+            # this step instead of writing it to D — the step's panel is
+            # written exactly ONCE (by do_trsm / do_potrf), halving the
+            # DUS traffic and HBM liveness vs a write-per-wave lowering
+            D = st["D"]
+            r0, r1 = k * nb, (k + 1) * nb
+            # Aᵀ[k-row, k..hi) −= (Lᵀ[:k, k])ᵀ · Lᵀ[:k, k..hi)
+            U = D[0:r0, r0:r1]
+            S = D[0:r0, r0:hi * mb]
+            st["rowk"] = D[r0:r1, r0:hi * mb] - mm(U.T, S)
+            return st
+
+        return do_update
+
+    if names == ["POTRF"]:
+        (grp,) = wave
+        if len(grp.tasks) != 1:
+            return None
+        (k,) = grp.tasks[0]
+
+        def do_potrf(st, k=k, last=(k == geom.nt - 1)):
+            from ..ops.tile_kernels import tri_inv_tile
+            D = st["D"]
+            c, r = geom.cols(k), geom.rows(k)
+            rowk = st.pop("rowk", None)
+            diag = rowk[:, :nb] if rowk is not None else D[c, r]
+            # symmetrize (identity for symmetric input; elementwise triu
+            # masking here measurably breaks XLA's in-place scheduling —
+            # the average form fuses cleanly)
+            diag = 0.5 * (diag + diag.T)
+            L = tile_chol(diag)
+            st["potrf_inv"] = tri_inv_tile(L)
+            if last:
+                # no TRSM wave follows: this step's single write is ours
+                st["D"] = D.at[c, r].set(L.T)
+            else:
+                # defer the write — the TRSM wave writes the whole row
+                # panel (Lᵀ diag + solved rest) as ONE contiguous DUS;
+                # split writes double the panel's HBM liveness
+                st["potrf_L"] = L
+                if rowk is not None:
+                    st["rowk_rest"] = rowk[:, nb:]
+            return st
+
+        return do_potrf
+
+    if names == ["TRSM"]:
+        (grp,) = wave
+        ks = {t[1] for t in grp.tasks}
+        if len(ks) != 1:
+            return None
+        k = ks.pop()
+        ms = sorted(t[0] for t in grp.tasks)
+        if ms != list(range(ms[0], ms[0] + len(ms))):
+            return None
+
+        def do_trsm(st, k=k, lo=ms[0], hi=ms[-1] + 1):
+            from ..ops.tile_kernels import tri_inv_tile
+            D = st["D"]
+            c = geom.cols(k)
+            inv = st.pop("potrf_inv", None)
+            L = st.pop("potrf_L", None)
+            if inv is None:      # robustness: recompute from the factor
+                inv = tri_inv_tile(D[c, geom.rows(k)].T)
+            rest = st.pop("rowk_rest", None)
+            if rest is None:     # k = 0: no UPDATE wave preceded
+                rest = D[c, lo * mb:hi * mb]
+            solved = mm(inv, rest)
+            if L is not None and lo == k + 1:
+                # one contiguous row-panel write: Lᵀ diag + solved rest
+                st["D"] = D.at[c, k * mb:hi * mb].set(
+                    jnp.concatenate([L.T, solved], axis=1))
+            else:
+                st["D"] = D.at[c, lo * mb:hi * mb].set(solved)
+            return st
+
+        return do_trsm
+
+    return None

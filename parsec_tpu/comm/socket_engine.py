@@ -204,6 +204,13 @@ class SocketCommEngine(CommEngine):
             self._thread.join(timeout=5.0)
             self._thread = None
         for s in self._socks.values():
+            # unregister BEFORE closing: a stale selector entry whose fd
+            # number gets reused by a later socket would break re-enable
+            # (register raises) or misattribute readiness events
+            try:
+                self._sel.unregister(s)
+            except (KeyError, ValueError):
+                pass
             try:
                 s.close()
             except OSError:

@@ -254,6 +254,12 @@ class WavefrontExecutor:
                  device_type: DeviceType = DeviceType.TPU):
         import jax
         import jax.numpy as jnp
+        if getattr(plan.taskpool, "requires_fuser", False):
+            raise ValueError(
+                f"taskpool {plan.taskpool.name!r} has bodies that read "
+                "the collection directly (CTL-gather pattern); per-tile "
+                "compiled execution cannot feed them — use the "
+                "PanelExecutor (compiled.panels) or the host runtime")
         self.jax, self.jnp = jax, jnp
         self.plan = plan
         self.bucket = bucket

@@ -1,0 +1,144 @@
+"""Panel-fused executor (compiled/panels.py): wavefront plans lowered to
+dense-array panel ops. Correctness vs LAPACK and vs the tile-dict
+executor, write-set preservation, and rejection diagnostics."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.algorithms.potrf import build_potrf
+from parsec_tpu.compiled.panels import PanelExecutor, PanelGeometry
+from parsec_tpu.compiled.wavefront import WavefrontExecutor, plan_taskpool
+from parsec_tpu.data.matrix import TiledMatrix
+
+
+def _spd(n, seed=0):
+    rng = np.random.default_rng(seed)
+    M = rng.standard_normal((n, n))
+    return (M @ M.T + n * np.eye(n)).astype(np.float32)
+
+
+@pytest.mark.parametrize("n,nb", [(256, 64), (256, 128), (192, 64),
+                                  (128, 128)])
+def test_panel_potrf_matches_lapack(n, nb):
+    A_host = _spd(n)
+    A = TiledMatrix.from_array(A_host.copy(), nb, nb, name="A")
+    ex = PanelExecutor(plan_taskpool(build_potrf(A)))
+    ex.run()
+    L = np.tril(A.to_array())
+    err = np.linalg.norm(L @ L.T - A_host) / np.linalg.norm(A_host)
+    assert err < 1e-4, err
+
+
+def test_panel_matches_tile_dict_executor():
+    """Same plan, both substrates → same lower triangle (same kernels,
+    same wave order)."""
+    A_host = _spd(256)
+    A1 = TiledMatrix.from_array(A_host.copy(), 64, 64, name="A")
+    PanelExecutor(plan_taskpool(build_potrf(A1))).run()
+    A2 = TiledMatrix.from_array(A_host.copy(), 64, 64, name="A")
+    WavefrontExecutor(plan_taskpool(build_potrf(A2))).run()
+    assert np.allclose(np.tril(A1.to_array()), np.tril(A2.to_array()),
+                       atol=2e-2), "substrates diverged"
+
+
+def test_panel_preserves_upper_tiles():
+    """The DAG never writes strictly-upper tiles; neither may the fused
+    path (write-set equivalence with the tiled executors)."""
+    A_host = _spd(256)
+    A = TiledMatrix.from_array(A_host.copy(), 64, 64, name="A")
+    ex = PanelExecutor(plan_taskpool(build_potrf(A)))
+    ex.run()
+    out = A.to_array()
+    nt = 256 // 64
+    for i in range(nt):
+        for j in range(i + 1, nt):
+            assert np.array_equal(out[i * 64:(i + 1) * 64,
+                                      j * 64:(j + 1) * 64],
+                                  A_host[i * 64:(i + 1) * 64,
+                                         j * 64:(j + 1) * 64]), (i, j)
+
+
+def test_panel_requires_wave_fuser():
+    """Taskpools without a wave_fuser are rejected with a clear error."""
+    A = TiledMatrix.from_array(_spd(128), 64, 64, name="A")
+    tp = build_potrf(A)
+    del tp.wave_fuser
+    with pytest.raises(ValueError, match="wave_fuser"):
+        PanelExecutor(plan_taskpool(tp))
+
+
+def test_panel_geometry_slices():
+    g = PanelGeometry(mb=32, nb=32, mt=4, nt=4)
+    assert g.rows(2) == slice(64, 96)
+
+
+# ---------------------------------------------------------------- left-looking
+
+def test_left_potrf_host_runtime_matches_lapack():
+    """build_potrf_left through the HOST runtime (CTL-gather ordering +
+    direct collection reads in UPDATE bodies)."""
+    import parsec_tpu as parsec
+    from parsec_tpu.algorithms.potrf import build_potrf_left
+
+    A_host = _spd(256)
+    A = TiledMatrix.from_array(A_host.copy(), 64, 64, name="A")
+    ctx = parsec.init(nb_cores=4)
+    ctx.start()
+    ctx.add_taskpool(build_potrf_left(A))
+    assert ctx.wait(timeout=60)
+    parsec.fini(ctx)
+    L = np.tril(A.to_array())
+    err = np.linalg.norm(L @ L.T - A_host) / np.linalg.norm(A_host)
+    assert err < 1e-4, err
+
+
+@pytest.mark.parametrize("n,nb", [(256, 64), (192, 64), (256, 128)])
+def test_left_potrf_panel_executor(n, nb):
+    from parsec_tpu.algorithms.potrf import build_potrf_left
+
+    A_host = _spd(n)
+    A = TiledMatrix.from_array(A_host.copy(), nb, nb, name="A")
+    ex = PanelExecutor(plan_taskpool(build_potrf_left(A)))
+    ex.run()
+    L = np.tril(A.to_array())
+    err = np.linalg.norm(L @ L.T - A_host) / np.linalg.norm(A_host)
+    assert err < 1e-4, err
+
+
+def test_left_matches_right_fused():
+    """Left- and right-looking fused paths agree on the factor."""
+    from parsec_tpu.algorithms.potrf import build_potrf_left
+
+    A_host = _spd(256)
+    A1 = TiledMatrix.from_array(A_host.copy(), 64, 64, name="A")
+    PanelExecutor(plan_taskpool(build_potrf_left(A1))).run()
+    A2 = TiledMatrix.from_array(A_host.copy(), 64, 64, name="A")
+    PanelExecutor(plan_taskpool(build_potrf(A2))).run()
+    assert np.allclose(np.tril(A1.to_array()), np.tril(A2.to_array()),
+                       atol=2e-2)
+
+
+def test_left_wave_structure():
+    """ASAP leveling of the left DAG: exactly 3 waves per step k
+    ([UPDATE], [POTRF], [TRSM]) — the schedule the fuser assumes."""
+    from parsec_tpu.algorithms.potrf import build_potrf_left
+
+    A = TiledMatrix.from_array(_spd(256), 64, 64, name="A")
+    plan = plan_taskpool(build_potrf_left(A))
+    assert plan.n_waves == 3 * 4 - 2       # 3 per step, last has no TRSM
+    kinds = [sorted(g.tc.name for g in w) for w in plan.waves]
+    assert kinds[0] == ["POTRF"] and kinds[1] == ["TRSM"]
+    for k in range(1, 4):
+        base = 2 + 3 * (k - 1)
+        assert kinds[base] == ["UPDATE"]
+        assert kinds[base + 1] == ["POTRF"]
+        if k < 3:
+            assert kinds[base + 2] == ["TRSM"]
+
+
+def test_left_rejected_by_wavefront_executor():
+    from parsec_tpu.algorithms.potrf import build_potrf_left
+
+    A = TiledMatrix.from_array(_spd(128), 64, 64, name="A")
+    with pytest.raises(ValueError, match="PanelExecutor"):
+        WavefrontExecutor(plan_taskpool(build_potrf_left(A)))
