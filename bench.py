@@ -256,6 +256,13 @@ def main():
             "link_latency_s": round(lat, 4),
             "rel_residual_check": float(f"{err:.3e}"),
             "latency": latency,
+            # flagship path memory: one donated Aᵀ array + the carry row
+            # panel; XLA memory_analysis measured temp ≈ matrix size
+            # (in-place DUS chain). Bounded-budget execution (HBM
+            # manager + segmented executor, device.hbm_budget_mb) is
+            # exercised by tests/test_hbm.py.
+            "hbm": {"matrix_bytes": N * N * 4,
+                    "est_peak_bytes": 2 * N * N * 4 + NB * N * 4},
         },
     }))
 

@@ -265,6 +265,7 @@ class WavefrontExecutor:
         self.bucket = bucket
         self.device_type = device_type
         self._vmapped: Dict[str, Callable] = {}
+        self._segments: Dict[Tuple, Callable] = {}
         # jit once: a fresh jax.jit wrapper per run() would recompile the
         # whole-DAG program on every call (jit caches by function object)
         self.jitted = self.jax.jit(self.run_arrays)
@@ -418,15 +419,24 @@ class WavefrontExecutor:
     # of untouched tiles. Preferred single-chip form; the stacked form
     # remains the input to the SPMD mesh path (sharded along slots).
 
-    def make_tiles(self) -> Dict[Tuple[str, int], Any]:
+    def make_tiles(self, host: bool = False
+                   ) -> Dict[Tuple[str, int], Any]:
+        """Tile dict from the collections. ``host=True`` keeps tiles as
+        host numpy (for budgeted segmented execution: the HBM manager
+        stages them in lazily instead of everything landing in device
+        memory up front)."""
+        import numpy as _np
         jnp = self.jnp
         tiles: Dict[Tuple[str, int], Any] = {}
         for name, dc in self.plan.collections.items():
             scratch = dc.scratch
             for key, slot in self.plan.slot_maps[name].items():
-                if scratch:   # factor scratch: device zeros, no host read
-                    tiles[(name, slot)] = jnp.zeros((dc.mb, dc.nb),
-                                                    dc.dtype)
+                if scratch:   # factor scratch: zeros, no host read
+                    z = (_np.zeros if host else jnp.zeros)(
+                        (dc.mb, dc.nb), dc.dtype)
+                    tiles[(name, slot)] = z
+                elif host:
+                    tiles[(name, slot)] = _np.asarray(dc.data_of(key))
                 else:
                     tiles[(name, slot)] = jnp.asarray(dc.data_of(key))
         return tiles
@@ -449,6 +459,159 @@ class WavefrontExecutor:
                         updates.append(((name, int(s)), val[b]))
             for k, v in updates:
                 tiles[k] = v
+        return tiles
+
+    # -- segmented tile-dict execution -----------------------------------
+    # Whole-DAG jit compiles every wave-group's ops into one XLA program:
+    # compile time grows with task count (42 s at 120 tasks, minutes at
+    # thousands). The segmented form dispatches one cached jitted segment
+    # per (class, bucket) shape: compile cost scales with the number of
+    # DISTINCT shapes (a handful per class — power-of-two bucketed), not
+    # with tasks or waves, and segments are reused across waves, runs and
+    # problem sizes with the same tile shape. JAX async dispatch keeps
+    # the per-call overhead pipelined. Trade-off: the program can't be
+    # fused across waves, so prefer run_tile_dict/jit for small DAGs and
+    # the panel path for dense one-matrix DAGs.
+
+    def _segment(self, grp: WaveGroup, batch: int) -> Callable:
+        chore = self._chore(grp.tc)
+        hooked = self._hook_applies(chore, grp)
+        shapes = tuple(
+            (self.plan.collections[name].mb,
+             self.plan.collections[name].nb,
+             np.dtype(self.plan.collections[name].dtype).str)
+            for (name, _idx) in grp.in_slots) if grp.in_slots else ()
+        key = (grp.tc.name, batch, hooked, shapes)
+        fn = self._segments.get(key)
+        if fn is None:
+            body = self._body(grp.tc, batch,
+                              grp if hooked else None)
+            fn = self.jax.jit(
+                lambda *ins, _b=body, _tc=grp.tc:
+                tuple(self._normalize_outs(_tc, _b(*ins))))
+            self._segments[key] = fn
+        return fn
+
+    def _split_group(self, grp: WaveGroup,
+                     manager: Optional[Any]) -> List[WaveGroup]:
+        """Split a wave-group so one sub-batch's tile working set
+        (inputs + outputs) fits in ~half the manager's budget."""
+        if manager is None:
+            return [grp]
+        tile_bytes = max(
+            dc.mb * dc.nb * np.dtype(dc.dtype).itemsize
+            for dc in self.plan.collections.values())
+        max_tiles = max(1, (manager.zone.capacity // 2) // tile_bytes)
+        per_task = max(1, len(grp.in_slots) + len(grp.out_slots))
+        chunk = max(1, max_tiles // per_task)
+        if len(grp.tasks) <= chunk:
+            return [grp]
+        subs = []
+        for lo in range(0, len(grp.tasks), chunk):
+            hi = lo + chunk
+            subs.append(WaveGroup(
+                tc=grp.tc, level=grp.level, tasks=grp.tasks[lo:hi],
+                in_slots=[(n, idx[lo:hi]) for (n, idx) in grp.in_slots],
+                out_slots=[(n, idx[lo:hi])
+                           for (n, idx) in grp.out_slots]))
+        return subs
+
+    def _use_schedule(self) -> Dict[Tuple[str, int], List[int]]:
+        """Wave indices at which each tile is read — the static schedule
+        that makes Belady eviction possible for the HBM manager."""
+        uses: Dict[Tuple[str, int], List[int]] = {}
+        for w, wave in enumerate(self.plan.waves):
+            for grp in wave:
+                for (name, idx) in grp.in_slots:
+                    for s in idx:
+                        uses.setdefault((name, int(s)), []).append(w)
+        return uses
+
+    _NEVER = 1 << 30      # "never read again" — the ideal evictee
+
+    def run_tile_dict_segmented(self, tiles: Dict[Tuple[str, int], Any],
+                                manager: Optional[Any] = None
+                                ) -> Dict[Tuple[str, int], Any]:
+        """Tile-dict execution dispatched wave-by-wave through cached
+        per-(class, bucket) jitted segments (bounded compile time).
+
+        With an :class:`~..device.hbm.HBMManager`, tile residency is
+        bounded by its budget: inputs are staged in (evicting the tile
+        with the farthest next use — the plan gives Belady's policy for
+        free), outputs registered, and the next wave's inputs are
+        prefetched while the current wave's dispatches are in flight.
+        Problems larger than the budget complete by spilling to host.
+        """
+        from ..utils import mca_param
+        jnp = self.jnp
+        tiles = dict(tiles)
+        if manager is not None:
+            uses = self._use_schedule()
+            # spills rebind the tiles dict to the host copy, so the
+            # executor drops its device reference and XLA can actually
+            # free the buffer (logical AND physical residency agree)
+            _spill = tiles.__setitem__
+            for key, val in tiles.items():
+                # register lazily (host-side): tiles stage in at first use
+                manager.register(key, val, spill=_spill,
+                                 next_use=uses.get(key, [self._NEVER])[0])
+
+        def _next_use(key, w):
+            for u in uses.get(key, ()):
+                if u > w:
+                    return u
+            return self._NEVER
+
+        prefetch = manager is not None and bool(
+            mca_param.get("device.hbm_prefetch", 1))
+        for w, wave in enumerate(self.plan.waves):
+            snapshot = dict(tiles)     # gather-before-scatter snapshot
+            updates: List[Tuple[Tuple[str, int], Any]] = []
+            for grp in wave:
+                # under a budget, split oversized groups so one
+                # sub-batch's working set fits (the reference stages
+                # per task; a k=0 trailing-update group can otherwise
+                # reference nearly the whole matrix at once)
+                for sub in self._split_group(grp, manager):
+                    gkeys = [(name, int(s))
+                             for (name, idx) in sub.in_slots
+                             for s in idx]
+                    if manager is not None:
+                        protect = tuple(gkeys)
+                        for key in gkeys:
+                            snapshot[key] = manager.ensure(
+                                key, snapshot.get(key), protect=protect,
+                                next_use=_next_use(key, w))
+                    B = len(sub.tasks)
+                    Bp = 1 << (B - 1).bit_length() if self.bucket else B
+                    inputs = []
+                    for (name, idx) in sub.in_slots:
+                        pidx = self._pad(idx, Bp, int(idx[0]))
+                        inputs.append(jnp.stack(
+                            [snapshot[(name, int(s))] for s in pidx]))
+                    outs = self._segment(sub, Bp)(*inputs)
+                    for (name, idx), val in zip(sub.out_slots, outs):
+                        for b, s in enumerate(idx):  # padding dropped
+                            updates.append(((name, int(s)), val[b]))
+            for k, v in updates:
+                tiles[k] = v
+                if manager is not None:
+                    manager.put(k, v, spill=_spill,
+                                next_use=_next_use(k, w))
+            if prefetch and w + 1 < len(self.plan.waves):
+                # stage the next wave's inputs while this wave's async
+                # dispatches drain (device_cuda stage-in stream analog).
+                # Opportunistic only: best_effort staging fills FREE
+                # space and never evicts — pinning or thrashing the
+                # resident set would defeat budgets sized for one
+                # sub-group
+                for grp in self.plan.waves[w + 1]:
+                    for (name, idx) in grp.in_slots:
+                        for s in idx:
+                            key = (name, int(s))
+                            tiles[key] = manager.ensure(
+                                key, tiles.get(key), best_effort=True,
+                                next_use=_next_use(key, w))
         return tiles
 
     def write_back_tiles(self, tiles: Dict[Tuple[str, int], Any]) -> None:

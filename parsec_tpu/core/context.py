@@ -26,6 +26,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+import weakref
+
 from .future import DataCopyFuture
 from .reshape import resolve_reshape
 from .task import Chore, DeviceType, HookReturn, Task, TaskStatus
@@ -117,6 +119,13 @@ class Context:
         # trace/grapher init (task_profiler installs a Trace on self.trace)
         from ..profiling import pins_modules as pins_modules_mod
         self.pins_modules = pins_modules_mod.install_selected(self)
+        # bounded device residency for task-written collection tiles
+        # (device.hbm_budget_mb; reference: GPU LRU eviction lists,
+        # device_gpu.h:115-136) — cold device tiles spill back to host
+        # numpy through their collection
+        from ..device.hbm import manager_from_mca
+        self.hbm = manager_from_mca()
+
         self._dot_path = str(mca_param.get("profiling.dot", "") or "")
         if self._dot_path:
             from ..profiling.grapher import Grapher
@@ -249,6 +258,10 @@ class Context:
             if tp.error is not None and tp not in self._aborted:
                 self._aborted.append(tp)
             self._cv.notify_all()
+        if self.hbm is not None:
+            # entries whose collection died with its taskpool: free the
+            # accounting, skip the pointless spill
+            self.hbm.sweep(_hbm_entry_dead)
 
     # --------------------------------------------------------- worker loop
     def _worker_main(self, es: ExecutionStream) -> None:
@@ -352,6 +365,32 @@ class Context:
             return rc
         return HookReturn.ERROR
 
+    def _hbm_track(self, dc, key, value) -> None:
+        """Register a device-resident tile a task is writing to its
+        collection; over budget, the manager spills the coldest tracked
+        tile back into its collection as host numpy. Called BEFORE the
+        collection write: the manager then always holds the newest
+        version, so a concurrent eviction can never overwrite a newer
+        collection value with a stale spill. The spill closure holds the
+        collection weakly — dead collections' entries are swept when
+        their taskpool terminates instead of being pinned forever."""
+        if not isinstance(value, self.hbm.jax.Array):
+            return
+        import weakref
+        k = tuple(key) if isinstance(key, (tuple, list)) else (key,)
+        dc_ref = weakref.ref(dc)
+
+        def _spill(_k, host, dc_ref=dc_ref, key=key):
+            target = dc_ref()
+            if target is not None:
+                target.write_tile(key, host)
+
+        try:
+            self.hbm.put((id(dc), k), value, spill=_spill)
+        except MemoryError:
+            warning("hbm", "tile %r exceeds the device budget alone; "
+                    "left resident", key)
+
     def complete_task(self, es: Optional[ExecutionStream], task: Task) -> None:
         """__parsec_complete_execution + release_deps analog
         (scheduling.c:441-470, parsec.c:1694-1921)."""
@@ -371,6 +410,9 @@ class Context:
         ready: List[Task] = []
         for ref in tc.iterate_successors(task):
             if isinstance(ref, DataRef):
+                # track first, write second — see _hbm_track
+                if self.hbm is not None:
+                    self._hbm_track(ref.collection, ref.key, ref.value)
                 ref.collection.write_tile(ref.key, ref.value)
                 continue
             if ref.reshape_spec is not None or \
@@ -402,6 +444,16 @@ class Context:
         self.pins.release_deps_end(es, task)
         self.pins.complete_exec_end(es, task)
         tp.addto_nb_tasks(-1)
+
+
+def _hbm_entry_dead(_key, entry) -> bool:
+    """True when a context-tracked HBM entry's collection weakref (the
+    first weakref default of its spill closure) is dead."""
+    spill = entry.get("spill")
+    for d in getattr(spill, "__defaults__", None) or ():
+        if isinstance(d, weakref.ref):
+            return d() is None
+    return False
 
 
 def init(nb_cores: Optional[int] = None, scheduler: Optional[str] = None,

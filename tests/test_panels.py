@@ -142,3 +142,45 @@ def test_left_rejected_by_wavefront_executor():
     A = TiledMatrix.from_array(_spd(128), 64, 64, name="A")
     with pytest.raises(ValueError, match="PanelExecutor"):
         WavefrontExecutor(plan_taskpool(build_potrf_left(A)))
+
+
+# ------------------------------------------------------------- segmented
+
+def test_segmented_tile_dict_matches_whole_dag():
+    """run_tile_dict_segmented: same results as the whole-DAG jit, with
+    a bounded segment cache (compile scales with distinct (class,
+    bucket) shapes, not waves/tasks)."""
+    A_host = _spd(512)
+    A1 = TiledMatrix.from_array(A_host.copy(), 64, 64, name="A")
+    ex1 = WavefrontExecutor(plan_taskpool(build_potrf(A1)))
+    t1 = ex1.make_tiles()
+    out1 = ex1.run_tile_dict(dict(t1))
+
+    A2 = TiledMatrix.from_array(A_host.copy(), 64, 64, name="A")
+    ex2 = WavefrontExecutor(plan_taskpool(build_potrf(A2)))
+    out2 = ex2.run_tile_dict_segmented(ex2.make_tiles())
+
+    for k in out1:
+        assert np.allclose(np.asarray(out1[k]), np.asarray(out2[k]),
+                           atol=1e-3), k
+    # the segment cache must stay below the wave-group count (shape
+    # reuse across waves — the point of the mode) and is bounded by
+    # classes x power-of-two buckets, not by DAG size
+    n_groups = sum(len(w) for w in ex2.plan.waves)
+    assert len(ex2._segments) < n_groups, (len(ex2._segments), n_groups)
+    assert len(ex2._segments) <= 4 * 6
+
+
+def test_segmented_reuses_segments_across_sizes():
+    """Same tile shape at a bigger NT adds few/no new segments."""
+    A1 = TiledMatrix.from_array(_spd(256), 64, 64, name="A")
+    ex = WavefrontExecutor(plan_taskpool(build_potrf(A1)))
+    ex.run_tile_dict_segmented(ex.make_tiles())
+    n_small = len(ex._segments)
+
+    A2 = TiledMatrix.from_array(_spd(512), 64, 64, name="A")
+    ex2 = WavefrontExecutor(plan_taskpool(build_potrf(A2)))
+    ex2._segments = ex._segments          # shared cache (same shapes)
+    ex2.run_tile_dict_segmented(ex2.make_tiles())
+    added = len(ex2._segments) - n_small
+    assert added <= 8, added              # only new bucket sizes appear
