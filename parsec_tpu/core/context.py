@@ -376,7 +376,6 @@ class Context:
         their taskpool terminates instead of being pinned forever."""
         if not isinstance(value, self.hbm.jax.Array):
             return
-        import weakref
         k = tuple(key) if isinstance(key, (tuple, list)) else (key,)
         dc_ref = weakref.ref(dc)
 
@@ -389,7 +388,7 @@ class Context:
             self.hbm.put((id(dc), k), value, spill=_spill)
         except MemoryError:
             warning("hbm", "tile %r exceeds the device budget alone; "
-                    "left resident", key)
+                    "left untracked", key)
 
     def complete_task(self, es: Optional[ExecutionStream], task: Task) -> None:
         """__parsec_complete_execution + release_deps analog

@@ -11,6 +11,8 @@ from ..utils import mca_param
 from ..utils.debug import debug_verbose
 
 mca_param.register("device.tpu.enabled", True, help="register the TPU device")
+mca_param.register("device.tpu.max_devices", 0,
+                   help="cap on per-chip TPU modules (0 = all visible)")
 
 
 class Device:
@@ -91,8 +93,17 @@ class Registry:
         self.add(RecursiveDevice())
         if mca_param.get("device.tpu.enabled", True):
             try:
+                # one module per visible chip (reference: per-GPU module
+                # instances, device_cuda_module.c:326) so device_for can
+                # load-balance across them by load x weight
+                import jax
                 from .tpu import TPUDevice
-                self.add(TPUDevice())
+                limit = int(mca_param.get("device.tpu.max_devices", 0))
+                devs = jax.devices()
+                if limit > 0:
+                    devs = devs[:limit]
+                for jd in devs:
+                    self.add(TPUDevice(jd))
             except Exception as exc:  # jax missing/broken → CPU-only context
                 debug_verbose(2, "device", "TPU device unavailable: %s", exc)
 

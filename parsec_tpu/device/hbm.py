@@ -184,7 +184,14 @@ class HBMManager:
                 old["offset"] = None    # _reserve may raise: never leave
                 #                         a dangling offset to double-free
             nb = _nbytes(value)
-            off = self._reserve(nb, protect + (key,))
+            try:
+                off = self._reserve(nb, protect + (key,))
+            except MemoryError:
+                # the value exceeds the whole budget: drop the entry
+                # entirely — keeping the superseded old value would pin
+                # a dead version and serve stale data
+                self._entries.pop(key, None)
+                raise
             self._entries[key] = {
                 "value": value, "offset": off, "last_use": self._clock,
                 "next_use": next_use,

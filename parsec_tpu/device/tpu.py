@@ -36,16 +36,21 @@ class TPUDevice(Device):
     device_type = DeviceType.TPU
     name = "tpu"
 
-    def __init__(self) -> None:
+    def __init__(self, jax_device: Any = None) -> None:
+        """One module instance per chip (reference: one
+        parsec_device_cuda_module_t per GPU, device_cuda_module.c:326).
+        ``jax_device`` pins this module to a specific ``jax.Device``;
+        default = the first visible device."""
         super().__init__()
         import jax
         self.jax = jax
-        devs = jax.devices()
-        self.jax_device = devs[0]
+        self.jax_device = jax_device if jax_device is not None \
+            else jax.devices()[0]
         self.platform = self.jax_device.platform
         # load-balancing weight: accelerators drastically out-throughput the
         # inline-CPU device (reference GFLOPS table device_cuda_module.c:53)
         self.weight = 100.0 if self.platform != "cpu" else 2.0
+        self.name = f"tpu{self.jax_device.id}"
         self._jit_cache: Dict[Any, Callable] = {}
         self._cache_lock = threading.Lock()
         debug_verbose(3, "device", "TPU device on %s (%s)",
@@ -74,7 +79,18 @@ class TPUDevice(Device):
         if not chore.batchable:
             return self._run_hook(task, chore)
         jitted = self._jitted(task, chore)
-        wrapped = Chore(device_type=chore.device_type,
-                        hook=lambda t, *tiles: jitted(*tiles),
+
+        def hook(t, *tiles):
+            # pin this module's chip: default_device alone does NOT
+            # decide placement — committed inputs win (and inputs
+            # committed to different chips make jit raise), so stage
+            # every input onto this module's device explicitly
+            # (device_put is a no-op for already-resident buffers)
+            staged = [self.jax.device_put(x, self.jax_device)
+                      if x is not None else None for x in tiles]
+            with self.jax.default_device(self.jax_device):
+                return jitted(*staged)
+
+        wrapped = Chore(device_type=chore.device_type, hook=hook,
                         evaluate=chore.evaluate)
         return self._run_hook(task, wrapped)

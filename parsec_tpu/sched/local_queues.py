@@ -125,10 +125,46 @@ class _LocalQueueScheduler(Scheduler):
         return n
 
 
+def _span_order(es):
+    """Hierarchical (core→pair→quad→…→VP) peer order: nearest
+    topology neighbors first. Stands in for hwloc levels (vpmap-scoped;
+    reference sched_local_queues_utils.h steal hierarchy)."""
+    peers = sorted((s for s in es.context.streams if s.vp_id == es.vp_id),
+                   key=lambda s: s.th_id)
+    me = next(i for i, s in enumerate(peers) if s is es)
+    order = []
+    span = 2
+    while span <= max(len(peers), 2):
+        base = (me // span) * span
+        for i in range(base, min(base + span, len(peers))):
+            if peers[i] not in order:
+                order.append(peers[i])
+        span *= 2
+    for p in peers:
+        if p not in order:
+            order.append(p)
+    return order
+
+
 class LFQScheduler(_LocalQueueScheduler):
-    """Local flat queues, bounded buffer, hierarchical steal."""
+    """Local flat queues: bounded per-thread buffer (reference hbbuffer),
+    overflow to the system dequeue, HIERARCHICAL steal order
+    (core→pair→quad→…, nearest first). ``distance > 0`` skips the local
+    buffer entirely — the ordered-ring semantics of sched.h:243-250:
+    far-distance tasks go where any starving thread finds them, which is
+    what prevents the re-schedule livelock the reference warns about."""
     name = "lfq"
-    local_bound = 64          # reference hbbuffer is bounded per-thread
+    local_bound = 64
+
+    def schedule(self, es, tasks: Sequence[Task], distance: int = 0) -> None:
+        if distance > 0 or es is None or \
+                getattr(es, "sched_obj", None) is None:
+            self.system.push_back(tasks)
+            return
+        super().schedule(es, tasks, distance)
+
+    def _steal_order(self, es):
+        return _span_order(es)
 
 
 class LLScheduler(_LocalQueueScheduler):
@@ -141,19 +177,88 @@ class LLScheduler(_LocalQueueScheduler):
 
 
 class PBQScheduler(_LocalQueueScheduler):
-    """Priority-based local flat queues: local ring kept priority-ordered."""
+    """Priority-based local flat queues (reference sched/pbq): a small
+    array of flat FIFO queues selected by priority BAND — tasks of
+    similar priority stay FIFO-ordered (no total sort), high bands pop
+    first. Distinct from llp's totally-ordered LIFO."""
     name = "pbq"
+    n_bands = 4
+    band_shift = 4            # priority // 16 picks the band (clamped)
+
+    def flow_init(self, es) -> None:
+        super().flow_init(es)
+        es.sched_obj = _BandedQueues(self.n_bands, self.band_shift)
 
     def _push_local(self, q, tasks, distance: int) -> None:
-        with q.lock:
-            q.dq.extend(tasks)
-            q.dq = deque(sorted(q.dq, key=lambda t: -t.priority))
+        q.push(tasks)
+
+    def _pop_local(self, q):
+        return q.pop_front()
+
+    def _steal(self, q):
+        return q.pop_back()
 
 
-class LLPScheduler(PBQScheduler):
-    """Per-thread LIFO sorted by priority (reference detaches, merges and
-    reattaches the chain on insert — here a sort under the stream lock)."""
+class _BandedQueues:
+    """Priority-banded flat FIFO queues (pbq's structure)."""
+
+    __slots__ = ("bands", "lock", "shift")
+
+    def __init__(self, n_bands: int, shift: int) -> None:
+        self.bands = [deque() for _ in range(n_bands)]
+        self.lock = threading.Lock()
+        self.shift = shift
+
+    def _band(self, task: Task) -> int:
+        b = max(0, int(task.priority)) >> self.shift
+        return min(b, len(self.bands) - 1)
+
+    def push(self, tasks) -> None:
+        with self.lock:
+            for t in tasks:
+                self.bands[self._band(t)].append(t)
+
+    def pop_front(self) -> Optional[Task]:
+        with self.lock:
+            for band in reversed(self.bands):     # high band first
+                if band:
+                    return band.popleft()
+        return None
+
+    def pop_back(self) -> Optional[Task]:
+        """Steal side: take from the LOWEST band's tail (leave the
+        victim its high-priority work)."""
+        with self.lock:
+            for band in self.bands:
+                if band:
+                    return band.pop()
+        return None
+
+    def __len__(self) -> int:
+        return sum(len(b) for b in self.bands)
+
+
+class LLPScheduler(_LocalQueueScheduler):
+    """Per-thread list kept TOTALLY priority-sorted: inserts merge the
+    incoming (sorted) batch into the sorted chain — the reference's
+    detach/merge/reattach (sched/llp, 790 LoC) — rather than pbq's
+    banded FIFO. Steals take the victim's lowest-priority tail."""
     name = "llp"
+
+    def _push_local(self, q, tasks, distance: int) -> None:
+        batch = sorted(tasks, key=lambda t: -t.priority)
+        with q.lock:
+            merged, it = [], iter(q.dq)
+            cur = next(it, None)
+            for t in batch:
+                while cur is not None and cur.priority >= t.priority:
+                    merged.append(cur)
+                    cur = next(it, None)
+                merged.append(t)
+            while cur is not None:
+                merged.append(cur)
+                cur = next(it, None)
+            q.dq = deque(merged)
 
 
 class LTQScheduler(_LocalQueueScheduler):
@@ -185,24 +290,88 @@ class LTQScheduler(_LocalQueueScheduler):
 
 
 class LHQScheduler(_LocalQueueScheduler):
-    """Local hierarchical queues: one queue per topology level. Without
-    hwloc, levels are (self, pair, quad, ... VP); steal walks levels
-    outward — realized as pair-first steal order."""
+    """Local hierarchical queues (reference sched/lhq): one ACTUAL queue
+    per topology level — level 0 private, level 1 shared by the stream
+    pair, level 2 by the quad, …, top level by the whole VP. Without
+    hwloc the levels are the power-of-two groupings of the vpmap.
+
+    ``distance`` is the ordered-ring hint of sched.h:243-250 realized
+    structurally: a task scheduled at distance d is pushed to the
+    level-d queue, visible to 2^d streams — the farther the hint, the
+    wider the task's availability. select() walks levels inward-out,
+    then steals peers' private queues (nearest-first), then the system
+    dequeue."""
     name = "lhq"
 
-    def _steal_order(self, es):
-        peers = sorted((s for s in es.context.streams if s.vp_id == es.vp_id),
-                       key=lambda s: s.th_id)
-        me = next(i for i, s in enumerate(peers) if s is es)
-        order = []
+    def install(self, context) -> None:
+        super().install(context)
+        self._shared = {}
+        self._shared_lock = threading.Lock()
+        self._level_cache = {}
+
+    def flow_init(self, es) -> None:
+        super().flow_init(es)
+        self._level_cache.pop(id(es), None)
+
+    def _levels(self, es):
+        """Level queues from private to VP-wide."""
+        cached = self._level_cache.get(id(es))
+        if cached is not None:
+            return cached
+        n_vp = sum(1 for s in es.context.streams if s.vp_id == es.vp_id)
+        levels = [es.sched_obj]
         span = 2
-        while span <= max(len(peers), 2):
-            base = (me // span) * span
-            for i in range(base, min(base + span, len(peers))):
-                if peers[i] not in order:
-                    order.append(peers[i])
+        while span < 2 * max(n_vp, 1):
+            group = es.th_id // span
+            with self._shared_lock:
+                q = self._shared.setdefault(
+                    (es.vp_id, span, group), _LocalDeque())
+            levels.append(q)
+            if span >= n_vp:
+                break
             span *= 2
-        for p in peers:
-            if p not in order:
-                order.append(p)
-        return order
+        self._level_cache[id(es)] = levels
+        return levels
+
+    def schedule(self, es, tasks: Sequence[Task], distance: int = 0) -> None:
+        if es is None or getattr(es, "sched_obj", None) is None:
+            self.system.push_back(tasks)
+            return
+        levels = self._levels(es)
+        lvl = min(max(distance, 0), len(levels) - 1)
+        if lvl == 0:
+            levels[0].push_front(tasks)
+        else:
+            levels[lvl].push_back(tasks)
+
+    def select(self, es) -> Optional[Task]:
+        levels = self._levels(es)
+        t = levels[0].pop_front()
+        if t is not None:
+            return t
+        for q in levels[1:]:
+            t = q.pop_front()
+            if t is not None:
+                es.stats["level_pops"] = es.stats.get("level_pops", 0) + 1
+                return t
+        order = es._steal_order
+        if order is None:
+            order = es._steal_order = _span_order(es)
+        for peer in order:
+            if peer is es:
+                continue
+            t = self._steal(peer.sched_obj)
+            if t is not None:
+                es.stats["stolen"] += 1
+                return t
+        t = self.system.pop_front()
+        if t is not None:
+            es.stats["stolen"] += 1
+        return t
+
+    def pending_tasks(self) -> int:
+        n = super().pending_tasks()
+        with self._shared_lock:
+            for q in self._shared.values():
+                n += len(q)
+        return n

@@ -186,3 +186,18 @@ def test_segmented_spill_rebinds_tiles_dict():
     n_dev = len(out) - n_host
     # resident device tiles must be bounded by the budget
     assert n_dev * nb * nb * 4 <= mgr.zone.capacity, (n_dev, n_host)
+
+
+def test_put_over_budget_drops_entry():
+    """A value larger than the whole budget: put raises AND the entry
+    is removed — no stale superseded version stays pinned."""
+    m = HBMManager(1 << 14, unit=1024)       # 16 KiB budget
+    small = np.ones((16, 16), np.float32)
+    m.ensure("k", small)
+    big = np.ones((128, 128), np.float32)    # 64 KiB > budget
+    import jax.numpy as jnp
+    with pytest.raises(MemoryError):
+        m.put("k", jnp.asarray(big))
+    with pytest.raises(KeyError):
+        m.value("k")
+    assert m.resident_bytes() == 0
