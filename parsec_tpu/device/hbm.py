@@ -70,7 +70,12 @@ class HBMManager:
     def __init__(self, budget_bytes: int, unit: int = 4096):
         import jax
         self.jax = jax
-        self.zone = ZoneAllocator(budget_bytes, unit=unit)
+        self.budget = budget_bytes
+        self.unit = unit
+        # the budget is PER CHIP: one zone per jax device tiles land on
+        # (per-chip device modules stage copies onto their own chips —
+        # a single global zone would not bound any real HBM)
+        self._zones: Dict[Any, ZoneAllocator] = {}
         self._entries: Dict[Hashable, Dict[str, Any]] = {}
         self._lock = threading.RLock()
         self._clock = 0
@@ -78,22 +83,38 @@ class HBMManager:
                       "bytes_spilled": 0, "peak_bytes": 0}
 
     # ---------------------------------------------------------- internal
-    def _account_alloc(self, nbytes: int) -> Optional[int]:
-        off = self.zone.malloc(nbytes)
+    def _zone_for(self, dev) -> ZoneAllocator:
+        z = self._zones.get(dev)
+        if z is None:
+            z = self._zones[dev] = ZoneAllocator(self.budget,
+                                                 unit=self.unit)
+        return z
+
+    @property
+    def zone(self) -> ZoneAllocator:
+        """The default device's zone (per-chip budget view)."""
+        with self._lock:
+            return self._zone_for(self.jax.devices()[0])
+
+    def _account_alloc(self, nbytes: int, dev) -> Optional[int]:
+        zone = self._zone_for(dev)
+        off = zone.malloc(nbytes)
         if off is not None:
-            used = self.zone.bytes_used()
+            used = zone.bytes_used()
             if used > self.stats["peak_bytes"]:
-                self.stats["peak_bytes"] = used
+                self.stats["peak_bytes"] = used   # max per-chip usage
         return off
 
-    def _evict_one(self, protect: Tuple[Hashable, ...]) -> bool:
-        """Spill the best victim not in ``protect``. Plan-informed when
-        next_use hints exist (farthest next use first; never-used-again
-        tiles are ideal victims), LRU otherwise."""
+    def _evict_one(self, protect: Tuple[Hashable, ...], dev) -> bool:
+        """Spill the best victim ON ``dev`` not in ``protect``.
+        Plan-informed when next_use hints exist (farthest next use
+        first; never-used-again tiles are ideal victims), LRU
+        otherwise."""
         with self._lock:
             best_key, best_rank = None, None
             for key, e in self._entries.items():
-                if e["offset"] is None or key in protect:
+                if e["offset"] is None or key in protect or \
+                        e.get("device") != dev:
                     continue
                 nu = e.get("next_use")
                 # rank: (next_use descending, last_use ascending);
@@ -109,25 +130,31 @@ class HBMManager:
             if spill_cb is not None:
                 spill_cb(best_key, host)
             e["value"] = host
-            self.zone.free(e["offset"])
+            self._zone_for(dev).free(e["offset"])
             e["offset"] = None
+            e["device"] = None
             self.stats["spills"] += 1
             self.stats["bytes_spilled"] += host.nbytes
             debug_verbose(3, "hbm", "spilled %r (%d bytes)", best_key,
                           host.nbytes)
             return True
 
-    def _reserve(self, nbytes: int, protect: Tuple[Hashable, ...]) -> int:
-        off = self._account_alloc(nbytes)
+    def _reserve(self, nbytes: int, protect: Tuple[Hashable, ...],
+                 dev) -> int:
+        off = self._account_alloc(nbytes, dev)
         while off is None:
-            if not self._evict_one(protect):
+            if not self._evict_one(protect, dev):
+                zone = self._zone_for(dev)
                 raise MemoryError(
                     f"HBM budget too small: cannot reserve {nbytes} "
-                    f"bytes (budget {self.zone.capacity}, in use "
-                    f"{self.zone.bytes_used()}, all resident tiles "
-                    f"pinned)")
-            off = self._account_alloc(nbytes)
+                    f"bytes on {dev} (budget {zone.capacity}, in use "
+                    f"{zone.bytes_used()}, all resident tiles pinned)")
+            off = self._account_alloc(nbytes, dev)
         return off
+
+    @staticmethod
+    def _device_of(value) -> Any:
+        return getattr(value, "device", None)
 
     # ------------------------------------------------------------ public
     def ensure(self, key: Hashable, value: Any = None,
@@ -149,7 +176,8 @@ class HBMManager:
                 if value is None:
                     raise KeyError(f"unknown HBM entry {key!r}")
                 e = {"value": value, "offset": None, "last_use": 0,
-                     "next_use": next_use, "spill": spill}
+                     "next_use": next_use, "spill": spill,
+                     "device": None}
                 self._entries[key] = e
             if spill is not None:
                 e["spill"] = spill
@@ -158,15 +186,28 @@ class HBMManager:
             e["last_use"] = self._clock
             if e["offset"] is None:
                 nb = _nbytes(e["value"])
-                if best_effort:
-                    off = self._account_alloc(nb)
-                    if off is None:
-                        return e["value"]      # no room: stay spilled
-                    e["offset"] = off
+                host_val = e["value"]
+                if isinstance(host_val, self.jax.Array):
+                    staged, dev = host_val, self._device_of(host_val)
                 else:
-                    e["offset"] = self._reserve(nb, protect)
-                if not isinstance(e["value"], self.jax.Array):
-                    e["value"] = self.jax.device_put(e["value"])
+                    # stage FIRST: the placement decides which chip's
+                    # zone pays (device_put under a per-chip module's
+                    # default_device lands there)
+                    staged = self.jax.device_put(host_val)
+                    dev = self._device_of(staged)
+                if best_effort:
+                    off = self._account_alloc(nb, dev)
+                    if off is None:
+                        return host_val        # no room: stay spilled
+                else:
+                    try:
+                        off = self._reserve(nb, protect, dev)
+                    except MemoryError:
+                        raise                  # entry keeps host_val
+                e["offset"] = off
+                e["device"] = dev
+                if staged is not host_val:
+                    e["value"] = staged
                     self.stats["stage_in"] += 1
                     self.stats["bytes_staged"] += nb
             return e["value"]
@@ -180,12 +221,13 @@ class HBMManager:
             self._clock += 1
             old = self._entries.get(key)
             if old is not None and old["offset"] is not None:
-                self.zone.free(old["offset"])
+                self._zone_for(old.get("device")).free(old["offset"])
                 old["offset"] = None    # _reserve may raise: never leave
                 #                         a dangling offset to double-free
             nb = _nbytes(value)
+            dev = self._device_of(value)
             try:
-                off = self._reserve(nb, protect + (key,))
+                off = self._reserve(nb, protect + (key,), dev)
             except MemoryError:
                 # the value exceeds the whole budget: drop the entry
                 # entirely — keeping the superseded old value would pin
@@ -194,7 +236,7 @@ class HBMManager:
                 raise
             self._entries[key] = {
                 "value": value, "offset": off, "last_use": self._clock,
-                "next_use": next_use,
+                "next_use": next_use, "device": dev,
                 "spill": spill if spill is not None else
                 (old or {}).get("spill")}
 
@@ -208,10 +250,12 @@ class HBMManager:
             if key in self._entries:
                 return
             e = {"value": value, "offset": None, "last_use": 0,
-                 "next_use": next_use, "spill": spill}
+                 "next_use": next_use, "spill": spill, "device": None}
             self._entries[key] = e
             if isinstance(value, self.jax.Array):
-                e["offset"] = self._reserve(_nbytes(value), (key,))
+                dev = self._device_of(value)
+                e["offset"] = self._reserve(_nbytes(value), (key,), dev)
+                e["device"] = dev
 
     def value(self, key: Hashable) -> Any:
         """Current value (device or spilled host) without staging."""
@@ -219,13 +263,14 @@ class HBMManager:
             return self._entries[key]["value"]
 
     def resident_bytes(self) -> int:
-        return self.zone.bytes_used()
+        with self._lock:
+            return sum(z.bytes_used() for z in self._zones.values())
 
     def drop(self, key: Hashable) -> None:
         with self._lock:
             e = self._entries.pop(key, None)
             if e is not None and e["offset"] is not None:
-                self.zone.free(e["offset"])
+                self._zone_for(e.get("device")).free(e["offset"])
 
     def sweep(self, dead: Callable[[Hashable, Dict[str, Any]], bool]
               ) -> int:

@@ -97,7 +97,11 @@ class _LocalQueueScheduler(Scheduler):
         t = self._pop_local(es.sched_obj)
         if t is not None:
             return t
-        # steal order is topology-fixed: computed once, cached on the stream
+        return self._steal_and_system(es)
+
+    def _steal_and_system(self, es) -> Optional[Task]:
+        """Steal from VP peers (topology-fixed order, cached on the
+        stream), then drain the system overflow queue."""
         order = es._steal_order
         if order is None:
             order = es._steal_order = self._steal_order(es)
@@ -339,10 +343,17 @@ class LHQScheduler(_LocalQueueScheduler):
             return
         levels = self._levels(es)
         lvl = min(max(distance, 0), len(levels) - 1)
-        if lvl == 0:
+        if distance <= 0:
             levels[0].push_front(tasks)
         else:
+            # distance > 0 clamped to the top level still goes to the
+            # BACK: an AGAIN-rescheduled task push_front'ed on a
+            # single-stream VP would forever precede the work it waits
+            # for (the livelock sched.h:243-250 warns about)
             levels[lvl].push_back(tasks)
+
+    def _steal_order(self, es):
+        return _span_order(es)
 
     def select(self, es) -> Optional[Task]:
         levels = self._levels(es)
@@ -354,20 +365,7 @@ class LHQScheduler(_LocalQueueScheduler):
             if t is not None:
                 es.stats["level_pops"] = es.stats.get("level_pops", 0) + 1
                 return t
-        order = es._steal_order
-        if order is None:
-            order = es._steal_order = _span_order(es)
-        for peer in order:
-            if peer is es:
-                continue
-            t = self._steal(peer.sched_obj)
-            if t is not None:
-                es.stats["stolen"] += 1
-                return t
-        t = self.system.pop_front()
-        if t is not None:
-            es.stats["stolen"] += 1
-        return t
+        return self._steal_and_system(es)
 
     def pending_tasks(self) -> int:
         n = super().pending_tasks()

@@ -165,3 +165,17 @@ def test_stress_dag_all_local_schedulers(sched):
         assert all(S.data_of(("f", i)) == 1 for i in range(n_fan))
     finally:
         parsec.fini(ctx)
+
+
+def test_lhq_single_stream_distance_goes_to_back():
+    """AGAIN-rescheduled tasks (distance=1) on a single-stream VP must
+    go to the BACK of the only queue — push_front would make the
+    rescheduled task forever precede the work it waits for (the
+    livelock sched.h:243-250 warns about)."""
+    ctx, s, es = _single_stream_sched("lhq")
+    try:
+        s.schedule(es, [_FakeTask(1)])                 # local front
+        s.schedule(es, [_FakeTask(2)], distance=1)     # must go behind
+        assert _drain(s, es) == [1, 2]
+    finally:
+        parsec.fini(ctx)
