@@ -119,7 +119,7 @@ def _measure_extras(jax, jnp, np, on_tpu):
 
     out = {}
     rng = np.random.default_rng(0)
-    import jax.numpy as _jnp
+    _jnp = jnp
     lat_f = jax.jit(lambda x: x + 1.0)
     float(lat_f(_jnp.float32(0)))
 
@@ -186,7 +186,28 @@ def _measure_extras(jax, jnp, np, on_tpu):
         ex = WavefrontExecutor(plan_taskpool(build_gemm_ptg(A2, B2, C2)))
         red = jax.jit(ex.run_tile_dict)    # dict -> dict: chainable
         comp_s = chain_timed(red, ex.make_tiles(), K=8)
+        from parsec_tpu.compiled.panels import PanelExecutor
+        np_, nbp = (8192, 1024) if on_tpu else (n, nb)
+        A3 = TiledMatrix(np_, np_, nbp, nbp, name="A")
+        B3 = TiledMatrix(np_, np_, nbp, nbp, name="B")
+        C3 = TiledMatrix(np_, np_, nbp, nbp, name="C")
+        exp = PanelExecutor(plan_taskpool(build_gemm_ptg(A3, B3, C3)))
+        REP = 4                       # repeats inside ONE jit: a single
+        #                               pass is shorter than the link rtt
+
+        def multi(st):
+            for _ in range(REP):
+                st = exp.run_state(st)
+            return st
+
+        st0 = {nm: _jnp.asarray(
+            rng.standard_normal((g.nb * g.nt, g.mb * g.mt)), _jnp.float32)
+            for nm, g in exp.geoms.items()}
+        panel_s = chain_timed(jax.jit(multi), st0, K=2) / REP
         out["dtd_gemm"] = {
+            "panel_fused_gflops":
+                round(2.0 * np_ ** 3 / panel_s / 1e9, 1),
+            "panel_fused_n": np_,
             "n": n, "tile": nb,
             "host_runtime_gflops": round(flops / host_s / 1e9, 1),
             "compiled_gflops": round(flops / comp_s / 1e9, 1),
@@ -289,14 +310,14 @@ def main():
         diagonal blocks are read by the DAG — the fuser symmetrizes
         diag blocks 0.5·(B+Bᵀ) at their point of use, and the residual
         check models exactly that matrix."""
-        return {"D": jnp.concatenate(
+        return {"A": jnp.concatenate(
             [gen_row(key, i) for i in range(NT)], axis=0)}
 
     gen_j = jax.jit(gen_state)
 
     def run(state):
         out = ex.run_state(state)
-        return jnp.sum(out["D"]), out
+        return jnp.sum(out["A"]), out
 
     red = jax.jit(run, donate_argnums=0)
 
@@ -334,7 +355,7 @@ def main():
     # N=40960 would add ~19 GiB and OOM the v5e right after the timed
     # runs). Only the scalar crosses the link.
     def residual(out, key):
-        Lt = out["D"]                   # Lᵀ in the upper block triangle
+        Lt = out["A"]                   # Lᵀ in the upper block triangle
         s = 8
         x = jax.random.normal(jax.random.fold_in(key, NT + 1), (N, s),
                               jnp.float32)

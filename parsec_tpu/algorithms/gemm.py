@@ -53,7 +53,55 @@ def build_gemm_ptg(A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
     def gemm_body(task, A_, B_, C_, _alpha=alpha, _beta=beta):
         return gemm_tile(C_, A_, B_, alpha=_alpha, beta=_beta)
 
+    tp.wave_fuser = _make_gemm_wave_fuser(alpha, beta)
     return tp
+
+
+def _make_gemm_wave_fuser(alpha: float, beta: float):
+    """Panel-fused lowering of the GEMM k-chain (compiled.panels, the
+    multi-collection case): wave k = every GEMM(·,·,k) = ONE dense
+    rank-nb update Cᵀ ← α·Bᵀ[:, k]·Aᵀ[k, :] + β·Cᵀ over the three
+    transposed stores. Mirrors the per-tile body exactly (including β
+    applied per chain step)."""
+
+    def fuser(wave, geoms):
+        import jax.numpy as jnp
+        from ..ops.tile_kernels import matmul_precision
+
+        if not isinstance(geoms, dict):
+            return None                # GEMM always has A/B/C stores
+        if sorted(g.tc.name for g in wave) != ["GEMM"]:
+            return None
+        (grp,) = wave
+        ks = {t[2] for t in grp.tasks}
+        if len(ks) != 1:
+            return None
+        k = ks.pop()
+        g = grp.tc.tp.g
+        ga, gb, gc = g.A.name, g.B.name, g.C.name
+        gA, gB, gC = geoms[ga], geoms[gb], geoms[gc]
+        # the wave must cover the full (m, n) grid — partial waves would
+        # need masking this lowering doesn't do
+        want = {(m, n) for m in range(gC.mt) for n in range(gC.nt)}
+        if {(m, n) for (m, n, _k) in grp.tasks} != want:
+            return None
+        prec = matmul_precision()
+
+        def do_rank_update(st, k=k):
+            At, Bt, Ct = st[ga], st[gb], st[gc]
+            # Aᵀ store is (K, M): its block-row k (= A's column panel k)
+            # is contiguous; Bᵀ store is (N, K): its column block k
+            # spans B's block-ROW extent (gB.mb per block)
+            acc = jnp.matmul(Bt[:, k * gB.mb:(k + 1) * gB.mb],
+                             At[k * gA.nb:(k + 1) * gA.nb, :],
+                             preferred_element_type=jnp.float32,
+                             precision=prec)
+            st[gc] = (alpha * acc + beta * Ct).astype(Ct.dtype)
+            return st
+
+        return do_rank_update
+
+    return fuser
 
 
 def insert_gemm_dtd(tp: "dtd.Taskpool", A: TiledMatrix, B: TiledMatrix,

@@ -220,11 +220,11 @@ def _potrf_wave_fuser(wave, geom):
         (k,) = grp.tasks[0]
 
         def do_potrf(st, k=k):
-            D = st["D"]
+            D = st[geom.name]
             r, c = geom.rows(k), geom.cols(k)
             # diag tile of Aᵀ = (A[k,k])ᵀ, symmetric → chol directly;
             # store Lᵀ (upper) back
-            st["D"] = D.at[c, r].set(tile_chol(D[c, r]).T)
+            st[geom.name] = D.at[c, r].set(tile_chol(D[c, r]).T)
             return st
 
         return do_potrf
@@ -241,12 +241,12 @@ def _potrf_wave_fuser(wave, geom):
 
         def do_trsm(st, k=k, lo=ms[0], hi=ms[-1] + 1):
             from ..ops.tile_kernels import tri_inv_tile
-            D = st["D"]
+            D = st[geom.name]
             c = geom.cols(k)
             # Lᵀ[k,k] stored upper → recover L, invert once per wave
             inv = tri_inv_tile(D[c, geom.rows(k)].T)
             # C ← C·L⁻ᵀ transposed: Cᵀ ← L⁻¹·Cᵀ, one contiguous row panel
-            st["D"] = D.at[c, lo * mb:hi * mb].set(
+            st[geom.name] = D.at[c, lo * mb:hi * mb].set(
                 mm(inv, D[c, lo * mb:hi * mb]))
             return st
 
@@ -274,14 +274,14 @@ def _potrf_wave_fuser(wave, geom):
             # strip j updates A[j.., j] — in Aᵀ: row panel j, trailing
             # columns; SYRK (diag tile) + GEMM (below) together, never
             # touching strictly-upper tiles
-            D = st["D"]
+            D = st[geom.name]
             Pt = D[geom.cols(k), lo * mb:hi * mb]     # (nb, R) = panelᵀ
             for j in range(lo, hi):
                 pj = Pt[:, (j - lo) * mb:(j - lo + 1) * mb]
                 old = D[geom.cols(j), j * mb:hi * mb]
                 D = D.at[geom.cols(j), j * mb:hi * mb].set(
                     old - mm(pj.T, Pt[:, (j - lo) * mb:]))
-            st["D"] = D
+            st[geom.name] = D
             return st
 
         return do_trailing
@@ -454,12 +454,12 @@ def _potrf_left_wave_fuser(wave, geom):
             # this step instead of writing it to D — the step's panel is
             # written exactly ONCE (by do_trsm / do_potrf), halving the
             # DUS traffic and HBM liveness vs a write-per-wave lowering
-            D = st["D"]
+            D = st[geom.name]
             r0, r1 = k * nb, (k + 1) * nb
             # Aᵀ[k-row, k..hi) −= (Lᵀ[:k, k])ᵀ · Lᵀ[:k, k..hi)
             U = D[0:r0, r0:r1]
             S = D[0:r0, r0:hi * mb]
-            st["rowk"] = D[r0:r1, r0:hi * mb] - mm(U.T, S)
+            st["_rowk"] = D[r0:r1, r0:hi * mb] - mm(U.T, S)
             return st
 
         return do_update
@@ -472,26 +472,26 @@ def _potrf_left_wave_fuser(wave, geom):
 
         def do_potrf(st, k=k, last=(k == geom.nt - 1)):
             from ..ops.tile_kernels import tri_inv_tile
-            D = st["D"]
+            D = st[geom.name]
             c, r = geom.cols(k), geom.rows(k)
-            rowk = st.pop("rowk", None)
+            rowk = st.pop("_rowk", None)
             diag = rowk[:, :nb] if rowk is not None else D[c, r]
             # symmetrize (identity for symmetric input; elementwise triu
             # masking here measurably breaks XLA's in-place scheduling —
             # the average form fuses cleanly)
             diag = 0.5 * (diag + diag.T)
             L = tile_chol(diag)
-            st["potrf_inv"] = tri_inv_tile(L)
+            st["_potrf_inv"] = tri_inv_tile(L)
             if last:
                 # no TRSM wave follows: this step's single write is ours
-                st["D"] = D.at[c, r].set(L.T)
+                st[geom.name] = D.at[c, r].set(L.T)
             else:
                 # defer the write — the TRSM wave writes the whole row
                 # panel (Lᵀ diag + solved rest) as ONE contiguous DUS;
                 # split writes double the panel's HBM liveness
-                st["potrf_L"] = L
+                st["_potrf_L"] = L
                 if rowk is not None:
-                    st["rowk_rest"] = rowk[:, nb:]
+                    st["_rowk_rest"] = rowk[:, nb:]
             return st
 
         return do_potrf
@@ -508,22 +508,22 @@ def _potrf_left_wave_fuser(wave, geom):
 
         def do_trsm(st, k=k, lo=ms[0], hi=ms[-1] + 1):
             from ..ops.tile_kernels import tri_inv_tile
-            D = st["D"]
+            D = st[geom.name]
             c = geom.cols(k)
-            inv = st.pop("potrf_inv", None)
-            L = st.pop("potrf_L", None)
+            inv = st.pop("_potrf_inv", None)
+            L = st.pop("_potrf_L", None)
             if inv is None:      # robustness: recompute from the factor
                 inv = tri_inv_tile(D[c, geom.rows(k)].T)
-            rest = st.pop("rowk_rest", None)
+            rest = st.pop("_rowk_rest", None)
             if rest is None:     # k = 0: no UPDATE wave preceded
                 rest = D[c, lo * mb:hi * mb]
             solved = mm(inv, rest)
             if L is not None and lo == k + 1:
                 # one contiguous row-panel write: Lᵀ diag + solved rest
-                st["D"] = D.at[c, k * mb:hi * mb].set(
+                st[geom.name] = D.at[c, k * mb:hi * mb].set(
                     jnp.concatenate([L.T, solved], axis=1))
             else:
-                st["D"] = D.at[c, lo * mb:hi * mb].set(solved)
+                st[geom.name] = D.at[c, lo * mb:hi * mb].set(solved)
             return st
 
         return do_trsm

@@ -37,9 +37,12 @@ A wave_fuser has signature::
     fuser(wave: List[WaveGroup], geom: PanelGeometry)
         -> Callable[[dict], dict] | None
 
-taking/returning the executor state — a dict whose ``"D"`` entry is the
-``(N, M)`` Aᵀ array; fusers may stash extra carry entries (e.g. a
-factored diagonal inverse consumed by the next wave). Return None to
+taking/returning the executor state — a dict with one transposed dense
+array per collection, keyed by collection name (``geom.name``); fusers
+may stash extra carry entries (underscore-prefixed by convention, e.g. a
+factored diagonal inverse consumed by the next wave). Multi-collection
+taskpools receive ``geom`` as a ``{name: PanelGeometry}`` dict. Return
+None to
 reject a wave (the executor then refuses, naming it — no silent
 fallback; a hybrid would reintroduce the copies this path avoids).
 """
@@ -59,8 +62,10 @@ from ..utils.debug import debug_verbose
 @dataclass(frozen=True)
 class PanelGeometry:
     """Transposed-dense layout geometry handed to wave fusers: the state
-    array ``D`` is ``(nb*nt, mb*mt)`` holding Aᵀ — tile (i, j) of A lives
-    at ``D[cols(j), rows(i)]`` transposed."""
+    array ``state[name]`` is ``(nb*nt, mb*mt)`` holding the collection
+    transposed — tile (i, j) lives at ``D[cols(j), rows(i)]``
+    transposed."""
+    name: str
     mb: int
     nb: int
     mt: int
@@ -76,12 +81,12 @@ class PanelGeometry:
 
 
 class PanelExecutor:
-    """Execute a :class:`WavefrontPlan` over Aᵀ dense storage.
+    """Execute a :class:`WavefrontPlan` over transposed dense storage.
 
-    Requirements (checked): the plan touches exactly ONE tiled-matrix
-    collection and its taskpool registered ``wave_fuser``.
-    :meth:`run_state` is a pure jittable function ``state -> state``
-    (state = ``{"D": (N, M) array, ...fuser carries}``).
+    Requirements (checked): the taskpool registered ``wave_fuser`` and
+    every collection is a tiled matrix. :meth:`run_state` is a pure
+    jittable function ``state -> state``
+    (state = ``{collection name: transposed dense array, ...carries}``).
     """
 
     def __init__(self, plan: WavefrontPlan):
@@ -93,37 +98,40 @@ class PanelExecutor:
             raise ValueError(
                 f"taskpool {plan.taskpool.name!r} registers no wave_fuser; "
                 "use the tile-dict/stacked executors instead")
-        if len(plan.collections) != 1:
-            raise ValueError(
-                "panel-fused execution needs exactly one collection, got "
-                f"{sorted(plan.collections)}")
-        (self.dc_name, dc), = plan.collections.items()
-        self.dc = dc
-        geom = PanelGeometry(mb=dc.mb, nb=dc.nb, mt=dc.mt, nt=dc.nt)
-        self.geom = geom
+        self.geoms = {
+            name: PanelGeometry(name=name, mb=dc.mb, nb=dc.nb,
+                                mt=dc.mt, nt=dc.nt)
+            for name, dc in plan.collections.items()}
+        # single-collection pools get the bare geometry (the common
+        # case; multi-collection fusers receive the dict)
+        geom_arg = (next(iter(self.geoms.values()))
+                    if len(self.geoms) == 1 else self.geoms)
+        self.geom = geom_arg
         # lower every wave up front — planning errors surface at build
         # time, not mid-trace
         self._wave_fns: List[Callable] = []
         for w, wave in enumerate(plan.waves):
-            fn = fuser(wave, geom)
+            fn = fuser(wave, geom_arg)
             if fn is None:
                 names = [(g.tc.name, len(g.tasks)) for g in wave]
                 raise ValueError(
                     f"wave {w} not fusable by {plan.taskpool.name!r}: "
                     f"{names}")
             self._wave_fns.append(fn)
-        # DAG write-set: (i, j) block coords any task writes
-        self._written: Set[Tuple[int, int]] = set()
-        inv = {s: k for k, s in plan.slot_maps[self.dc_name].items()}
+        # DAG write-set per collection: (i, j) block coords any task writes
+        self._written: Dict[str, Set[Tuple[int, int]]] = {
+            name: set() for name in self.geoms}
+        invmaps = {name: {s: k for k, s in plan.slot_maps[name].items()}
+                   for name in self.geoms}
         for wave in plan.waves:
             for grp in wave:
-                for (_name, slots) in grp.out_slots:
+                for (name, slots) in grp.out_slots:
                     for s in slots:
-                        self._written.add(tuple(inv[int(s)]))
-        debug_verbose(3, "panels", "lowered %s: %d waves onto one "
-                      "(%d x %d) transposed array", plan.taskpool.name,
-                      len(self._wave_fns), geom.nb * geom.nt,
-                      geom.mb * geom.mt)
+                        self._written[name].add(
+                            tuple(invmaps[name][int(s)]))
+        debug_verbose(3, "panels", "lowered %s: %d waves onto %d "
+                      "transposed dense arrays", plan.taskpool.name,
+                      len(self._wave_fns), len(self.geoms))
         self.jitted = self.jax.jit(self.run_state, donate_argnums=0)
 
     # -- pure dense execution --------------------------------------------
@@ -131,29 +139,37 @@ class PanelExecutor:
         state = dict(state)
         for fn in self._wave_fns:
             state = fn(state)
-        # fuser carries (factored inverses etc.) are wave-transient
-        return {"D": state["D"]}
+        # fuser carries (factored inverses etc.) are wave-transient —
+        # only the collection arrays survive
+        return {name: state[name] for name in self.geoms}
 
     # -- host-driven convenience -----------------------------------------
     def make_state(self) -> Dict[str, Any]:
-        """Collection tiles → Aᵀ dense state."""
+        """Collection tiles → transposed dense state, one array per
+        collection."""
         import jax.numpy as jnp
-        g = self.geom
-        rows = []
-        for j in range(g.nt):
-            rows.append(jnp.concatenate(
-                [jnp.asarray(self.dc.data_of((i, j))).T
-                 for i in range(g.mt)], axis=1))
-        return {"D": jnp.concatenate(rows, axis=0)}
+        state = {}
+        for name, g in self.geoms.items():
+            dc = self.plan.collections[name]
+            rows = []
+            for j in range(g.nt):
+                rows.append(jnp.concatenate(
+                    [jnp.asarray(dc.data_of((i, j))).T
+                     for i in range(g.mt)], axis=1))
+            state[name] = jnp.concatenate(rows, axis=0)
+        return state
 
     def write_back(self, state: Dict[str, Any]) -> None:
-        """Write ONLY the DAG's write-set back to the collection —
+        """Write ONLY the DAG's write-set back to the collections —
         substrate scribbles outside it stay invisible at the collection
         level."""
-        g = self.geom
-        host = np.asarray(state["D"])
-        for (i, j) in sorted(self._written):
-            self.dc.write_tile((i, j), host[g.cols(j), g.rows(i)].T)
+        for name, g in self.geoms.items():
+            if not self._written[name]:
+                continue
+            dc = self.plan.collections[name]
+            host = np.asarray(state[name])
+            for (i, j) in sorted(self._written[name]):
+                dc.write_tile((i, j), host[g.cols(j), g.rows(i)].T)
 
     def run(self, jit: bool = True) -> float:
         t0 = time.perf_counter()

@@ -79,6 +79,7 @@ class HBMManager:
         self._entries: Dict[Hashable, Dict[str, Any]] = {}
         self._lock = threading.RLock()
         self._clock = 0
+        self._stage_dev = None       # placement guess for reserve-first
         self.stats = {"stage_in": 0, "spills": 0, "bytes_staged": 0,
                       "bytes_spilled": 0, "peak_bytes": 0}
 
@@ -188,28 +189,44 @@ class HBMManager:
                 nb = _nbytes(e["value"])
                 host_val = e["value"]
                 if isinstance(host_val, self.jax.Array):
-                    staged, dev = host_val, self._device_of(host_val)
-                else:
-                    # stage FIRST: the placement decides which chip's
-                    # zone pays (device_put under a per-chip module's
-                    # default_device lands there)
-                    staged = self.jax.device_put(host_val)
-                    dev = self._device_of(staged)
+                    # already in HBM: account it where it lives
+                    dev = self._device_of(host_val)
+                    if best_effort:
+                        off = self._account_alloc(nb, dev)
+                        if off is None:
+                            return host_val
+                    else:
+                        off = self._reserve(nb, protect, dev)
+                    e["offset"], e["device"] = off, dev
+                    return host_val
+                # host value: reserve BEFORE staging — a failed
+                # best_effort probe must cost zero transfers (and never
+                # transiently exceed the physical budget). Placement is
+                # guessed as the last-staged device; on a mismatch the
+                # accounting moves to the actual zone afterwards.
+                guess = self._stage_dev or self.jax.devices()[0]
                 if best_effort:
-                    off = self._account_alloc(nb, dev)
+                    off = self._account_alloc(nb, guess)
                     if off is None:
                         return host_val        # no room: stay spilled
                 else:
-                    try:
+                    off = self._reserve(nb, protect, guess)
+                staged = self.jax.device_put(host_val)
+                dev = self._device_of(staged)
+                if dev != guess:
+                    self._zone_for(guess).free(off)
+                    if best_effort:
+                        off = self._account_alloc(nb, dev)
+                        if off is None:
+                            del staged         # rare double-guess miss
+                            return host_val
+                    else:
                         off = self._reserve(nb, protect, dev)
-                    except MemoryError:
-                        raise                  # entry keeps host_val
-                e["offset"] = off
-                e["device"] = dev
-                if staged is not host_val:
-                    e["value"] = staged
-                    self.stats["stage_in"] += 1
-                    self.stats["bytes_staged"] += nb
+                self._stage_dev = dev
+                e["offset"], e["device"] = off, dev
+                e["value"] = staged
+                self.stats["stage_in"] += 1
+                self.stats["bytes_staged"] += nb
             return e["value"]
 
     def put(self, key: Hashable, value: Any,

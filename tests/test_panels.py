@@ -68,7 +68,7 @@ def test_panel_requires_wave_fuser():
 
 
 def test_panel_geometry_slices():
-    g = PanelGeometry(mb=32, nb=32, mt=4, nt=4)
+    g = PanelGeometry(name="A", mb=32, nb=32, mt=4, nt=4)
     assert g.rows(2) == slice(64, 96)
 
 
@@ -184,3 +184,63 @@ def test_segmented_reuses_segments_across_sizes():
     ex2.run_tile_dict_segmented(ex2.make_tiles())
     added = len(ex2._segments) - n_small
     assert added <= 8, added              # only new bucket sizes appear
+
+
+# ---------------------------------------------------------- multi-collection
+
+def test_panel_gemm_multi_collection():
+    """GEMM through the panel executor: three transposed stores, one
+    rank-nb dense update per k wave — the multi-collection case of the
+    wave_fuser contract."""
+    from parsec_tpu.algorithms.gemm import build_gemm_ptg
+
+    rng = np.random.default_rng(3)
+    A_h = rng.standard_normal((192, 256)).astype(np.float32)
+    B_h = rng.standard_normal((256, 128)).astype(np.float32)
+    C_h = rng.standard_normal((192, 128)).astype(np.float32)
+    A = TiledMatrix.from_array(A_h.copy(), 64, 64, name="A")
+    B = TiledMatrix.from_array(B_h.copy(), 64, 64, name="B")
+    C = TiledMatrix.from_array(C_h.copy(), 64, 64, name="C")
+    ex = PanelExecutor(plan_taskpool(build_gemm_ptg(A, B, C)))
+    assert isinstance(ex.geom, dict) and set(ex.geom) == {"A", "B", "C"}
+    ex.run()
+    assert np.allclose(C.to_array(), A_h @ B_h + C_h, atol=1e-3)
+    # read-only stores never written back
+    assert np.array_equal(A.to_array(), A_h)
+
+
+def test_panel_gemm_rectangular_nonuniform_tiles():
+    from parsec_tpu.algorithms.gemm import build_gemm_ptg
+
+    rng = np.random.default_rng(4)
+    A_h = rng.standard_normal((128, 96)).astype(np.float32)
+    B_h = rng.standard_normal((96, 64)).astype(np.float32)
+    C_h = np.zeros((128, 64), np.float32)
+    A = TiledMatrix.from_array(A_h.copy(), 64, 32, name="A")
+    B = TiledMatrix.from_array(B_h.copy(), 32, 64, name="B")
+    C = TiledMatrix.from_array(C_h.copy(), 64, 64, name="C")
+    ex = PanelExecutor(plan_taskpool(build_gemm_ptg(A, B, C)))
+    ex.run()
+    assert np.allclose(C.to_array(), A_h @ B_h, atol=1e-3)
+
+
+def test_panel_gemm_matches_tile_dict():
+    from parsec_tpu.algorithms.gemm import build_gemm_ptg
+
+    rng = np.random.default_rng(5)
+    A_h = rng.standard_normal((128, 128)).astype(np.float32)
+    B_h = rng.standard_normal((128, 128)).astype(np.float32)
+    C_h = rng.standard_normal((128, 128)).astype(np.float32)
+
+    C1 = TiledMatrix.from_array(C_h.copy(), 64, 64, name="C")
+    PanelExecutor(plan_taskpool(build_gemm_ptg(
+        TiledMatrix.from_array(A_h.copy(), 64, 64, name="A"),
+        TiledMatrix.from_array(B_h.copy(), 64, 64, name="B"),
+        C1))).run()
+
+    C2 = TiledMatrix.from_array(C_h.copy(), 64, 64, name="C")
+    WavefrontExecutor(plan_taskpool(build_gemm_ptg(
+        TiledMatrix.from_array(A_h.copy(), 64, 64, name="A"),
+        TiledMatrix.from_array(B_h.copy(), 64, 64, name="B"),
+        C2))).run()
+    assert np.allclose(C1.to_array(), C2.to_array(), atol=1e-4)
