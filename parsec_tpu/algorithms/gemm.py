@@ -68,8 +68,6 @@ def _make_gemm_wave_fuser(alpha: float, beta: float):
         import jax.numpy as jnp
         from ..ops.tile_kernels import matmul_precision
 
-        if not isinstance(geoms, dict):
-            return None                # GEMM always has A/B/C stores
         if sorted(g.tc.name for g in wave) != ["GEMM"]:
             return None
         (grp,) = wave

@@ -197,7 +197,7 @@ def _fuser_helpers(geom):
     return jnp, mm, tile_chol
 
 
-def _potrf_wave_fuser(wave, geom):
+def _potrf_wave_fuser(wave, geoms):
     """Lower one right-looking POTRF wave to Aᵀ-dense ops
     (compiled.panels contract).
 
@@ -209,6 +209,7 @@ def _potrf_wave_fuser(wave, geom):
     task lists (never wave-index arithmetic); unrecognized waves return
     None.
     """
+    (geom,) = geoms.values()      # single-collection DAG
     jnp, mm, tile_chol = _fuser_helpers(geom)
     names = sorted(g.tc.name for g in wave)
     mb, nb = geom.mb, geom.nb
@@ -427,13 +428,14 @@ def build_potrf_left(A: TiledMatrix) -> ptg.Taskpool:
     return tp
 
 
-def _potrf_left_wave_fuser(wave, geom):
+def _potrf_left_wave_fuser(wave, geoms):
     """Lower one left-looking POTRF wave to Aᵀ-dense ops.
 
     Wave shapes per step k: [UPDATE(·,k)] → one matmul applying every
     prior panel's contribution to block-column k; [POTRF(k)] → diagonal
     chol (inverse stashed in the carry); [TRSM(·,k)] → one panel solve
     via the stashed inverse."""
+    (geom,) = geoms.values()      # single-collection DAG
     jnp, mm, tile_chol = _fuser_helpers(geom)
     names = sorted(g.tc.name for g in wave)
     mb, nb = geom.mb, geom.nb

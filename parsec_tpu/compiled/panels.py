@@ -40,9 +40,9 @@ A wave_fuser has signature::
 taking/returning the executor state — a dict with one transposed dense
 array per collection, keyed by collection name (``geom.name``); fusers
 may stash extra carry entries (underscore-prefixed by convention, e.g. a
-factored diagonal inverse consumed by the next wave). Multi-collection
-taskpools receive ``geom`` as a ``{name: PanelGeometry}`` dict. Return
-None to
+factored diagonal inverse consumed by the next wave). ``geom`` is always
+the ``{name: PanelGeometry}`` dict; single-collection fusers unpack
+their one entry. Return None to
 reject a wave (the executor then refuses, naming it — no silent
 fallback; a hybrid would reintroduce the copies this path avoids).
 """
@@ -102,10 +102,10 @@ class PanelExecutor:
             name: PanelGeometry(name=name, mb=dc.mb, nb=dc.nb,
                                 mt=dc.mt, nt=dc.nt)
             for name, dc in plan.collections.items()}
-        # single-collection pools get the bare geometry (the common
-        # case; multi-collection fusers receive the dict)
-        geom_arg = (next(iter(self.geoms.values()))
-                    if len(self.geoms) == 1 else self.geoms)
+        # fusers always receive the {name: PanelGeometry} dict —
+        # uniform, no type sniffing (single-collection fusers unpack
+        # their one entry)
+        geom_arg = self.geoms
         self.geom = geom_arg
         # lower every wave up front — planning errors surface at build
         # time, not mid-trace

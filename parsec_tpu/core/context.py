@@ -51,9 +51,10 @@ class ExecutionStream:
     """Per-worker execution stream (reference parsec_execution_stream_t)."""
 
     __slots__ = ("context", "th_id", "vp_id", "sched_obj", "next_task",
-                 "thread", "stats", "_vp_peers", "_steal_order")
+                 "thread", "stats", "_vp_peers", "_steal_order", "infos")
 
     def __init__(self, context: "Context", th_id: int, vp_id: int):
+        from ..utils.info import InfoArray, per_stream_infos
         self.context = context
         self.th_id = th_id
         self.vp_id = vp_id
@@ -64,6 +65,8 @@ class ExecutionStream:
                       "stolen": 0}
         self._vp_peers = None        # cached steal orders (sched/base.py)
         self._steal_order = None
+        # extensible per-stream info slots (parsec_internal.h:688-702)
+        self.infos = InfoArray(per_stream_infos, self)
 
 
 def _parse_vpmap(nb_cores: int) -> List[int]:

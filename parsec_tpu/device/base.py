@@ -32,6 +32,9 @@ class Device:
         self.weight = 1.0
         self.load = 0.0
         self._lock = threading.Lock()
+        # extensible per-device info slots (parsec_per_device_infos)
+        from ..utils.info import InfoArray, per_device_infos
+        self.infos = InfoArray(per_device_infos, self)
 
     def attach(self, registry: "Registry", index: int) -> None:
         self.registry = registry

@@ -199,29 +199,33 @@ class HBMManager:
                         off = self._reserve(nb, protect, dev)
                     e["offset"], e["device"] = off, dev
                     return host_val
-                # host value: reserve BEFORE staging — a failed
-                # best_effort probe must cost zero transfers (and never
-                # transiently exceed the physical budget). Placement is
-                # guessed as the last-staged device; on a mismatch the
-                # accounting moves to the actual zone afterwards.
+                # host value: probe free space on the GUESSED landing
+                # device first (no eviction!) so a failed best_effort
+                # probe costs zero transfers; eviction decisions are
+                # only ever made against the device the value actually
+                # lands on. The one-tile window between staging and
+                # reservation is the only transient physical overshoot.
                 guess = self._stage_dev or self.jax.devices()[0]
-                if best_effort:
-                    off = self._account_alloc(nb, guess)
-                    if off is None:
-                        return host_val        # no room: stay spilled
-                else:
-                    off = self._reserve(nb, protect, guess)
-                staged = self.jax.device_put(host_val)
+                off = self._account_alloc(nb, guess)
+                if off is None and best_effort:
+                    return host_val            # no room: stay spilled
+                try:
+                    staged = self.jax.device_put(host_val)
+                except Exception:
+                    if off is not None:        # never leak the probe
+                        self._zone_for(guess).free(off)
+                    raise
                 dev = self._device_of(staged)
-                if dev != guess:
+                if dev != guess and off is not None:
                     self._zone_for(guess).free(off)
+                    off = None
+                if off is None:
+                    off = self._account_alloc(nb, dev)
+                if off is None:
                     if best_effort:
-                        off = self._account_alloc(nb, dev)
-                        if off is None:
-                            del staged         # rare double-guess miss
-                            return host_val
-                    else:
-                        off = self._reserve(nb, protect, dev)
+                        del staged             # actual chip full too
+                        return host_val
+                    off = self._reserve(nb, protect, dev)
                 self._stage_dev = dev
                 e["offset"], e["device"] = off, dev
                 e["value"] = staged

@@ -1,0 +1,195 @@
+"""Standalone trace-reader tools (tools/profiling analog).
+
+The reference ships a reader suite around its binary traces:
+``dbpreader.c`` (C reader), ``dbp2xml``, ``dbp-dot2png``, ``dbp2mem``,
+and the Python/Cython ``pbt2ptt.pyx`` + ``profile2h5.py`` converters
+producing pandas HDF5 tables (SURVEY §2.13, 11 kLoC of tools/). This
+module is the TPU build's equivalent over the JSON traces written by
+:meth:`profiling.trace.Trace.dump_json` — usable as a library AND as a
+CLI::
+
+    python -m parsec_tpu.profiling.tools summary  rank0.json rank1.json
+    python -m parsec_tpu.profiling.tools chrome   out.json rank*.json
+    python -m parsec_tpu.profiling.tools csv      out.csv  rank*.json
+    python -m parsec_tpu.profiling.tools comms    rank*.json
+
+``summary`` = dbpreader's per-key statistics; ``chrome`` merges ranks
+into one Chrome/Perfetto timeline (pid = rank); ``csv`` is the
+profile2h5 pandas-table analog; ``comms`` reproduces check-comms.py's
+message-count/byte-sum report from the comm msg_size events.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Sequence, Tuple
+
+
+def load(path: str) -> Dict[str, Any]:
+    """One rank's dumped trace: {"dictionary": ..., "events": [...]}."""
+    with open(path) as fh:
+        d = json.load(fh)
+    if "events" not in d:
+        raise ValueError(f"{path}: not a parsec_tpu trace dump")
+    return d
+
+
+def load_ranks(paths: Sequence[str]) -> List[Dict[str, Any]]:
+    return [load(p) for p in paths]
+
+
+def _pair_durations(events: List[Dict]) -> Dict[str, List[float]]:
+    """Match begin/end pairs per (key, object) → seconds per key."""
+    open_begins: Dict[Tuple, float] = {}
+    durs: Dict[str, List[float]] = defaultdict(list)
+    for ev in events:
+        k = (ev["key"], ev.get("object"))
+        if ev["phase"] == "begin":
+            open_begins[k] = ev["t"]
+        elif ev["phase"] == "end" and k in open_begins:
+            durs[ev["key"]].append(ev["t"] - open_begins.pop(k))
+    return durs
+
+
+def summary(traces: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Per-key event counts and paired-duration statistics across ranks
+    (dbpreader-style)."""
+    out: Dict[str, Any] = {"ranks": len(traces), "keys": {}}
+    for rank, tr in enumerate(traces):
+        events = tr["events"]
+        counts: Dict[str, int] = defaultdict(int)
+        for ev in events:
+            counts[f"{ev['key']}:{ev['phase']}"] += 1
+        durs = _pair_durations(events)
+        for key, lst in durs.items():
+            row = out["keys"].setdefault(
+                key, {"pairs": 0, "total_s": 0.0, "max_s": 0.0})
+            row["pairs"] += len(lst)
+            row["total_s"] += sum(lst)
+            row["max_s"] = max(row["max_s"], max(lst))
+        out.setdefault("counts", []).append(dict(counts))
+    for row in out["keys"].values():
+        row["avg_s"] = row["total_s"] / max(row["pairs"], 1)
+        for f in ("total_s", "max_s", "avg_s"):
+            row[f] = round(row[f], 6)
+    return out
+
+
+def comms(traces: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """check-comms.py analog: activation counts and payload byte sums
+    from the comm msg_size events (reference asserts e.g. 100 activates
+    / 209,715,200 bytes for bw_test)."""
+    out = {}
+    for rank, tr in enumerate(traces):
+        sent = recv = bytes_sent = bytes_recv = 0
+        for ev in tr["events"]:
+            if not str(ev["key"]).startswith("comm_"):
+                continue
+            n = int(ev.get("info", {}).get("msg_size", 0))
+            if ev["phase"] == "sent":
+                sent += 1
+                bytes_sent += n
+            elif ev["phase"] == "recv":
+                recv += 1
+                bytes_recv += n
+        out[f"rank{rank}"] = {
+            "activations_sent": sent, "activations_recv": recv,
+            "bytes_sent": bytes_sent, "bytes_recv": bytes_recv}
+    out["total"] = {
+        k: sum(r[k] for r in out.values() if isinstance(r, dict))
+        for k in ("activations_sent", "activations_recv",
+                  "bytes_sent", "bytes_recv")}
+    return out
+
+
+def merge_chrome(traces: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Multi-rank Chrome/Perfetto timeline: pid = rank, tid = stream."""
+    out = []
+    for rank, tr in enumerate(traces):
+        open_begins: Dict[Tuple, Dict] = {}
+        for ev in tr["events"]:
+            us = ev["t"] * 1e6
+            k = (ev["key"], ev.get("object"))
+            if ev["phase"] == "begin":
+                open_begins[k] = ev
+            elif ev["phase"] == "end" and k in open_begins:
+                b = open_begins.pop(k)
+                out.append({"name": ev["key"], "ph": "X", "pid": rank,
+                            "tid": b["stream"], "ts": b["t"] * 1e6,
+                            "dur": us - b["t"] * 1e6,
+                            "args": ev.get("info") or {}})
+            else:
+                out.append({"name": f"{ev['key']}:{ev['phase']}",
+                            "ph": "i", "pid": rank, "tid": ev["stream"],
+                            "ts": us, "s": "t",
+                            "args": ev.get("info") or {}})
+    return {"traceEvents": out}
+
+
+def to_rows(traces: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Flat table rows (profile2h5 pandas analog): one row per event
+    with the info dict splatted into ``info_*`` columns."""
+    rows = []
+    for rank, tr in enumerate(traces):
+        for ev in tr["events"]:
+            row = {"rank": rank, "key": ev["key"], "phase": ev["phase"],
+                   "t": ev["t"], "stream": ev["stream"],
+                   "object": str(ev.get("object"))}
+            for ik, iv in (ev.get("info") or {}).items():
+                row[f"info_{ik}"] = iv
+            rows.append(row)
+    return rows
+
+
+def write_csv(path: str, rows: List[Dict[str, Any]]) -> None:
+    import csv
+    cols: List[str] = []
+    for r in rows:
+        for c in r:
+            if c not in cols:
+                cols.append(c)
+    with open(path, "w", newline="") as fh:
+        w = csv.DictWriter(fh, fieldnames=cols)
+        w.writeheader()
+        w.writerows(rows)
+
+
+def main(argv: Sequence[str] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="parsec_tpu.profiling.tools",
+        description="trace reader suite (tools/profiling analog)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    s = sub.add_parser("summary", help="per-key stats (dbpreader)")
+    s.add_argument("traces", nargs="+")
+    c = sub.add_parser("chrome", help="merged Chrome/Perfetto timeline")
+    c.add_argument("out")
+    c.add_argument("traces", nargs="+")
+    v = sub.add_parser("csv", help="flat event table (profile2h5)")
+    v.add_argument("out")
+    v.add_argument("traces", nargs="+")
+    m = sub.add_parser("comms", help="comm volume report (check-comms)")
+    m.add_argument("traces", nargs="+")
+    args = p.parse_args(argv)
+
+    traces = load_ranks(args.traces)
+    if args.cmd == "summary":
+        json.dump(summary(traces), sys.stdout, indent=1)
+        print()
+    elif args.cmd == "chrome":
+        with open(args.out, "w") as fh:
+            json.dump(merge_chrome(traces), fh)
+        print(f"wrote {args.out}")
+    elif args.cmd == "csv":
+        write_csv(args.out, to_rows(traces))
+        print(f"wrote {args.out}")
+    elif args.cmd == "comms":
+        json.dump(comms(traces), sys.stdout, indent=1)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,143 @@
+"""Info registry (class/info.c analog) and the standalone trace-reader
+suite (tools/profiling analog)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import parsec_tpu as parsec
+from parsec_tpu.profiling import tools
+from parsec_tpu.utils.info import InfoArray, InfoRegistry
+
+
+# ------------------------------------------------------------------ info
+
+def test_info_register_and_lazy_construct():
+    reg = InfoRegistry()
+    sid = reg.register("steals_hist", constructor=lambda carrier: [])
+    assert reg.lookup("steals_hist") == sid
+    carrier = object()
+    arr = InfoArray(reg, carrier)
+    lst = arr.get("steals_hist")
+    lst.append(3)
+    assert arr.get(sid) == [3]          # same lazy object, by id too
+
+
+def test_info_reregister_keeps_slot():
+    reg = InfoRegistry()
+    a = reg.register("x")
+    b = reg.register("x", constructor=lambda c: 42)
+    assert a == b
+    assert InfoArray(reg, None).get("x") == 42
+
+
+def test_info_unknown_slot():
+    reg = InfoRegistry()
+    arr = InfoArray(reg, None)
+    assert arr.get("nope", default="d") == "d"
+    with pytest.raises(KeyError):
+        arr.set("nope", 1)
+
+
+def test_per_stream_and_device_infos_wired():
+    from parsec_tpu.utils.info import per_device_infos, per_stream_infos
+
+    sid = per_stream_infos.register("test_marks",
+                                    constructor=lambda es: {"hits": 0})
+    did = per_device_infos.register("test_dev", constructor=lambda d: d.name)
+    ctx = parsec.init(nb_cores=2)
+    try:
+        es = ctx.streams[0]
+        es.infos.get("test_marks")["hits"] += 1
+        assert es.infos.get(sid)["hits"] == 1
+        dev = ctx.devices.devices[0]
+        assert dev.infos.get("test_dev") == dev.name
+    finally:
+        parsec.fini(ctx)
+        per_stream_infos.unregister("test_marks")
+        per_device_infos.unregister("test_dev")
+
+
+# ----------------------------------------------------------------- tools
+
+@pytest.fixture
+def trace_file(tmp_path):
+    """Run a small traced taskpool and dump its trace."""
+    from parsec_tpu.dsl import ptg
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.profiling.trace import Trace
+    from parsec_tpu.utils import mca_param
+
+    S = LocalCollection("S", {(i,): 0 for i in range(6)})
+    tp = ptg.Taskpool("tools_t", N=6, S=S)
+    tp.task_class(
+        "W", params=("i",),
+        space=lambda g: ((i,) for i in range(g.N)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, i: (g.S, (i,)))],
+            outs=[ptg.Out(data=lambda g, i: (g.S, (i,)))])])
+
+    @tp.get_task_class("W").body_cpu
+    def w(task, x):
+        return x + 1
+
+    ctx = parsec.init(nb_cores=2)
+    Trace().install(ctx)
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    path = tmp_path / "rank0.json"
+    ctx.trace.dump_json(str(path))
+    parsec.fini(ctx)
+    return str(path)
+
+
+def test_tools_summary(trace_file):
+    s = tools.summary(tools.load_ranks([trace_file]))
+    assert s["ranks"] == 1
+    assert s["keys"]["task"]["pairs"] == 6
+    assert s["keys"]["task"]["total_s"] > 0
+
+
+def test_tools_rows_and_csv(tmp_path, trace_file):
+    rows = tools.to_rows(tools.load_ranks([trace_file]))
+    assert any(r["key"] == "task" and r["phase"] == "end" for r in rows)
+    out = tmp_path / "t.csv"
+    tools.write_csv(str(out), rows)
+    head = out.read_text().splitlines()
+    assert head[0].startswith("rank,key,phase")
+    assert len(head) == len(rows) + 1
+
+
+def test_tools_chrome_merge(trace_file):
+    merged = tools.merge_chrome(tools.load_ranks([trace_file,
+                                                  trace_file]))
+    evs = merged["traceEvents"]
+    assert {e["pid"] for e in evs} == {0, 1}     # one pid per rank
+    assert sum(1 for e in evs if e["ph"] == "X" and e["name"] == "task") \
+        == 12
+
+
+def test_tools_cli(tmp_path, trace_file):
+    r = subprocess.run(
+        [sys.executable, "-m", "parsec_tpu.profiling.tools",
+         "summary", trace_file],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["keys"]["task"]["pairs"] == 6
+
+    out = tmp_path / "c.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "parsec_tpu.profiling.tools",
+         "chrome", str(out), trace_file],
+        capture_output=True, text=True, cwd="/root/repo")
+    assert r.returncode == 0, r.stderr
+    assert json.loads(out.read_text())["traceEvents"]
+
+
+def test_tools_comms_report(trace_file):
+    rep = tools.comms(tools.load_ranks([trace_file]))
+    assert rep["total"]["activations_sent"] == 0    # single process
