@@ -1,0 +1,153 @@
+"""Flash attention as a hand-written pallas TPU kernel.
+
+The framework's hot-op escape hatch: XLA fuses most elementwise work
+into matmuls, but attention's online-softmax recurrence leaves HBM
+round-trips between the s = QKᵀ, softmax, and PV stages that XLA does
+not eliminate at long sequence lengths. This kernel keeps the whole
+per-(head, q-block) recurrence in VMEM scratch across the KV grid
+dimension — the standard flash-attention tiling (Dao et al. 2022)
+expressed in pallas (see /opt/skills/guides/pallas_guide.md; reference
+runtime analog: user .jdf BODY CUDA kernels — the runtime schedules
+them, the kernel owns the device).
+
+Public entry: :func:`flash_attention` over ``(S, H, dh)`` operands (the
+layout `compiled.ring_attention` uses). Falls back to pallas interpret
+mode off-TPU so the same code path is exercised by CPU tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils import mca_param
+
+mca_param.register("ops.flash_attention_block_q", 512,
+                   help="flash-attention query block size")
+mca_param.register("ops.flash_attention_block_k", 512,
+                   help="flash-attention key/value block size")
+
+_NEG = -1e30          # finite -inf: exp() stays NaN-free for fully
+#                       masked rows (same convention as ring_attention)
+_MINLANE = 128        # f32 lane tile: scalar-per-row state is stored
+#                       broadcast to a full lane tile
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+               scale: float, causal: bool, bq: int, bk: int):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    qi = pl.program_id(1)
+    # causal: KV blocks entirely in the future contribute nothing —
+    # skip their compute outright (halves the causal work)
+    live = (qi + 1) * bq > ki * bk if causal else ki >= 0
+
+    @pl.when(live)
+    def _fold():
+        q = q_ref[0]                 # (bq, dh)
+        k = k_ref[0]                 # (bk, dh)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0)
+            kpos = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            # fully-masked rows: keep p exactly zero (m_new == _NEG)
+            p = jnp.where(s > _NEG / 2, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1)
+        acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
+            p, v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:, 0]
+        o_ref[0] = (acc_ref[...] /
+                    jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+# pallas imports deferred so the module imports on builds without pallas
+try:  # pragma: no cover - exercised implicitly by every call
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAVE_PALLAS = True
+except Exception:  # noqa: BLE001
+    _HAVE_PALLAS = False
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 0, block_k: int = 0,
+                    interpret: Optional[bool] = None):
+    """Softmax attention over ``(S, H, dh)`` operands via the pallas
+    flash kernel. ``interpret=None`` auto-selects interpret mode off-TPU
+    (so CPU tests run the identical kernel)."""
+    if not _HAVE_PALLAS:
+        raise RuntimeError("pallas unavailable in this jax build")
+    S, H, dh = q.shape
+    Sk = k.shape[0]
+    scale = scale if scale is not None else 1.0 / math.sqrt(dh)
+    bq = block_q or int(mca_param.get("ops.flash_attention_block_q", 512))
+    bk = block_k or int(mca_param.get("ops.flash_attention_block_k", 512))
+    bq = min(bq, S)
+    bk = min(bk, Sk)
+    if S % bq or Sk % bk:
+        raise ValueError(f"sequence lengths ({S}, {Sk}) must divide the "
+                         f"block sizes ({bq}, {bk})")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # (S, H, dh) → (H, S, dh); pad head dim to the f32 lane tile
+    qT = jnp.swapaxes(q, 0, 1).astype(jnp.float32)
+    kT = jnp.swapaxes(k, 0, 1).astype(jnp.float32)
+    vT = jnp.swapaxes(v, 0, 1).astype(jnp.float32)
+    dh_p = max(_MINLANE, ((dh + _MINLANE - 1) // _MINLANE) * _MINLANE)
+    if dh_p != dh:
+        pad = [(0, 0), (0, 0), (0, dh_p - dh)]
+        qT, kT, vT = (jnp.pad(x, pad) for x in (qT, kT, vT))
+
+    kern = functools.partial(_fa_kernel, scale=scale, causal=causal,
+                             bq=bq, bk=bk)
+    out = pl.pallas_call(
+        kern,
+        grid=(H, S // bq, Sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, dh_p), lambda h, qi, ki: (h, qi, 0)),
+            pl.BlockSpec((1, bk, dh_p), lambda h, qi, ki: (h, ki, 0)),
+            pl.BlockSpec((1, bk, dh_p), lambda h, qi, ki: (h, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, dh_p),
+                               lambda h, qi, ki: (h, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((H, S, dh_p), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, dh_p), jnp.float32),
+            pltpu.VMEM((bq, _MINLANE), jnp.float32),
+            pltpu.VMEM((bq, _MINLANE), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qT, kT, vT)
+    return jnp.swapaxes(out[:, :, :dh], 0, 1)
