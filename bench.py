@@ -50,6 +50,11 @@ if _plat:
     import jax
     jax.config.update("jax_platforms", _plat)
 
+# Persistent XLA compile cache: the panel-fused programs compile in
+# ~100-200 s through the tunnel; cached re-compiles land in seconds.
+from parsec_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
+enable_compile_cache()
+
 
 def _timed(f):
     t0 = time.perf_counter()
@@ -233,6 +238,71 @@ def _measure_extras(jax, jnp, np, on_tpu):
                         "run_s": round(dt, 3)}
     except Exception as exc:  # noqa: BLE001
         out["geqrf"] = {"error": str(exc)[:200]}
+
+    # -- dgeqrf panel-fused flagship form (blocked Householder) -----------
+    # PANEL(k)/REDUCE/APPLY taskpool lowered by the PanelExecutor: the
+    # whole trailing update per step is two large MXU matmuls
+    # (CholeskyQR2 panel + exact orthogonal-completion reconstruction).
+    try:
+        from parsec_tpu.algorithms.geqrf import build_geqrf_hh
+        from parsec_tpu.compiled.panels import PanelExecutor
+        nq, nbq = (32768, 1024) if on_tpu else (256, 64)
+        nq = int(os.environ.get("PARSEC_BENCH_QR_N", nq))
+        Aq = TiledMatrix(nq, nq, nbq, nbq, name="A")
+        exq = PanelExecutor(plan_taskpool(build_geqrf_hh(Aq)))
+
+        def gen_q(key):
+            return {"A": jax.random.normal(key, (nq, nq), _jnp.float32)}
+
+        gen_qj = jax.jit(gen_q)
+
+        def run_q(st):
+            o = exq.run_state(st)
+            return _jnp.sum(o["A"]), o
+
+        red_q = jax.jit(run_q, donate_argnums=0)
+        t0 = time.perf_counter()
+        tot, oq = red_q(gen_qj(jax.random.PRNGKey(7)))
+        float(tot)
+        compile_q = time.perf_counter() - t0
+        del oq                      # keep HBM headroom for the timed runs
+        qs = []
+        for i in range(3):
+            st = gen_qj(jax.random.PRNGKey(7))
+            jax.block_until_ready(st)
+            t0 = time.perf_counter()
+            float(lat_f(_jnp.float32(i)))
+            lq = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            tot, oq = red_q(st)
+            float(tot)
+            qs.append(max(time.perf_counter() - t0 - lq, 1e-6))
+            if i < 2:
+                del oq
+        dtq = sorted(qs)[1]
+
+        # residual probe: ||RᵀRx − AᵀAx|| / ||AᵀAx|| (orthogonal-
+        # invariant QR identity; A regenerated from the same key)
+        def resid_q(o, key):
+            x = jax.random.normal(jax.random.fold_in(key, 1234), (nq, 8),
+                                  _jnp.float32)
+            A0t = gen_q(key)["A"]          # the Aᵀ store the DAG factored
+            AtAx = A0t @ (A0t.T @ x)
+            R = o["A"].T                   # R + zeros below (DAG contract)
+            RtRx = R.T @ (R @ x)
+            return _jnp.linalg.norm(RtRx - AtAx) / _jnp.linalg.norm(AtAx)
+
+        errq = float(jax.jit(resid_q)(oq, jax.random.PRNGKey(7)))
+        del oq
+        out["geqrf_fused"] = {
+            "n": nq, "tile": nbq, "taskpool": "geqrf_hh",
+            "executor": "panel_fused",
+            "gflops": round(geqrf_flops(nq, nq) / dtq / 1e9, 1),
+            "run_s": round(dtq, 4),
+            "compile_s": round(compile_q, 2),
+            "rel_residual_check": float(f"{errq:.3e}")}
+    except Exception as exc:  # noqa: BLE001
+        out["geqrf_fused"] = {"error": str(exc)[:200]}
 
     # -- transformer FFN+attention: compiled ring-attention step ----------
     try:

@@ -58,6 +58,10 @@ class WavefrontPlan:
     collections: Dict[str, Any]              # name -> collection
     slot_maps: Dict[str, Dict[Tuple, int]]   # name -> (tile key -> slot)
     n_tasks: int = 0
+    # True when some non-CTL flow carries task->task values with no tile
+    # placement: only executors that keep values in carry state (the
+    # panel-fused path) or the host runtime can run such plans
+    has_value_flows: bool = False
 
     @property
     def n_waves(self) -> int:
@@ -71,6 +75,15 @@ def _flow_tile(tc: PTGTaskClass, fname: str, locals) -> Tuple[Any, Tuple]:
             f"compiled mode requires FlowSpec.tile on {tc.name}.{fname}")
     dc, key = spec.tile(tc.tp.g, *locals)
     return dc, tuple(key)
+
+
+def _is_value_flow(tc: PTGTaskClass, f) -> bool:
+    """Non-CTL flow with no tile placement: a task->task value (e.g. a
+    whole factored panel) that never lives in a collection. Such flows
+    still level the DAG (their edges order waves) but have no slots; the
+    per-tile executors cannot feed them — wave fusers carry them in
+    state, the host runtime passes them with activations."""
+    return (not f.is_ctl) and tc.specs[f.name].tile is None
 
 
 def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
@@ -159,12 +172,17 @@ def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
             raise ValueError(f"two collections share the name {dc.name!r}")
         return dc.name
 
+    has_value_flows = any(
+        _is_value_flow(tc, f)
+        for tc in tp.task_classes for f in tc.flows)
     for w, wave in enumerate(waves):
         for grp in wave:
             tc = grp.tc
             in_fl = [f for f in tc.flows if not f.is_ctl
+                     and not _is_value_flow(tc, f)
                      and (f.access & FlowAccess.READ)]
             out_fl = [f for f in tc.flows if not f.is_ctl
+                      and not _is_value_flow(tc, f)
                       and (f.access & FlowAccess.WRITE)]
             ins: Dict[str, List[int]] = {f.name: [] for f in in_fl}
             outs: Dict[str, List[int]] = {f.name: [] for f in out_fl}
@@ -199,7 +217,8 @@ def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
         for grp in wave:
             for p in grp.tasks:
                 for f in grp.tc.flows:
-                    if f.is_ctl or not (f.access & FlowAccess.WRITE):
+                    if f.is_ctl or not (f.access & FlowAccess.WRITE) \
+                            or _is_value_flow(grp.tc, f):
                         continue
                     dc, key = _flow_tile(grp.tc, f.name, p)
                     tk = (dc.name, key)
@@ -212,7 +231,7 @@ def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
     for (i, j, fname) in edges:
         tc_j, p_j = tasks[j]
         f_j = tc_j.flow_by_name[fname]
-        if f_j.is_ctl:
+        if f_j.is_ctl or _is_value_flow(tc_j, f_j):
             continue
         dc, key = _flow_tile(tc_j, fname, p_j)
         lw, lr = int(level[i]), int(level[j])
@@ -226,7 +245,8 @@ def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
                     f"use the host runtime for this DAG")
 
     plan = WavefrontPlan(taskpool=tp, waves=waves, collections=collections,
-                         slot_maps=slot_maps, n_tasks=n)
+                         slot_maps=slot_maps, n_tasks=n,
+                         has_value_flows=has_value_flows)
     debug_verbose(3, "wavefront", "planned %s: %d tasks, %d waves",
                   tp.name, n, len(waves))
     return plan
@@ -260,6 +280,13 @@ class WavefrontExecutor:
                 "the collection directly (CTL-gather pattern); per-tile "
                 "compiled execution cannot feed them — use the "
                 "PanelExecutor (compiled.panels) or the host runtime")
+        if plan.has_value_flows:
+            raise ValueError(
+                f"taskpool {plan.taskpool.name!r} carries task->task "
+                "values with no tile placement; per-tile compiled "
+                "execution cannot route them — use the PanelExecutor "
+                "(wave fusers keep values in carry state) or the host "
+                "runtime")
         self.jax, self.jnp = jax, jnp
         self.plan = plan
         self.bucket = bucket

@@ -179,6 +179,89 @@ def geqrt_tile(A):
     return Q.astype(A.dtype), R.astype(A.dtype)
 
 
+# ---- panel QR (whole block-column at once, MXU-formulated) -------------
+# The compiled GEQRF path factors an entire (mk x nb) panel per step.
+# XLA's blocked-Householder QR serializes badly on TPU (measured ~20 ms
+# at 16384x1024 where the CholeskyQR2 pipeline below takes ~5 ms), so the
+# panel kernel is CholeskyQR2 — two Gram+Cholesky orthogonalization
+# rounds, everything but the nb-sized factorizations a matmul — followed
+# by an exact orthogonal-completion reconstruction:
+#
+#     given the reduced factor Q_r (mk x nb) with top block Q1, set
+#         V = Q_r - E1,   X = I - Q1
+#     then  H = I - V X^-T V^T  satisfies  H E1 = Q_r  (exact algebra:
+#     V^T E1 = (Q1 - I)^T = -X^T) and
+#           H^T H = I + V X^-1 (Q_r^T Q_r - I) X^-T V^T
+#
+# i.e. H is orthogonal exactly when Q_r is orthonormal — CholeskyQR2's
+# job — and the trailing update H^T C = C - V X^-T (V^T C) is two large
+# matmuls. This is the Householder-reconstruction idea of Ballard et al.
+# / Yamamoto (public algorithm), reformulated around an explicit nb x nb
+# inverse instead of an unpivoted LU (X's diagonal is >= 1 after the
+# sign fix below, the same conditioning argument). Reference analog: the
+# GEQRT+TSQRT panel chain of dplasma's dgeqrf
+# (reference parsec/data_dist/matrix/ + BASELINE.md dgeqrf config).
+
+mca_param.register("ops.panel_qr", "cholqr2",
+                   help="panel QR kernel for the fused GEQRF path: "
+                        "cholqr2 (all-matmul, needs full column rank) | "
+                        "xla (jnp.linalg.qr, slower, more robust)")
+
+
+def panel_qr_tile(Pt):
+    """Factor a panel given TRANSPOSED ``Pt`` (nb x mk, P = Ptᵀ).
+
+    Returns ``(Vt, Xinv, R)`` with ``Vt`` (nb x mk) the transposed
+    reconstruction factor, ``Xinv = X⁻¹`` (nb x nb), and ``R`` (nb x nb
+    upper) such that ``H = I - Vtᵀ·Xinvᵀ·Vt`` is orthogonal,
+    ``Hᵀ·P = [R; 0]`` and ``H·E1 = Q_r``. All heavy ops are matmuls at
+    f32 accumulation.
+    """
+    nb = Pt.shape[0]
+    Pt = Pt.astype(jnp.float32)
+    if str(mca_param.get("ops.panel_qr", "cholqr2")) == "xla":
+        Q, R = jnp.linalg.qr(Pt.T)      # reduced: (mk, nb), (nb, nb)
+        Qt = Q.T
+    else:
+        # CholeskyQR2: Q1 = P L1^-T, Q = Q1 L2^-T, R = (L1 L2)^T.
+        # Grams accumulate in f32; the nb-sized chol/solves are exact.
+        G1 = jnp.matmul(Pt, Pt.T, preferred_element_type=jnp.float32,
+                        precision=_prec())
+        L1 = jnp.linalg.cholesky(G1)
+        Q1t = jax.scipy.linalg.solve_triangular(L1, Pt, lower=True)
+        G2 = jnp.matmul(Q1t, Q1t.T, preferred_element_type=jnp.float32,
+                        precision=_prec())
+        L2 = jnp.linalg.cholesky(G2)
+        Qt = jax.scipy.linalg.solve_triangular(L2, Q1t, lower=True)
+        # nb x nb product: always full f32 — R must match the H the
+        # trailing update applies, and this matmul's cost is noise
+        R = jnp.matmul(L1, L2, preferred_element_type=jnp.float32,
+                       precision="highest").T
+    # sign fix: scale columns of Q (rows of Qt) so diag(Q1) <= 0 and
+    # X = I - Q1 has diagonal >= 1 (well-conditioned inverse); R's rows
+    # absorb the signs, so Q·R is unchanged
+    d = jnp.diagonal(Qt[:, :nb])
+    s = jnp.where(d >= 0, -1.0, 1.0).astype(jnp.float32)
+    Qt = s[:, None] * Qt
+    R = s[:, None] * R
+    Vt = Qt.at[:, :nb].add(-jnp.eye(nb, dtype=jnp.float32))
+    X = jnp.eye(nb, dtype=jnp.float32) - Qt[:, :nb].T
+    Xinv = jnp.linalg.inv(X)
+    return Vt, Xinv, R
+
+
+def panel_qr_apply(Vt, Xinv, Ct):
+    """Trailing update in transposed storage: given ``Ct = Cᵀ``
+    (ncols x mk), return ``(Hᵀ·C)ᵀ = Ct - (Ct·Vtᵀ)·Xinvᵀ·Vt`` — two
+    large matmuls plus one small (ncols x nb)·(nb x nb)."""
+    W = jnp.matmul(Ct, Vt.T, preferred_element_type=jnp.float32,
+                   precision=_prec())
+    W = jnp.matmul(W, Xinv.T, preferred_element_type=jnp.float32,
+                   precision=_prec())
+    return (Ct - jnp.matmul(W, Vt, preferred_element_type=jnp.float32,
+                            precision=_prec())).astype(Ct.dtype)
+
+
 def unmqr_tile(Q, C):
     """C ← Qᵀ·C (apply a diagonal-tile factor to a row-panel tile)."""
     out = jnp.matmul(Q.T, C, preferred_element_type=jnp.float32,

@@ -1,0 +1,36 @@
+"""Persistent XLA compilation cache.
+
+The flagship panel-fused programs compile in ~100-200 s through the
+remote-tunnel backend; the persistent cache cuts warm re-compiles to
+seconds (measured 170 s -> 40 s for the 94-wave GEQRF program, 7 s ->
+2 s for small programs — the warm residue is cache deserialization).
+Reference analog: the reference pays its codegen cost once at ptgpp
+compile time; here the XLA binary is the generated artifact, so caching
+it across processes restores the same once-per-program economics.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache(path: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at ``path`` (default:
+    ``$PARSEC_COMPILE_CACHE`` or ``.xla_cache`` next to the repo root).
+    Set ``PARSEC_COMPILE_CACHE=0`` to disable. Safe to call repeatedly;
+    returns the cache dir in use (None when disabled)."""
+    env = os.environ.get("PARSEC_COMPILE_CACHE", "")
+    if env == "0":
+        return None
+    if path is None:
+        path = env or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), ".xla_cache")
+    import jax
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    try:
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except AttributeError:   # knob name varies across jax versions
+        pass
+    return path

@@ -126,3 +126,100 @@ def test_geqrf_run_sharded(rng):
 def test_geqrf_flops_positive():
     assert geqrf_flops(512, 512) > 0
     assert geqrf_flops(1024, 512) > geqrf_flops(512, 512)
+
+
+# ---- blocked-Householder (panel-fused) variant -------------------------
+
+def _check_qr_result(R, A_host, nb):
+    m, n = A_host.shape
+    for bi in range(m // nb):
+        for bj in range(n // nb):
+            blk = R[bi * nb:(bi + 1) * nb, bj * nb:(bj + 1) * nb]
+            if bi > bj:
+                np.testing.assert_allclose(blk, 0.0, atol=1e-4)
+    np.testing.assert_allclose(R.T @ R, A_host.T @ A_host,
+                               rtol=2e-3, atol=2e-2)
+
+
+def test_panel_qr_tile_identity(rng):
+    """The CholeskyQR2 + reconstruction kernel: H orthogonal,
+    H·E1 = Q_r, Hᵀ·P = [R; 0]."""
+    import jax.numpy as jnp
+    from parsec_tpu.ops.tile_kernels import panel_qr_tile
+    mk, nb = 96, 32
+    P = rng.standard_normal((mk, nb)).astype(np.float32)
+    Vt, Xinv, R = panel_qr_tile(jnp.asarray(P.T))
+    Vt_n, Xinv_n, R_n = (np.asarray(x) for x in (Vt, Xinv, R))
+    H = np.eye(mk, dtype=np.float32) - Vt_n.T @ Xinv_n.T @ Vt_n
+    np.testing.assert_allclose(H.T @ H, np.eye(mk), atol=1e-4)
+    HtP = H.T @ P
+    np.testing.assert_allclose(HtP[:nb], R_n, atol=1e-3)
+    np.testing.assert_allclose(HtP[nb:], 0.0, atol=1e-3)
+    np.testing.assert_allclose(np.tril(R_n, -1), 0.0, atol=1e-5)
+    # the public trailing-update kernel must agree with H's action
+    from parsec_tpu.ops.tile_kernels import panel_qr_apply
+    C = rng.standard_normal((mk, 48)).astype(np.float32)
+    got = np.asarray(panel_qr_apply(Vt, Xinv, jnp.asarray(C.T)))
+    np.testing.assert_allclose(got, (H.T @ C).T, atol=1e-3)
+
+
+def test_geqrf_hh_checker_square():
+    from parsec_tpu.algorithms.geqrf import build_geqrf_hh
+    A = TiledMatrix(4 * 16, 4 * 16, 16, 16, name="A")
+    ptg.check_taskpool(build_geqrf_hh(A))
+
+
+def test_geqrf_hh_checker_tall():
+    from parsec_tpu.algorithms.geqrf import build_geqrf_hh
+    A = TiledMatrix(6 * 16, 3 * 16, 16, 16, name="A")
+    ptg.check_taskpool(build_geqrf_hh(A))
+
+
+def test_geqrf_hh_rejects_nonsquare_tiles():
+    from parsec_tpu.algorithms.geqrf import build_geqrf_hh
+    A = TiledMatrix(64, 64, 32, 16, name="A")
+    with pytest.raises(ValueError):
+        build_geqrf_hh(A)
+
+
+@pytest.mark.parametrize("shape", [(96, 96), (128, 64)])
+def test_geqrf_hh_host_runtime(ctx, rng, shape):
+    from parsec_tpu.algorithms.geqrf import build_geqrf_hh
+    m, n = shape
+    nb = 32
+    A_host = rng.standard_normal((m, n)).astype(np.float32)
+    A = TiledMatrix.from_array(A_host.copy(), nb, nb, name="A")
+    ctx.add_taskpool(build_geqrf_hh(A))
+    assert ctx.wait(timeout=120)
+    _check_qr_result(A.to_array(), A_host, nb)
+
+
+@pytest.mark.parametrize("shape", [(128, 128), (160, 96)])
+def test_geqrf_hh_panel_fused(rng, shape):
+    """The fused path (PanelExecutor over the Aᵀ store) matches the QR
+    identity end-to-end."""
+    import jax
+    from parsec_tpu.algorithms.geqrf import build_geqrf_hh
+    from parsec_tpu.compiled.panels import PanelExecutor
+    from parsec_tpu.compiled.wavefront import plan_taskpool
+    m, n = shape
+    nb = 32
+    A_host = rng.standard_normal((m, n)).astype(np.float32)
+    A = TiledMatrix.from_array(A_host.copy(), nb, nb, name="A")
+    ex = PanelExecutor(plan_taskpool(build_geqrf_hh(A)))
+    out = jax.jit(ex.run_state)(ex.make_state())
+    ex.write_back(out)
+    _check_qr_result(A.to_array(), A_host, nb)
+
+
+def test_geqrf_hh_refused_by_tile_executor():
+    """Value flows + direct collection reads: the per-tile compiled
+    executors must refuse loudly."""
+    from parsec_tpu.algorithms.geqrf import build_geqrf_hh
+    from parsec_tpu.compiled.wavefront import (WavefrontExecutor,
+                                               plan_taskpool)
+    A = TiledMatrix(4 * 16, 4 * 16, 16, 16, name="A")
+    plan = plan_taskpool(build_geqrf_hh(A))
+    assert plan.has_value_flows
+    with pytest.raises(ValueError):
+        WavefrontExecutor(plan)
