@@ -317,8 +317,8 @@ def _measure_extras(jax, jnp, np, on_tpu):
         W1 = jnp.asarray(rng.standard_normal((D, F)) / 32, jnp.float32)
         W2 = jnp.asarray(rng.standard_normal((F, D)) / 32, jnp.float32)
 
-        def step(q):
-            o = ring_attention(q, k, v, mesh, axis="seq")
+        def step(q, impl="xla"):
+            o = ring_attention(q, k, v, mesh, axis="seq", impl=impl)
             x = o.reshape(o.shape[0], -1)
             h = jnp.maximum(x @ W1, 0.0)
             y = x + h @ W2
@@ -331,6 +331,17 @@ def _measure_extras(jax, jnp, np, on_tpu):
             "seq": S, "heads": H, "d_head": dh, "ffn": F,
             "compiled_gflops": round(flops / dt / 1e9, 1),
             "run_s": round(dt, 4)}
+        # same step with the pallas flash kernel as the ring's local
+        # block computation (ops.flash_attention wired via impl="flash").
+        # Own guard: a flash failure must not discard the xla numbers.
+        try:
+            ff = jax.jit(lambda q: step(q, impl="flash"))
+            dtf = chain_timed(ff, q, K=8)
+            out["transformer"]["flash_gflops"] = round(flops / dtf / 1e9, 1)
+            out["transformer"]["flash_run_s"] = round(dtf, 4)
+            out["transformer"]["flash_speedup"] = round(dt / dtf, 2)
+        except Exception as exc:  # noqa: BLE001
+            out["transformer"]["flash_error"] = str(exc)[:200]
     except Exception as exc:  # noqa: BLE001
         out["transformer"] = {"error": str(exc)[:200]}
     return out

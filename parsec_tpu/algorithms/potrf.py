@@ -40,6 +40,11 @@ def build_potrf(A: TiledMatrix) -> ptg.Taskpool:
     NT = A.nt
     if A.mt != A.nt:
         raise ValueError("POTRF needs a square tile grid")
+    if A.mb != A.nb:
+        # the wave fusers index the transposed store with nb-granular
+        # row panels and mb-granular columns interchangeably — non-
+        # square tiles would silently produce wrong slices
+        raise ValueError("POTRF needs square tiles (mb == nb)")
     tp = ptg.Taskpool("potrf", A=A, NT=NT)
 
     POTRF = tp.task_class(
@@ -318,6 +323,8 @@ def build_potrf_left(A: TiledMatrix) -> ptg.Taskpool:
     NT = A.nt
     if A.mt != A.nt:
         raise ValueError("POTRF needs a square tile grid")
+    if A.mb != A.nb:
+        raise ValueError("POTRF needs square tiles (mb == nb)")
     if getattr(A, "dist", None) is not None and \
             getattr(A.dist, "nb_ranks", 1) > 1:
         raise ValueError("build_potrf_left is single-process; use "

@@ -129,6 +129,29 @@ def build_transformer_block(Qc: DataCollection, Kc: DataCollection,
                 outs=[ptg.Out(data=lambda g, i: (g.Y, (i,)))]),
         ])
 
+    # TPU incarnation first: chore_for(TPU) picks it on TPU devices, CPU
+    # devices fall through to the generic jnp body below — the reference
+    # per-device BODY selection (jdf2c.c GPU hook, CUDA BODY sections).
+    # The pallas flash kernel computes this tile's partial attention;
+    # the result is merged into the carried online-softmax state via the
+    # (o, lse) identity, so TPU- and CPU-executed links of one chain
+    # interoperate on the same state representation.
+    @ATT.body_tpu
+    def att_body_tpu(task, Q, K, V, S):
+        from ..ops.flash_attention import (flash_attention,
+                                           merge_attention_states)
+        acc, m, l = S
+        o_j, lse_j = flash_attention(
+            Q[:, None, :], K[:, None, :], V[:, None, :],
+            scale=scale, return_lse=True)
+        o_c = acc / jnp.maximum(l, 1e-30)[:, None]
+        lse_c = m + jnp.log(jnp.maximum(l, 1e-30))
+        o_m, lse_m = merge_attention_states(
+            o_c, lse_c, o_j[:, 0].astype(jnp.float32), lse_j[:, 0])
+        # back to the chain's (acc, m, l) invariants with m := lse and
+        # l := 1 (acc = o·l); any later fold or NORM stays consistent
+        return {"S": (o_m, lse_m, jnp.ones_like(lse_m))}
+
     @ATT.body
     def att_body(task, Q, K, V, S):
         acc, m, l = S

@@ -179,6 +179,14 @@ class SocketCommEngine(CommEngine):
     def enable(self) -> None:
         super().enable()
         if self.nb_ranks > 1 and self._thread is None:
+            if not self._socks:
+                # disable() closed the peer mesh; restarting the comm
+                # thread with zero registered sockets would leave this
+                # rank silently deaf — fail fast (engines are created
+                # per run; re-wireup needs a fresh engine)
+                raise RuntimeError(
+                    "socket engine re-enabled after disable() closed "
+                    "the peer mesh; create a new engine instead")
             if self._wake_r.fileno() < 0:     # re-enable after disable()
                 self._wake_r, self._wake_w = socket.socketpair()
                 self._wake_r.setblocking(False)

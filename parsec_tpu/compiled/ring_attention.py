@@ -69,7 +69,7 @@ def _online_softmax_step(q_blk, k_cur, v_cur, acc, m, l, scale,
 
 def ring_attention(q, k, v, mesh, axis: str = "seq",
                    kv_chunk: Optional[int] = None,
-                   causal: bool = False):
+                   causal: bool = False, impl: str = "xla"):
     """Multi-head attention with the sequence sharded over mesh ``axis``.
 
     ``q/k/v``: float arrays of shape ``(S, H, dh)`` (sequence-major) laid
@@ -85,16 +85,77 @@ def ring_attention(q, k, v, mesh, axis: str = "seq",
     each device masks the visiting KV block against its query block's
     position range, so fully-future blocks contribute nothing while the
     ring still rotates uniformly.
+
+    ``impl``: local-block computation. ``"xla"`` — the jnp online-
+    softmax fold (works everywhere). ``"flash"`` — the pallas flash
+    kernel (ops.flash_attention) per visiting KV block, partial results
+    combined with the (o, lse) state merge; measured ~6× the xla fold
+    at S=16384 on a v5e chip (the S×S score round-trips through HBM are
+    what the kernel eliminates). ``kv_chunk`` maps to the kernel's key
+    block size. The ppermute ring is identical in both modes.
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import PartitionSpec as P
     shard_map = jax.shard_map
+    if impl not in ("xla", "flash"):
+        raise ValueError(f"ring_attention impl must be xla|flash: {impl!r}")
 
     n = mesh.shape[axis]
     scale = 1.0 / math.sqrt(q.shape[-1])
     perm = [(i, (i + 1) % n) for i in range(n)]
+
+    if impl == "flash":
+        from ..ops.flash_attention import (flash_attention,
+                                           merge_attention_states)
+
+        def block_flash(q_blk, k_blk, v_blk):
+            my = lax.axis_index(axis)
+            bk = min(kv_chunk, k_blk.shape[0]) if kv_chunk else 0
+
+            def fold(k_cur, v_cur, diag):
+                o, lse = flash_attention(
+                    q_blk, k_cur, v_cur, causal=diag, scale=scale,
+                    block_k=bk, return_lse=True)
+                return o.astype(jnp.float32), lse
+
+            # resident block first: with causal masking it is the
+            # diagonal block (kernel-level causal mask); visiting blocks
+            # are either fully past (unmasked) or fully future (skipped)
+            o_c, lse_c = fold(k_blk, v_blk, causal)
+
+            def step(carry, t):
+                k_cur, v_cur, o_c, lse_c = carry
+                k_cur = lax.ppermute(k_cur, axis, perm)
+                v_cur = lax.ppermute(v_cur, axis, perm)
+                kv_owner = (my - t - 1) % n
+
+                def do_fold(op):
+                    k_, v_, o1, l1 = op
+                    o2, l2 = fold(k_, v_, False)
+                    return merge_attention_states(o1, l1, o2, l2)
+
+                if causal:
+                    o_c, lse_c = lax.cond(
+                        kv_owner < my, do_fold,
+                        lambda op: (op[2], op[3]),
+                        (k_cur, v_cur, o_c, lse_c))
+                else:
+                    o_c, lse_c = do_fold((k_cur, v_cur, o_c, lse_c))
+                return (k_cur, v_cur, o_c, lse_c), None
+
+            (k_f, v_f, o_c, lse_c), _ = lax.scan(
+                step, (k_blk, v_blk, o_c, lse_c), jnp.arange(n - 1))
+            return o_c.astype(q_blk.dtype)
+
+        # check_vma=False: pallas_call's out_shape carries no varying-
+        # across-mesh annotation, which the shard_map vma checker (JAX
+        # ≥0.8) rejects; the kernel is per-device-local so the check
+        # adds nothing here
+        return shard_map(block_flash, mesh=mesh,
+                         in_specs=(P(axis), P(axis), P(axis)),
+                         out_specs=P(axis), check_vma=False)(q, k, v)
 
     def block(q_blk, k_blk, v_blk):
         # [Sb, H, dh] → head-major [H, Sb, dh] for batched matmuls

@@ -368,30 +368,17 @@ class Context:
             return rc
         return HookReturn.ERROR
 
-    def _hbm_track(self, dc, key, value) -> None:
+    def _hbm_track(self, dc, key, value):
         """Register a device-resident tile a task is writing to its
         collection; over budget, the manager spills the coldest tracked
         tile back into its collection as host numpy. Called BEFORE the
-        collection write: the manager then always holds the newest
-        version, so a concurrent eviction can never overwrite a newer
-        collection value with a stale spill. The spill closure holds the
-        collection weakly — dead collections' entries are swept when
-        their taskpool terminates instead of being pinned forever."""
-        if not isinstance(value, self.hbm.jax.Array):
-            return
-        k = tuple(key) if isinstance(key, (tuple, list)) else (key,)
-        dc_ref = weakref.ref(dc)
-
-        def _spill(_k, host, dc_ref=dc_ref, key=key):
-            target = dc_ref()
-            if target is not None:
-                target.write_tile(key, host)
-
-        try:
-            self.hbm.put((id(dc), k), value, spill=_spill)
-        except MemoryError:
-            warning("hbm", "tile %r exceeds the device budget alone; "
-                    "left untracked", key)
+        collection write with the entry PINNED (caller unpins after the
+        write): the manager always holds the newest version AND cannot
+        evict it inside the track→write window, where the spill's host
+        write would race the device write (budget under-enforcement).
+        Returns the key to unpin, or None when untracked."""
+        from ..device.hbm import track_collection_write
+        return track_collection_write(self.hbm, dc, key, value)
 
     def complete_task(self, es: Optional[ExecutionStream], task: Task) -> None:
         """__parsec_complete_execution + release_deps analog
@@ -412,10 +399,15 @@ class Context:
         ready: List[Task] = []
         for ref in tc.iterate_successors(task):
             if isinstance(ref, DataRef):
-                # track first, write second — see _hbm_track
+                # track (pinned) first, write second, unpin last — see
+                # _hbm_track
+                mkey = None
                 if self.hbm is not None:
-                    self._hbm_track(ref.collection, ref.key, ref.value)
+                    mkey = self._hbm_track(ref.collection, ref.key,
+                                           ref.value)
                 ref.collection.write_tile(ref.key, ref.value)
+                if mkey is not None:
+                    self.hbm.unpin(mkey)
                 continue
             if ref.reshape_spec is not None or \
                     isinstance(ref.value, DataCopyFuture):

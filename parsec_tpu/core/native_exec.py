@@ -36,7 +36,10 @@ class NativeDAGExecutor:
     """Execute a PTG taskpool on the C++ engine."""
 
     def __init__(self, tp, nworkers: int = 4,
-                 device_type: DeviceType = DeviceType.CPU):
+                 device_type: DeviceType = DeviceType.CPU, hbm=None):
+        """``hbm``: optional :class:`~..device.hbm.HBMManager` — tile
+        write-backs are then budget-tracked exactly like the host
+        runtime's completion path (pinned put → write → unpin)."""
         lib = _native.load()
         if lib is None:
             raise RuntimeError("native core unavailable (no g++?)")
@@ -49,6 +52,7 @@ class NativeDAGExecutor:
         self.tp = tp
         self.nworkers = max(1, nworkers)
         self.device_type = device_type
+        self.hbm = hbm
 
         # ---- enumerate the task space
         self.tasks: List[Tuple[object, Tuple[int, ...]]] = []
@@ -130,10 +134,18 @@ class NativeDAGExecutor:
                 outs = {out_flows[0].name: result}
             task.output.update(outs)
             # terminal collection write-backs; successor activation is
-            # native (the engine counts down deps from the edge list)
+            # native (the engine counts down deps from the edge list).
+            # Budget-tracked when an HBM manager is attached — the same
+            # pinned track → write → unpin protocol as the host
+            # runtime's complete_task.
+            from ..device.hbm import track_collection_write
             for ref in tc.iterate_successors(task):
                 if isinstance(ref, DataRef):
+                    mkey = track_collection_write(
+                        self.hbm, ref.collection, ref.key, ref.value)
                     ref.collection.write_tile(ref.key, ref.value)
+                    if mkey is not None:
+                        self.hbm.unpin(mkey)
             if self.nconsumers[tid]:
                 self._outputs[tid] = {f.name: task.output.get(
                     f.name, task.data.get(f.name)) for f in tc.flows}

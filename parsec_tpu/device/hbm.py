@@ -115,7 +115,7 @@ class HBMManager:
             best_key, best_rank = None, None
             for key, e in self._entries.items():
                 if e["offset"] is None or key in protect or \
-                        e.get("device") != dev:
+                        e.get("device") != dev or e.get("pins", 0) > 0:
                     continue
                 nu = e.get("next_use")
                 # rank: (next_use descending, last_use ascending);
@@ -236,8 +236,17 @@ class HBMManager:
     def put(self, key: Hashable, value: Any,
             protect: Tuple[Hashable, ...] = (),
             next_use: Optional[int] = None,
-            spill: Optional[Callable] = None) -> None:
-        """Register a device value just produced (already in HBM)."""
+            spill: Optional[Callable] = None,
+            pin: bool = False) -> None:
+        """Register a device value just produced (already in HBM).
+
+        ``pin=True`` marks the entry ineligible for eviction until
+        :meth:`unpin` — callers that put a value and then publish it
+        elsewhere (e.g. the runtime writing the tile into a collection
+        after tracking it) close the window where an eviction's spill
+        would race the publish (ADVICE round 2: the spill's host write
+        could be overwritten by the device value, leaving the
+        collection holding an unaccounted device array)."""
         with self._lock:
             self._clock += 1
             old = self._entries.get(key)
@@ -257,9 +266,22 @@ class HBMManager:
                 raise
             self._entries[key] = {
                 "value": value, "offset": off, "last_use": self._clock,
+                # pins ACCUMULATE across re-puts: a second writer's
+                # pinned put while the first is inside its track->write
+                # window must not drop the first pin (native workers
+                # complete concurrently)
+                "pins": (old or {}).get("pins", 0) + (1 if pin else 0),
                 "next_use": next_use, "device": dev,
                 "spill": spill if spill is not None else
                 (old or {}).get("spill")}
+
+    def unpin(self, key: Hashable) -> None:
+        """Release one :meth:`put` pin; no-op for unknown keys (the
+        entry may have been dropped by a failed oversized put)."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and e.get("pins", 0) > 0:
+                e["pins"] -= 1
 
     def register(self, key: Hashable, value: Any,
                  next_use: Optional[int] = None,
@@ -303,6 +325,40 @@ class HBMManager:
             for k in victims:
                 self.drop(k)
             return len(victims)
+
+
+def track_collection_write(mgr: Optional[HBMManager], dc, key,
+                           value) -> Optional[Hashable]:
+    """Track a device-resident tile a task is about to write into its
+    collection (pinned — see :meth:`HBMManager.put`); returns the
+    manager key to :meth:`~HBMManager.unpin` AFTER the collection write,
+    or None when the value is untracked (host value / over-budget).
+
+    Shared by the host runtime (core.context complete_task) and the
+    native executor so both completion paths enforce the budget the
+    same way. The spill closure holds the collection weakly — dead
+    collections' entries are swept when their taskpool terminates
+    instead of being pinned forever."""
+    import weakref
+    if mgr is None or not isinstance(value, mgr.jax.Array):
+        return None
+    k = tuple(key) if isinstance(key, (tuple, list)) else (key,)
+    dc_ref = weakref.ref(dc)
+
+    def _spill(_k, host, dc_ref=dc_ref, key=key):
+        target = dc_ref()
+        if target is not None:
+            target.write_tile(key, host)
+
+    mkey = (id(dc), k)
+    try:
+        mgr.put(mkey, value, spill=_spill, pin=True)
+    except MemoryError:
+        from ..utils.debug import warning
+        warning("hbm", "tile %r exceeds the device budget alone; "
+                "left untracked", key)
+        return None
+    return mkey
 
 
 def manager_from_mca() -> Optional[HBMManager]:

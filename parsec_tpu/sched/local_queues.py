@@ -315,11 +315,14 @@ class LHQScheduler(_LocalQueueScheduler):
 
     def flow_init(self, es) -> None:
         super().flow_init(es)
-        self._level_cache.pop(id(es), None)
+        self._level_cache.pop((es.vp_id, es.th_id), None)
 
     def _levels(self, es):
-        """Level queues from private to VP-wide."""
-        cached = self._level_cache.get(id(es))
+        """Level queues from private to VP-wide. Cache key is the
+        stream's stable identity (vp, thread) — ``id(es)`` of a
+        collected stream can be reused by a new object and silently
+        serve the old stream's levels."""
+        cached = self._level_cache.get((es.vp_id, es.th_id))
         if cached is not None:
             return cached
         n_vp = sum(1 for s in es.context.streams if s.vp_id == es.vp_id)
@@ -334,7 +337,7 @@ class LHQScheduler(_LocalQueueScheduler):
             if span >= n_vp:
                 break
             span *= 2
-        self._level_cache[id(es)] = levels
+        self._level_cache[(es.vp_id, es.th_id)] = levels
         return levels
 
     def schedule(self, es, tasks: Sequence[Task], distance: int = 0) -> None:

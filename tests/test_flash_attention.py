@@ -61,6 +61,40 @@ def test_flash_rejects_nondividing_blocks():
         flash_attention(q, q, q, block_q=64, block_k=64)
 
 
+def test_flash_lse_and_state_merge():
+    """return_lse gives the true per-row logsumexp, and merging the
+    (o, lse) partials of two disjoint key halves reproduces full
+    attention — the ring-attention composition property."""
+    from parsec_tpu.ops.flash_attention import merge_attention_states
+    rng = np.random.default_rng(3)
+    S, H, dh = 128, 2, 64
+    q = rng.standard_normal((S, H, dh)).astype(np.float32)
+    k = rng.standard_normal((S, H, dh)).astype(np.float32)
+    v = rng.standard_normal((S, H, dh)).astype(np.float32)
+    scale = 1.0 / np.sqrt(dh)
+    o, lse = flash_attention(jnp.asarray(q), jnp.asarray(k),
+                             jnp.asarray(v), block_q=64, block_k=64,
+                             return_lse=True)
+    for h in range(H):
+        s = q[:, h] @ k[:, h].T * scale
+        ref_lse = np.log(np.exp(s - s.max(-1, keepdims=True))
+                         .sum(-1)) + s.max(-1)
+        np.testing.assert_allclose(np.asarray(lse)[:, h], ref_lse,
+                                   rtol=1e-4, atol=1e-4)
+    half = S // 2
+    o1, l1 = flash_attention(jnp.asarray(q), jnp.asarray(k[:half]),
+                             jnp.asarray(v[:half]), block_q=64,
+                             block_k=64, return_lse=True)
+    o2, l2 = flash_attention(jnp.asarray(q), jnp.asarray(k[half:]),
+                             jnp.asarray(v[half:]), block_q=64,
+                             block_k=64, return_lse=True)
+    om, lm = merge_attention_states(o1, l1, o2, l2)
+    np.testing.assert_allclose(np.asarray(om), np.asarray(o),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(lm), np.asarray(lse),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_flash_causal_first_block_rows():
     """Row 0 attends only to key 0 under causal masking (the strictest
     fully-masked-tail case)."""
@@ -72,3 +106,15 @@ def test_flash_causal_first_block_rows():
                                      jnp.asarray(v), causal=True,
                                      block_q=64, block_k=64))
     np.testing.assert_allclose(out[0, 0], v[0, 0], rtol=1e-4, atol=1e-4)
+
+
+def test_flash_default_blocks_adapt_to_sequence():
+    """Default block sizes shrink to divide S (S=1536 is a multiple of
+    512 but not of the 1024 default); explicit block sizes stay
+    strict."""
+    rng = np.random.default_rng(4)
+    q = rng.standard_normal((1536, 1, 64)).astype(np.float32)
+    out = np.asarray(flash_attention(jnp.asarray(q), jnp.asarray(q),
+                                     jnp.asarray(q)))
+    ref = _dense_ref(q, q, q, False, 1.0 / 8.0)
+    np.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-3)

@@ -307,3 +307,32 @@ def test_jax_values_2ranks():
 
 def test_jax_values_3ranks():
     _run_ranks("scenario_jax_values", 3)
+
+
+def test_reenable_after_disable_raises():
+    """disable() tears the peer mesh down; a re-enable would start a
+    comm thread with zero sockets (silently deaf) — must fail fast."""
+    import threading
+    from parsec_tpu.comm.socket_engine import SocketCommEngine
+    base = _free_port_base()
+    engines = {}
+
+    def mk(r):
+        engines[r] = SocketCommEngine(r, 2, base_port=base)
+
+    t = threading.Thread(target=mk, args=(1,))
+    t.start()
+    mk(0)
+    t.join(timeout=30)
+    try:
+        e = engines[0]
+        e.enable()
+        e.disable()
+        with pytest.raises(RuntimeError, match="re-enabled"):
+            e.enable()
+    finally:
+        for eng in engines.values():
+            try:
+                eng.disable()
+            except Exception:
+                pass

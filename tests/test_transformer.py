@@ -112,3 +112,48 @@ def test_transformer_bigger_config(ctx, rng):
     assert ctx.wait(timeout=120)
     got = np.concatenate([np.asarray(Y.data_of((i,))) for i in range(T)])
     np.testing.assert_allclose(got, ref, rtol=5e-3, atol=5e-3)
+
+
+def test_att_tpu_chore_matches_generic(rng):
+    """The pallas-backed TPU incarnation of ATT (flash + (o,lse) merge)
+    must produce the same chain state as the generic jnp body — TPU- and
+    CPU-executed links of one chain interoperate (interpret mode runs
+    the identical kernel on CPU)."""
+    import jax.numpy as jnp
+    from parsec_tpu.core.task import DeviceType
+    from parsec_tpu.data.collection import LocalCollection
+
+    H, T, TS, dh = 1, 3, 32, 16
+    Qc = LocalCollection("Q"); Kc = LocalCollection("K")
+    Vc = LocalCollection("V"); Y = LocalCollection("Y")
+    tiles = {}
+    for c, nm in ((Qc, "q"), (Kc, "k"), (Vc, "v")):
+        for i in range(T):
+            t = rng.standard_normal((TS, dh)).astype(np.float32)
+            c.write_tile((0, i), t)
+            tiles[(nm, i)] = t
+    Wo = np.eye(H * dh, H * dh, dtype=np.float32)
+    tp = build_transformer_block(Qc, Kc, Vc, Y, H, T, TS, dh,
+                                 Wo, Wo[:, :8], Wo[:8, :])
+    ATT = tp.task_class_by_name("ATT")
+    tpu_hook = ATT.chore_for(DeviceType.TPU).hook
+    cpu_hook = ATT.chore_for(DeviceType.CPU).hook
+    assert tpu_hook is not cpu_hook
+
+    def chain(hooks):
+        S = (jnp.zeros((TS, dh), jnp.float32),
+             jnp.full((TS,), -jnp.inf, jnp.float32),
+             jnp.zeros((TS,), jnp.float32))
+        for j, hook in enumerate(hooks):
+            S = hook(None, jnp.asarray(tiles[("q", 0)]),
+                     jnp.asarray(tiles[("k", j)]),
+                     jnp.asarray(tiles[("v", j)]), S)["S"]
+        acc, m, l = S
+        return np.asarray(acc / l[:, None])
+
+    ref = chain([cpu_hook] * T)
+    np.testing.assert_allclose(chain([tpu_hook] * T), ref,
+                               rtol=2e-3, atol=2e-3)
+    # mixed chain: CPU link then TPU links (state representations agree)
+    np.testing.assert_allclose(chain([cpu_hook, tpu_hook, tpu_hook]),
+                               ref, rtol=2e-3, atol=2e-3)
