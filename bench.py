@@ -197,12 +197,19 @@ def _measure_extras(jax, jnp, np, on_tpu):
         B3 = TiledMatrix(np_, np_, nbp, nbp, name="B")
         C3 = TiledMatrix(np_, np_, nbp, nbp, name="C")
         exp = PanelExecutor(plan_taskpool(build_gemm_ptg(A3, B3, C3)))
-        REP = 4                       # repeats inside ONE jit: a single
+        REP = 8                       # repeats inside ONE jit: a single
         #                               pass is shorter than the link rtt
 
         def multi(st):
             for _ in range(REP):
                 st = exp.run_state(st)
+                # defeat cross-pass CSE: identical A/B operands would
+                # let XLA dedup the repeated matmuls (measured 2-5x
+                # ABOVE peak without this). One-row elementwise nudge:
+                # non-uniform (scalar-broadcast adds get algebraically
+                # factored out of dots) and ~free (64 KB)
+                st["A"] = st["A"].at[:1, :].add(
+                    1e-30 * st["C"][:1, :])
             return st
 
         st0 = {nm: _jnp.asarray(

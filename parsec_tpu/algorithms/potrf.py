@@ -316,19 +316,20 @@ def build_potrf_left(A: TiledMatrix) -> ptg.Taskpool:
     panels — the MXU-optimal schedule (measured ~98-106 TF/s/chip vs
     ~68 for the fused right-looking form at N=32768-40960).
 
-    Single-process taskpool: UPDATE bodies read sibling tiles straight
-    from the collection, which owner-computes distribution does not
-    provide across ranks — use :func:`build_potrf` for distributed runs.
+    Distribution: UPDATE's gathered operands are resolved with the
+    direct-memory pattern of reference JDF bodies — local tiles read
+    from the collection, remote tiles through the comm engine's
+    one-sided :meth:`~..comm.engine.CommEngine.fetch_tile` (the
+    rendezvous-GET analog, remote_dep_mpi.c:1594-1729). The CTL-gather
+    guarantees every gathered TRSM wrote its tile back on its owner
+    before UPDATE runs, so the fetch is race-free; the same taskpool
+    runs single-process panel-fused AND multi-rank.
     """
     NT = A.nt
     if A.mt != A.nt:
         raise ValueError("POTRF needs a square tile grid")
     if A.mb != A.nb:
         raise ValueError("POTRF needs square tiles (mb == nb)")
-    if getattr(A, "dist", None) is not None and \
-            getattr(A.dist, "nb_ranks", 1) > 1:
-        raise ValueError("build_potrf_left is single-process; use "
-                         "build_potrf for distributed runs")
     tp = ptg.Taskpool("potrf_left", A=A, NT=NT)
 
     def _gathered(g, m, k):
@@ -407,18 +408,38 @@ def build_potrf_left(A: TiledMatrix) -> ptg.Taskpool:
                          "G"))])])
 
     # the CTL-gather contract guarantees every gathered TRSM has written
-    # its tile back before the UPDATE body runs, so direct collection
-    # reads are safe (single process)
+    # its tile back (on its owner rank) before the UPDATE body runs, so
+    # direct local reads / remote one-sided fetches are race-free.
+    # Fetched tiles are FINAL for the taskpool's lifetime (column j is
+    # never rewritten after step j), so remote fetches cache per rank on
+    # the taskpool — each remote tile crosses the wire once, not once
+    # per consuming UPDATE.
+    tp._fetch_cache = {}
+
     @UPDATE.body(batchable=False)
     def update_body(task, C):
         import numpy as np
         g = task.taskpool.g
+        ctx = task.taskpool.context
+        cache = task.taskpool._fetch_cache
         m, k = task.locals
+
+        def tile(row, j):
+            owner = g.A.rank_of((row, j))
+            if ctx is None or ctx.nb_ranks == 1 or owner == ctx.my_rank:
+                return np.asarray(g.A.data_of((row, j)), dtype=np.float32)
+            hit = cache.get((row, j))
+            if hit is None:
+                hit = np.asarray(
+                    ctx.comm.fetch_tile(g.A, (row, j), owner,
+                                        scope=task.taskpool.name),
+                    dtype=np.float32)
+                cache[(row, j)] = hit   # benign race: idempotent value
+            return hit
+
         acc = np.asarray(C, dtype=np.float32).copy()
         for j in range(k):
-            Lm = np.asarray(g.A.data_of((m, j)), dtype=np.float32)
-            Lk = np.asarray(g.A.data_of((k, j)), dtype=np.float32)
-            acc -= Lm @ Lk.T
+            acc -= tile(m, j) @ tile(k, j).T
         return acc.astype(np.asarray(C).dtype)
 
     @POTRF.body

@@ -23,6 +23,7 @@ class AMTag(enum.IntEnum):
     TERMDET_USER_TRIGGER = 4
     DTD_CONTROL = 5
     BARRIER = 6
+    TILE_FETCH = 7        # one-sided collection-tile GET (RMA analog)
     FIRST_USER_TAG = 8
 
 MAX_REGISTERED_TAGS = 32     # PARSEC_MAX_REGISTERED_TAGS (parsec_comm_engine.h:24)
@@ -48,6 +49,13 @@ class CommEngine:
                       "bytes_sent": 0, "bytes_recv": 0}
         self._stats_lock = threading.Lock()
         self._trace = None
+        # one-sided tile-fetch service (RMA GET over AMs): exposed
+        # collections by name + in-flight fetch futures
+        self._exposed_colls: Dict[str, Any] = {}
+        self._fetch_futures: Dict[int, Any] = {}
+        self._fetch_next = 0
+        self._fetch_lock = threading.Lock()
+        self.tag_register(AMTag.TILE_FETCH, self._on_tile_fetch)
 
     # -- instrumentation (profiling msg-size info, remote_dep.h:374-384) --
     def install_trace(self, trace) -> None:
@@ -126,6 +134,90 @@ class CommEngine:
     def get(self, remote_rank: int, remote_handle: Any, local_handle: Any,
             on_done: Optional[Callable] = None) -> None:
         raise NotImplementedError
+
+    # -- one-sided tile fetch (RMA GET over AMs) --------------------------
+    # The reference's rendezvous GET moves registered remote memory
+    # (remote_dep_mpi.c:1594-1729). The runtime analog here: a worker
+    # fetches a remote COLLECTION tile by (name, key); the owner's comm
+    # thread reads its collection and replies. Safe whenever dataflow
+    # ordering (e.g. a CTL-gather) guarantees the owner's tile is final
+    # — the direct-memory gathered-operand pattern of reference JDF
+    # bodies, made rank-correct.
+
+    def expose_collection(self, dc, scope: str = "") -> None:
+        """Make ``dc`` fetchable from other ranks (weakly held). The
+        wire identity is ``(scope, dc.name)`` — the scope is the owning
+        taskpool's name (taskpool names are already the cross-rank
+        registry identity), so same-named collections of different
+        taskpools never alias. A live identity clash is a user error
+        (duplicate taskpool name) and raises rather than silently
+        serving the wrong tiles."""
+        import weakref
+        ident = (scope, dc.name)
+        old = self._exposed_colls.get(ident)
+        if old is not None:
+            cur = old()
+            if cur is not None and cur is not dc:
+                raise ValueError(
+                    f"collection identity {ident!r} already exposed by "
+                    f"a different live collection; tile-fetch "
+                    f"identities must be unique per rank")
+        self._exposed_colls[ident] = weakref.ref(dc)
+
+    def _on_tile_fetch(self, src: int, msg: Any) -> None:
+        if msg.get("reply"):
+            with self._fetch_lock:
+                fut = self._fetch_futures.pop(msg["req"], None)
+            if fut is not None:
+                if "error" in msg:
+                    fut.set(("error", msg["error"]))
+                else:
+                    fut.set(("ok", msg["value"]))
+            return
+        try:
+            import numpy as np
+            ident = (msg.get("scope", ""), msg["name"])
+            ref = self._exposed_colls.get(ident)
+            dc = ref() if ref is not None else None
+            if dc is None:
+                raise KeyError(f"collection {ident!r} not exposed "
+                               f"on rank {self.rank}")
+            value = np.asarray(dc.data_of(tuple(msg["key"])))
+            reply = {"reply": True, "req": msg["req"], "value": value}
+        except Exception as exc:  # noqa: BLE001 — cross the wire, not die
+            reply = {"reply": True, "req": msg["req"],
+                     "error": str(exc)[:500]}
+        self.send_am(AMTag.TILE_FETCH, src, reply)
+
+    def fetch_tile(self, dc, key, owner: int, timeout: float = 120.0,
+                   scope: str = ""):
+        """Blocking GET of tile ``key`` of collection ``dc`` from
+        ``owner`` (local reads short-circuit). ``scope`` must match the
+        owner's :meth:`expose_collection` scope (the taskpool name).
+        The caller is responsible for ordering (the tile must be final
+        on the owner)."""
+        if owner == self.rank or self.nb_ranks == 1:
+            return dc.data_of(key)
+        from ..core.future import Future
+        fut = Future()
+        with self._fetch_lock:
+            req = self._fetch_next
+            self._fetch_next += 1
+            self._fetch_futures[req] = fut
+        self.send_am(AMTag.TILE_FETCH, owner,
+                     {"name": dc.name, "scope": scope, "key": tuple(key),
+                      "req": req})
+        try:
+            status, value = fut.get(timeout=timeout)
+        finally:
+            # reply handler pops on fulfillment; a timeout must not
+            # leak the future (or let a stale late reply fulfill it)
+            with self._fetch_lock:
+                self._fetch_futures.pop(req, None)
+        if status == "error":
+            raise RuntimeError(f"tile fetch ({dc.name!r}, {key}) from "
+                               f"rank {owner} failed: {value}")
+        return value
 
     # -- progress ---------------------------------------------------------
     def progress(self) -> int:

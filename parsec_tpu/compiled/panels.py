@@ -98,6 +98,12 @@ class PanelExecutor:
             raise ValueError(
                 f"taskpool {plan.taskpool.name!r} registers no wave_fuser; "
                 "use the tile-dict/stacked executors instead")
+        if getattr(plan, "has_reshapes", False):
+            raise ValueError(
+                f"taskpool {plan.taskpool.name!r} declares dep "
+                "[type=...] reshape specs; wave fusers lower raw panel "
+                "slices — use the tile-dict executors (which apply "
+                "specs at gather) or the host runtime")
         self.geoms = {
             name: PanelGeometry(name=name, mb=dc.mb, nb=dc.nb,
                                 mt=dc.mt, nt=dc.nt)

@@ -42,13 +42,17 @@ from ..utils.debug import debug_verbose
 
 @dataclass
 class WaveGroup:
-    """All tasks of one class inside one wave."""
+    """All tasks of one class inside one wave (sub-grouped by reshape
+    signature when dep ``[type=...]`` specs differ across instances)."""
     tc: PTGTaskClass
     level: int
     tasks: List[Tuple[int, ...]]
     # per non-CTL flow, (collection name, np.int32[B] tile-slot indices)
     in_slots: List[Tuple[str, np.ndarray]] = field(default_factory=list)
     out_slots: List[Tuple[str, np.ndarray]] = field(default_factory=list)
+    # per in-flow composed ReshapeSpec (or None), shared by every task
+    # in the group — applied to the gathered stack before the body
+    in_specs: List[Optional[Any]] = field(default_factory=list)
 
 
 @dataclass
@@ -62,6 +66,11 @@ class WavefrontPlan:
     # placement: only executors that keep values in carry state (the
     # panel-fused path) or the host runtime can run such plans
     has_value_flows: bool = False
+    # dep [type=...] support: True when any dep declares a ReshapeSpec
+    has_reshapes: bool = False
+    # (collection name, slot) -> spec of the LAST terminal data write —
+    # applied by write_back (the Out-side conversion of DataRef writes)
+    terminal_specs: Dict[Tuple[str, int], Any] = field(default_factory=dict)
 
     @property
     def n_waves(self) -> int:
@@ -87,12 +96,16 @@ def _is_value_flow(tc: PTGTaskClass, f) -> bool:
 
 
 def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
-    """Enumerate, level, group and hazard-check a PTG taskpool."""
+    """Enumerate, level, group and hazard-check a PTG taskpool.
+
+    Dep ``[type=...]`` reshape specs (parsec_reshape.c analog) are
+    static per-edge layout maps, so the planner resolves them up front:
+    each consumer's composed (Out ∘ In) spec is recorded per group and
+    applied to the gathered stack at execution (XLA fuses the cast/
+    transpose into the body); terminal DataRef specs are applied by
+    write_back. Groups whose instances disagree on specs are split."""
     from ..dsl.ptg import taskpool_uses_reshape
-    if taskpool_uses_reshape(tp):
-        raise NotImplementedError(
-            "compiled wavefront executor does not apply reshape specs; "
-            "run reshape-bearing taskpools on the host runtime")
+    has_reshapes = taskpool_uses_reshape(tp)
     # ---- enumerate tasks and assign ids
     tasks: List[Tuple[PTGTaskClass, Tuple[int, ...]]] = []
     tid: Dict[Tuple[str, Tuple], int] = {}
@@ -105,6 +118,8 @@ def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
     # ---- build successor edges via the closed-form iterators
     succs: List[List[int]] = [[] for _ in range(n)]
     edges: List[Tuple[int, int, str]] = []   # (producer, consumer, flow)
+    # (consumer tid, flow) -> composed producer∘consumer ReshapeSpec
+    edge_specs: Dict[Tuple[int, str], Any] = {}
     indeg = np.zeros(n, dtype=np.int64)
     for i, (tc, p) in enumerate(tasks):
         dry = Task(tp, tc, p)
@@ -117,6 +132,8 @@ def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
             j = tid[(ref.task_class.name, tuple(ref.locals))]
             succs[i].append(j)
             edges.append((i, j, ref.flow_name))
+            if ref.reshape_spec is not None:
+                edge_specs[(j, ref.flow_name)] = ref.reshape_spec
             indeg[j] += 1
 
     # ---- Kahn leveling (batched in the C++ core when available)
@@ -147,15 +164,43 @@ def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
         if seen != n:
             raise RuntimeError("PTG DAG has a cycle")
 
-    # ---- group into waves
+    # ---- per-task input reshape specs (static, from the closed form)
+    def _in_flows(tc: PTGTaskClass):
+        return [f for f in tc.flows if not f.is_ctl
+                and not _is_value_flow(tc, f)
+                and (f.access & FlowAccess.READ)]
+
+    def _task_in_specs(i: int, tc: PTGTaskClass, p) -> Tuple:
+        if not has_reshapes:
+            return ()
+        specs = []
+        for f in _in_flows(tc):
+            spec = edge_specs.get((i, f.name))
+            if spec is None:
+                dep = tc._active_in(tp.g, tc.specs[f.name], p)
+                if dep is not None and dep.src is None and \
+                        dep.reshape is not None:
+                    spec = dep.reshape
+            specs.append(spec)
+        return tuple(specs)
+
+    task_specs: List[Tuple] = [
+        _task_in_specs(i, tc, p) for i, (tc, p) in enumerate(tasks)]
+
+    # ---- group into waves (split by reshape signature: one group =
+    # one batched body call, so every instance must share its specs)
     n_waves = int(level.max()) + 1 if n else 0
     waves: List[List[WaveGroup]] = [[] for _ in range(n_waves)]
-    groups: Dict[Tuple[int, str], WaveGroup] = {}
+    groups: Dict[Tuple, WaveGroup] = {}
     for i, (tc, p) in enumerate(tasks):
-        gkey = (int(level[i]), tc.name)
+        sig = tuple(s.key if s is not None else None
+                    for s in task_specs[i])
+        gkey = (int(level[i]), tc.name, sig)
         grp = groups.get(gkey)
         if grp is None:
-            grp = WaveGroup(tc=tc, level=int(level[i]), tasks=[])
+            grp = WaveGroup(tc=tc, level=int(level[i]), tasks=[],
+                            in_specs=list(task_specs[i]) or
+                            [None] * len(_in_flows(tc)))
             groups[gkey] = grp
             waves[int(level[i])].append(grp)
         grp.tasks.append(p)
@@ -244,9 +289,54 @@ def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
                     f"wave{lr} but the tile is rewritten in wave {w}; "
                     f"use the host runtime for this DAG")
 
+    # ---- terminal DataRef reshape specs (Out-side [type=...]): applied
+    # once by write_back, matching the host runtime's per-write
+    # conversion for the FINAL value. A reshaped write that a LATER
+    # data-sourced read would observe has no store representation (the
+    # store keeps raw values) — refuse loudly.
+    terminal_specs: Dict[Tuple[str, int], Any] = {}
+    if has_reshapes:
+        term_wave: Dict[Tuple[str, int], int] = {}
+        reshaped_wmin: Dict[Tuple[str, int], int] = {}
+        data_read_wave: Dict[Tuple[str, int], int] = {}
+        g = tp.g
+        for i, (tc, p) in enumerate(tasks):
+            w = int(level[i])
+            for spec_ in tc.spec_list:
+                for dep in spec_.outs:
+                    if dep.data is None or not dep.active(g, p):
+                        continue
+                    dc, key = dep.data(g, *p)
+                    slot_key = (dc.name, slot_maps[dc.name][tuple(key)])
+                    if dep.reshape is not None:
+                        reshaped_wmin[slot_key] = min(
+                            reshaped_wmin.get(slot_key, 1 << 30), w)
+                        if term_wave.get(slot_key, -1) <= w:
+                            terminal_specs[slot_key] = dep.reshape
+                            term_wave[slot_key] = w
+                    elif term_wave.get(slot_key, -1) <= w:
+                        terminal_specs.pop(slot_key, None)
+                        term_wave[slot_key] = w
+                dep = tc._active_in(g, spec_, p)
+                if dep is not None and dep.data is not None and \
+                        spec_.tile is not None:
+                    dc, key = dep.data(g, *p)
+                    slot_key = (dc.name, slot_maps[dc.name][tuple(key)])
+                    data_read_wave[slot_key] = max(
+                        data_read_wave.get(slot_key, -1), w)
+        for slot_key, w_r in reshaped_wmin.items():
+            if data_read_wave.get(slot_key, -1) > w_r:
+                raise NotImplementedError(
+                    f"tile {slot_key} is written with an Out-side "
+                    f"reshape and read back from the collection in a "
+                    f"later wave; store-based execution keeps raw "
+                    f"values — run this taskpool on the host runtime")
+
     plan = WavefrontPlan(taskpool=tp, waves=waves, collections=collections,
                          slot_maps=slot_maps, n_tasks=n,
-                         has_value_flows=has_value_flows)
+                         has_value_flows=has_value_flows,
+                         has_reshapes=has_reshapes,
+                         terminal_specs=terminal_specs)
     debug_verbose(3, "wavefront", "planned %s: %d tasks, %d waves",
                   tp.name, n, len(waves))
     return plan
@@ -413,6 +503,17 @@ class WavefrontExecutor:
         return list(self._normalize_outs(grp.tc, outs))
 
     # -- pure store-passing execution ------------------------------------
+    @staticmethod
+    def _apply_in_specs(grp: WaveGroup, inputs: List[Any]) -> List[Any]:
+        """Apply the group's composed dep [type=...] specs to the
+        gathered stacks (cast/transpose act on the last two axes, so
+        batched application is exact; ReshapeSpec.fn must be batch-safe
+        for compiled execution)."""
+        if not any(s is not None for s in grp.in_specs):
+            return inputs
+        return [s.apply(x) if s is not None else x
+                for s, x in zip(grp.in_specs, inputs)]
+
     def run_arrays(self, stores: Dict[str, Any]) -> Dict[str, Any]:
         """stores: name → (ntiles+1, mb, nb) array (last slot = dummy)."""
         jnp = self.jnp
@@ -428,6 +529,7 @@ class WavefrontExecutor:
                 for (name, idx) in grp.in_slots:
                     gidx = self._pad(idx, Bp, 0)
                     inputs.append(snapshot[name][gidx])
+                inputs = self._apply_in_specs(grp, inputs)
                 outs = self._exec_group(grp, Bp, inputs)
                 for (name, idx), val in zip(grp.out_slots, outs):
                     dummy = stores[name].shape[0] - 1
@@ -480,6 +582,7 @@ class WavefrontExecutor:
                 inputs = [self.jnp.stack([snapshot[(name, int(s))]
                                           for s in idx])
                           for (name, idx) in grp.in_slots]
+                inputs = self._apply_in_specs(grp, inputs)
                 outs = self._exec_group(grp, B, inputs)
                 for (name, idx), val in zip(grp.out_slots, outs):
                     for b, s in enumerate(idx):
@@ -508,14 +611,22 @@ class WavefrontExecutor:
              self.plan.collections[name].nb,
              np.dtype(self.plan.collections[name].dtype).str)
             for (name, _idx) in grp.in_slots) if grp.in_slots else ()
-        key = (grp.tc.name, batch, hooked, shapes)
+        sig = tuple(s.key if s is not None else None
+                    for s in grp.in_specs)
+        key = (grp.tc.name, batch, hooked, shapes, sig)
         fn = self._segments.get(key)
         if fn is None:
             body = self._body(grp.tc, batch,
                               grp if hooked else None)
-            fn = self.jax.jit(
-                lambda *ins, _b=body, _tc=grp.tc:
-                tuple(self._normalize_outs(_tc, _b(*ins))))
+            specs = tuple(grp.in_specs)
+
+            def seg(*ins, _b=body, _tc=grp.tc, _specs=specs):
+                if any(s is not None for s in _specs):
+                    ins = [s.apply(x) if s is not None else x
+                           for s, x in zip(_specs, ins)]
+                return tuple(self._normalize_outs(_tc, _b(*ins)))
+
+            fn = self.jax.jit(seg)
             self._segments[key] = fn
         return fn
 
@@ -540,7 +651,8 @@ class WavefrontExecutor:
                 tc=grp.tc, level=grp.level, tasks=grp.tasks[lo:hi],
                 in_slots=[(n, idx[lo:hi]) for (n, idx) in grp.in_slots],
                 out_slots=[(n, idx[lo:hi])
-                           for (n, idx) in grp.out_slots]))
+                           for (n, idx) in grp.out_slots],
+                in_specs=list(grp.in_specs)))
         return subs
 
     def _use_schedule(self) -> Dict[Tuple[str, int], List[int]]:
@@ -642,11 +754,14 @@ class WavefrontExecutor:
         return tiles
 
     def write_back_tiles(self, tiles: Dict[Tuple[str, int], Any]) -> None:
+        tspecs = self.plan.terminal_specs
         for name, dc in self.plan.collections.items():
             if dc.scratch:
                 continue      # nobody reads factor scratch after the run
             for key, slot in self.plan.slot_maps[name].items():
-                dc.write_tile(key, tiles[(name, slot)])
+                v = tiles[(name, slot)]
+                spec = tspecs.get((name, slot))
+                dc.write_tile(key, spec.apply(v) if spec is not None else v)
 
     # -- host-driven run --------------------------------------------------
     def make_stores(self) -> Dict[str, Any]:
@@ -663,8 +778,18 @@ class WavefrontExecutor:
         return stores
 
     def write_back(self, stores: Dict[str, Any]) -> None:
+        tspecs = self.plan.terminal_specs
         for name, dc in self.plan.collections.items():
             if dc.scratch:
+                continue
+            if any(k[0] == name for k in tspecs):
+                # per-tile path: some slots carry terminal [type=...]
+                # conversions the stacked write can't express
+                for key, slot in self.plan.slot_maps[name].items():
+                    v = stores[name][slot]
+                    spec = tspecs.get((name, slot))
+                    dc.write_tile(key, spec.apply(v)
+                                  if spec is not None else v)
                 continue
             dc.from_stacked(stores[name][:-1], self.plan.slot_maps[name])
 

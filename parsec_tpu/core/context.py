@@ -155,6 +155,16 @@ class Context:
         if self.comm is not None and hasattr(self.comm, "register_termdet"):
             self.comm.register_termdet(tp.name, tp.monitor)
         tp.context = self
+        if self.comm is not None and self.nb_ranks > 1:
+            # expose the taskpool's collections for one-sided tile
+            # fetches (CommEngine.fetch_tile): bodies using the
+            # direct-memory gathered-operand pattern resolve remote
+            # tiles through the owner's comm thread
+            g = getattr(tp, "g", None)
+            for obj in vars(g).values() if g is not None else ():
+                if hasattr(obj, "data_of") and hasattr(obj, "rank_of") \
+                        and hasattr(obj, "name"):
+                    self.comm.expose_collection(obj, scope=tp.name)
         with self._lock:
             self._active_taskpools.append(tp)
             self._taskpools_by_name[tp.name] = tp

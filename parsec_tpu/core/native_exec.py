@@ -43,11 +43,6 @@ class NativeDAGExecutor:
         lib = _native.load()
         if lib is None:
             raise RuntimeError("native core unavailable (no g++?)")
-        from ..dsl.ptg import taskpool_uses_reshape
-        if taskpool_uses_reshape(tp):
-            raise NotImplementedError(
-                "native DAG executor does not apply reshape specs; "
-                "run reshape-bearing taskpools on the host runtime")
         self.lib = lib
         self.tp = tp
         self.nworkers = max(1, nworkers)
@@ -64,8 +59,11 @@ class NativeDAGExecutor:
         n = len(self.tasks)
 
         # ---- dry-run successor iterators to build the edge list
-        # edge: (src_tid, dst_tid, src_flow, dst_flow)
-        self.in_edges: List[List[Tuple[int, str, str]]] = [[] for _ in range(n)]
+        # edge: (src_tid, src_flow, dst_flow, composed reshape spec) —
+        # dep [type=...] conversions are static per edge, applied when
+        # the consumer's input is attached (parsec_local_reshape analog)
+        self.in_edges: List[List[Tuple[int, str, str, object]]] = \
+            [[] for _ in range(n)]
         esrc, edst = [], []
         self.nconsumers = np.zeros(n, dtype=np.int64)
         for i, (tc, p) in enumerate(self.tasks):
@@ -79,7 +77,8 @@ class NativeDAGExecutor:
                 j = tid[(ref.task_class.name, tuple(ref.locals))]
                 esrc.append(i)
                 edst.append(j)
-                self.in_edges[j].append((i, ref.src_flow, ref.flow_name))
+                self.in_edges[j].append(
+                    (i, ref.src_flow, ref.flow_name, ref.reshape_spec))
                 self.nconsumers[i] += 1
 
         ndeps = np.array([len(e) for e in self.in_edges], dtype=np.int32)
@@ -110,10 +109,12 @@ class NativeDAGExecutor:
         try:
             tc, p = self.tasks[tid]
             task = Task(self.tp, tc, p)
-            for (i, src_flow, dst_flow) in self.in_edges[tid]:
+            for (i, src_flow, dst_flow, spec) in self.in_edges[tid]:
                 out = self._outputs[i]
-                task.data[dst_flow] = None if out is None \
-                    else out.get(src_flow)
+                v = None if out is None else out.get(src_flow)
+                if spec is not None and v is not None:
+                    v = spec.apply(v)
+                task.data[dst_flow] = v
             lookup = getattr(tc, "data_lookup", None)
             if lookup is not None:
                 lookup(task)
@@ -151,7 +152,7 @@ class NativeDAGExecutor:
                     f.name, task.data.get(f.name)) for f in tc.flows}
             # drop predecessor outputs once their last consumer ran
             with self._refcount_lock:
-                for (i, _sf, _df) in self.in_edges[tid]:
+                for (i, _sf, _df, _spec) in self.in_edges[tid]:
                     self._pending_consumers[i] -= 1
                     if self._pending_consumers[i] == 0:
                         self._outputs[i] = None

@@ -33,6 +33,10 @@ class ReshapeSpec:
     ``dtype``: cast target (numpy dtype name or jax dtype).
     ``transpose``: swap the last two axes.
     ``fn``: arbitrary transform ``value -> value`` (applied last).
+    For the compiled executors, which apply specs to whole gathered
+    stacks ``(batch, mb, nb)``, ``fn`` must be batch-safe — operate on
+    the last two axes only (dtype/transpose are batch-safe by
+    construction). The host runtime applies specs per value.
     ``name``: identity for caching — two specs with the same name are the
     same conversion. Specs built only from dtype/transpose get a canonical
     name automatically; specs with ``fn`` get a unique one unless named.

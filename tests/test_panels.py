@@ -244,3 +244,33 @@ def test_panel_gemm_matches_tile_dict():
         TiledMatrix.from_array(B_h.copy(), 64, 64, name="B"),
         C2))).run()
     assert np.allclose(C1.to_array(), C2.to_array(), atol=1e-4)
+
+
+@pytest.mark.parametrize("kb", [1, 2, 0])
+@pytest.mark.parametrize("beta", [1.0, 0.5])
+def test_panel_gemm_k_blocking_exact(kb, beta):
+    """k-blocked fusion (gemm.k_block) must reproduce the per-wave
+    chain bit-for-bit semantics, including β applied per chain step."""
+    from parsec_tpu.algorithms.gemm import build_gemm_ptg
+    from parsec_tpu.utils import mca_param
+
+    rng = np.random.default_rng(7)
+    A_h = rng.standard_normal((128, 192)).astype(np.float32)
+    B_h = rng.standard_normal((192, 128)).astype(np.float32)
+    C_h = rng.standard_normal((128, 128)).astype(np.float32)
+    A = TiledMatrix.from_array(A_h.copy(), 64, 64, name="A")
+    B = TiledMatrix.from_array(B_h.copy(), 64, 64, name="B")
+    C = TiledMatrix.from_array(C_h.copy(), 64, 64, name="C")
+    mca_param.set("gemm.k_block", kb)
+    try:
+        ex = PanelExecutor(plan_taskpool(
+            build_gemm_ptg(A, B, C, alpha=2.0, beta=beta)))
+        ex.run()
+    finally:
+        mca_param.unset("gemm.k_block")
+    KT = 3
+    ref = C_h.copy()
+    for k in range(KT):
+        ref = 2.0 * A_h[:, k * 64:(k + 1) * 64] @ \
+            B_h[k * 64:(k + 1) * 64] + beta * ref
+    assert np.allclose(C.to_array(), ref, atol=1e-3)

@@ -213,3 +213,137 @@ def test_ptg_collection_read_reshape(ctx):
     ctx.add_taskpool(tp)
     assert ctx.wait(timeout=30)
     assert store.data_of(("y",)).dtype == np.float32
+
+
+# ------------------------------------ compiled executors (dep [type=...])
+
+def _reshape_dag():
+    """SRC(i,j) produces A(i,j) -> DST(i,j) consumes it through a
+    composed Out∘In spec (transpose then x2) and writes B(i,j); DST's
+    terminal write carries its own Out-side spec (+1)."""
+    rng = np.random.default_rng(11)
+    A_h = rng.standard_normal((64, 64)).astype(np.float32)
+    A = TiledMatrix.from_array(A_h.copy(), 32, 32, name="A")
+    B = TiledMatrix.from_array(np.zeros((64, 64), np.float32), 32, 32,
+                               name="B")
+    t_spec = ReshapeSpec(transpose=True)
+    x2 = ReshapeSpec(fn=lambda v: v * 2, name="x2")
+    p1 = ReshapeSpec(fn=lambda v: v + 1, name="p1")
+    tp = ptg.Taskpool("creshape", A=A, B=B, MT=2, NT=2)
+    tp.task_class(
+        "SRC", params=("i", "j"),
+        space=lambda g: ((i, j) for i in range(g.MT) for j in range(g.NT)),
+        flows=[ptg.FlowSpec(
+            "V", ptg.RW,
+            tile=lambda g, i, j: (g.A, (i, j)),
+            ins=[ptg.In(data=lambda g, i, j: (g.A, (i, j)))],
+            outs=[ptg.Out(dst=("DST", lambda g, i, j: (i, j), "X"),
+                          reshape=t_spec)])])
+    DST = tp.task_class(
+        "DST", params=("i", "j"),
+        space=lambda g: ((i, j) for i in range(g.MT) for j in range(g.NT)),
+        flows=[
+            ptg.FlowSpec(
+                "X", ptg.READ,
+                tile=lambda g, i, j: (g.A, (i, j)),
+                ins=[ptg.In(src=("SRC", lambda g, i, j: (i, j), "V"),
+                            reshape=x2)]),
+            ptg.FlowSpec(
+                "C", ptg.WRITE,
+                tile=lambda g, i, j: (g.B, (i, j)),
+                outs=[ptg.Out(data=lambda g, i, j: (g.B, (i, j)),
+                              reshape=p1)])])
+
+    @tp.get_task_class("SRC").body
+    def src_body(task, V):
+        return V
+
+    @DST.body
+    def dst_body(task, X, C):
+        return {"C": X}
+
+    # expected B tile (i,j) = 2·A(i,j)ᵀ + 1
+    expect = np.zeros((64, 64), np.float32)
+    for i in range(2):
+        for j in range(2):
+            expect[i*32:(i+1)*32, j*32:(j+1)*32] = \
+                2.0 * A_h[i*32:(i+1)*32, j*32:(j+1)*32].T + 1.0
+    return tp, B, expect
+
+
+def test_reshape_host_runtime_tiled(ctx):
+    tp, B, expect = _reshape_dag()
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=60)
+    np.testing.assert_allclose(B.to_array(), expect, atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["tile_dict", "stacked", "segmented"])
+def test_reshape_compiled_executors(mode):
+    """The compiled wavefront paths apply composed dep specs at gather
+    and terminal Out specs at write_back (refusal deleted)."""
+    import jax
+    from parsec_tpu.compiled.wavefront import (WavefrontExecutor,
+                                               plan_taskpool)
+    tp, B, expect = _reshape_dag()
+    plan = plan_taskpool(tp)
+    assert plan.has_reshapes
+    ex = WavefrontExecutor(plan)
+    if mode == "tile_dict":
+        out = jax.jit(ex.run_tile_dict)(ex.make_tiles())
+        ex.write_back_tiles(out)
+    elif mode == "segmented":
+        out = ex.run_tile_dict_segmented(ex.make_tiles())
+        ex.write_back_tiles(out)
+    else:
+        ex.run()
+    np.testing.assert_allclose(B.to_array(), expect, atol=1e-5)
+
+
+def test_reshape_native_executor():
+    from parsec_tpu import _native
+    from parsec_tpu.core.native_exec import NativeDAGExecutor
+    if _native.load() is None:
+        pytest.skip("native core unavailable")
+    tp, B, expect = _reshape_dag()
+    NativeDAGExecutor(tp, nworkers=2).run()
+    np.testing.assert_allclose(B.to_array(), expect, atol=1e-5)
+
+
+def test_reshape_panel_executor_refuses():
+    from parsec_tpu.compiled.panels import PanelExecutor
+    from parsec_tpu.compiled.wavefront import plan_taskpool
+    tp, B, expect = _reshape_dag()
+    tp.wave_fuser = lambda wave, geoms: (lambda st: st)
+    with pytest.raises(ValueError, match="reshape"):
+        PanelExecutor(plan_taskpool(tp))
+
+
+def test_reshape_write_then_later_read_refused():
+    """A reshaped terminal write observed by a later collection read has
+    no store representation — the planner must refuse."""
+    from parsec_tpu.compiled.wavefront import plan_taskpool
+    A = TiledMatrix.from_array(np.zeros((32, 32), np.float32), 32, 32,
+                               name="A")
+    p1 = ReshapeSpec(fn=lambda v: v + 1, name="p1")
+    tp = ptg.Taskpool("rwr", A=A)
+    tp.task_class(
+        "W", params=("i",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "V", ptg.RW,
+            tile=lambda g, i: (g.A, (0, 0)),
+            ins=[ptg.In(data=lambda g, i: (g.A, (0, 0)))],
+            outs=[ptg.Out(data=lambda g, i: (g.A, (0, 0)), reshape=p1),
+                  ptg.Out(dst=("R", lambda g, i: (0,), "K"))])])
+    tp.task_class(
+        "R", params=("i",), space=lambda g: ((0,),),
+        flows=[
+            ptg.FlowSpec("K", ptg.CTL,
+                         ins=[ptg.In(src=("W", lambda g, i: (0,), "V"))]),
+            ptg.FlowSpec(
+                "V", ptg.RW,
+                tile=lambda g, i: (g.A, (0, 0)),
+                ins=[ptg.In(data=lambda g, i: (g.A, (0, 0)))],
+                outs=[ptg.Out(data=lambda g, i: (g.A, (0, 0)))])])
+    with pytest.raises(NotImplementedError, match="reshape"):
+        plan_taskpool(tp)

@@ -219,6 +219,37 @@ def scenario_potrf(ctx, engine, rank, nb_ranks, n=192, nb=32):
     return len(list(A.local_keys()))
 
 
+def scenario_potrf_left(ctx, engine, rank, nb_ranks, n=192, nb=32):
+    """The left-looking flagship taskpool multi-rank: UPDATE's gathered
+    operands resolve remote tiles through the one-sided fetch_tile
+    service (CTL-gather ordering makes the fetches race-free)."""
+    from parsec_tpu.algorithms.potrf import build_potrf_left
+    from parsec_tpu.data.matrix import TiledMatrix, TwoDimBlockCyclic
+
+    rng = np.random.default_rng(0)
+    M = rng.standard_normal((n, n)).astype(np.float64)
+    A_host = (M @ M.T + n * np.eye(n)).astype(np.float32)
+    dist = TwoDimBlockCyclic(P=nb_ranks, Q=1)
+    A = TiledMatrix.from_array(A_host.copy(), nb, nb, dist=dist,
+                               myrank=rank, name="A")
+    tp = build_potrf_left(A)
+    ctx.add_taskpool(tp)
+    ctx.start()
+    assert ctx.wait(timeout=90), \
+        f"rank {rank}: potrf_left did not terminate"
+    L_ref = np.linalg.cholesky(A_host.astype(np.float64))
+    for (i, j) in A.local_keys():
+        if j > i:
+            continue
+        tile = np.asarray(A.data_of((i, j)), dtype=np.float64)
+        ref = L_ref[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb]
+        if i == j:
+            tile = np.tril(tile)
+        err = np.linalg.norm(tile - ref) / max(1e-30, np.linalg.norm(ref))
+        assert err < 1e-3, f"rank {rank} tile ({i},{j}) err {err}"
+    return len(list(A.local_keys()))
+
+
 def scenario_jax_values(ctx, engine, rank, nb_ranks, n=4096):
     """Bodies produce device-resident jax.Arrays that cross rank
     boundaries: the engine must snapshot them to host numpy at the comm
@@ -299,6 +330,14 @@ def test_rendezvous_2ranks():
 
 def test_potrf_2ranks():
     _run_ranks("scenario_potrf", 2)
+
+
+def test_potrf_left_2ranks():
+    _run_ranks("scenario_potrf_left", 2)
+
+
+def test_potrf_left_3ranks():
+    _run_ranks("scenario_potrf_left", 3)
 
 
 def test_jax_values_2ranks():
