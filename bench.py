@@ -99,6 +99,13 @@ def _measure_latency():
                             eager_limit=64 * 1024)
         out["rdv_1M_p50_us"] = round(r["p50_us"], 1)
         out["rdv_1M_p90_us"] = round(r["p90_us"], 1)
+        # device-resident payload: D2H at send, comm-thread device_put
+        # at receive (comm.stage_recv) — the runtime-path wire cost for
+        # accelerator tiles
+        r = measure_latency(payload_bytes=1 << 18, hops=24,
+                            device_payload=True)
+        out["device_256k_p50_us"] = round(r["p50_us"], 1)
+        out["device_256k_p90_us"] = round(r["p90_us"], 1)
     except Exception as exc:  # noqa: BLE001 — never sink the main metric
         out["error"] = str(exc)[:200]
     return out
@@ -169,6 +176,16 @@ def _measure_extras(jax, jnp, np, on_tpu):
             ctx.start()
             A = TiledMatrix.from_array(A_h.copy(), nb, nb, name="A")
             B = TiledMatrix.from_array(B_h.copy(), nb, nb, name="B")
+            # warm run: the pure-body jit compiles once per process;
+            # the reference similarly excludes CUDA module load/compile
+            # from its steady-state device numbers
+            Cw = TiledMatrix.from_array(C_h.copy(), nb, nb, name="Cw")
+            tpw = dtd.Taskpool("gemm_warm")
+            ctx.add_taskpool(tpw)
+            insert_gemm_dtd(tpw, A, B, Cw)
+            tpw.wait()
+            jax.block_until_ready(
+                [Cw.data_of(k) for k in Cw.local_keys()])
             C = TiledMatrix.from_array(C_h.copy(), nb, nb, name="C")
             tp = dtd.Taskpool("gemm_bench")
             ctx.add_taskpool(tp)
@@ -224,9 +241,11 @@ def _measure_extras(jax, jnp, np, on_tpu):
             "host_runtime_gflops": round(flops / host_s / 1e9, 1),
             "compiled_gflops": round(flops / comp_s / 1e9, 1),
             "host_vs_compiled": round(comp_s / host_s, 4),
-            "note": "host runtime pays per-task dispatch over the axon "
-                    "tunnel (~0.1 s roundtrip class); on a local TPU "
-                    "host the gap is launch overhead only",
+            "note": "host runtime: pure-body jitted DTD dispatch "
+                    "(dsl/dtd.py pure=True) pipelines asynchronously; "
+                    "measured per-task cost ~2.3 ms = ~1.4 ms link "
+                    "dispatch floor (chained-jit probe) + Python "
+                    "runtime overhead",
         }
     except Exception as exc:  # noqa: BLE001
         out["dtd_gemm"] = {"error": str(exc)[:200]}
@@ -310,6 +329,47 @@ def _measure_extras(jax, jnp, np, on_tpu):
             "rel_residual_check": float(f"{errq:.3e}")}
     except Exception as exc:  # noqa: BLE001
         out["geqrf_fused"] = {"error": str(exc)[:200]}
+
+    # -- out-of-core POTRF: segmented executor under an HBM budget --------
+    # Budgeted execution with manager-MEASURED residency (peak_bytes ==
+    # budget, spills > 0): the matrix exceeds the budget and the run
+    # completes by staging/evicting through the HBMManager (Belady from
+    # the plan's use schedule). Scale note: a matrix above the PHYSICAL
+    # 15.75 GB HBM is infeasible through the axon tunnel — measured
+    # host<->device bandwidth is ~19 MB/s D2H / ~6 MB/s H2D, so the
+    # tens-of-GB spill traffic would take hours; the budget knob
+    # exercises the identical mechanism at tunnel-feasible scale.
+    try:
+        from parsec_tpu.algorithms.potrf import (build_potrf,
+                                                 potrf_flops)
+        from parsec_tpu.device.hbm import HBMManager
+        no, nbo, budget_mb = (8192, 1024, 128) if on_tpu else (512, 128, 1)
+        Mo = rng.standard_normal((no, no)).astype(np.float32)
+        A_in = (Mo @ Mo.T / no + 2 * np.eye(no)).astype(np.float32)
+        del Mo
+        Ao = TiledMatrix.from_array(A_in.copy(), nbo, nbo, name="A")
+        exo = WavefrontExecutor(plan_taskpool(build_potrf(Ao)))
+        mgr = HBMManager(budget_mb << 20)
+        t0 = time.perf_counter()
+        tiles_o = exo.make_tiles(host=True)
+        out_o = exo.run_tile_dict_segmented(tiles_o, manager=mgr)
+        exo.write_back_tiles(out_o)
+        dt_o = time.perf_counter() - t0
+        Lo = np.tril(Ao.to_array().astype(np.float64))
+        res_o = float(np.linalg.norm(Lo @ Lo.T - A_in) /
+                      np.linalg.norm(A_in))
+        out["ooc_potrf"] = {
+            "n": no, "tile": nbo, "budget_mb": budget_mb,
+            "matrix_mb": no * no * 4 >> 20,
+            "run_s": round(dt_o, 1),
+            "gflops": round(potrf_flops(no) / dt_o / 1e9, 1),
+            "rel_residual": float(f"{res_o:.3e}"),
+            "hbm_measured": {k: int(v) for k, v in mgr.stats.items()},
+            "note": "manager-measured residency; above-physical-HBM "
+                    "sizes blocked by tunnel bandwidth (~19/6 MB/s)"}
+        del out_o, tiles_o, A_in
+    except Exception as exc:  # noqa: BLE001
+        out["ooc_potrf"] = {"error": str(exc)[:200]}
 
     # -- transformer FFN+attention: compiled ring-attention step ----------
     try:
@@ -480,6 +540,90 @@ def main():
     err = float(jax.jit(residual)(out, jax.random.PRNGKey(0)))
     del out
 
+    # -- precision-knob variant: the SAME flagship taskpool/executor at
+    # matmul_precision=highest (6-pass f32 MXU emulation) + exact
+    # triangular solves (trsm_hook=solve) — converts the bf16 headline
+    # into a defensible dpotrf claim (value + residual side by side).
+    # Np < N keeps the extra compile bounded; the path is identical.
+    precision = {}
+    if os.environ.get("PARSEC_BENCH_PRECISION", "1") != "0":
+        try:
+            from parsec_tpu.utils import mca_param
+            Np = min(N, int(os.environ.get("PARSEC_BENCH_PREC_N", 24576)))
+            NTp = Np // NB
+            mca_param.set("ops.matmul_precision", "highest")
+            mca_param.set("potrf.trsm_hook", "solve")
+            try:
+                Ap = TiledMatrix(Np, Np, NB, NB, name="A")
+                exp_ = PanelExecutor(plan_taskpool(build_potrf_left(Ap)))
+
+                def gen_p(key):
+                    R = jax.random.normal(key, (Np, Np), jnp.float32)
+                    return {"A": R.at[jnp.arange(Np), jnp.arange(Np)].add(
+                        2.0 * Np)}
+
+                def run_p(st):
+                    o = exp_.run_state(st)
+                    return jnp.sum(o["A"]), o
+
+                red_p = jax.jit(run_p, donate_argnums=0)
+                gen_pj = jax.jit(gen_p)
+                tot, op = red_p(gen_pj(jax.random.PRNGKey(3)))
+                float(tot)                       # compile + warm
+                del op
+                ps = []
+                for i in range(3):
+                    st = gen_pj(jax.random.PRNGKey(3))
+                    jax.block_until_ready(st)
+                    t0 = time.perf_counter()
+                    float(lat_f(jnp.float32(i)))
+                    lp = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    tot, op = red_p(st)
+                    float(tot)
+                    ps.append(max(time.perf_counter() - t0 - lp, 1e-6))
+                    if i < 2:
+                        del op
+                dtp = sorted(ps)[1]
+
+                def resid_p(o, key):
+                    x = jax.random.normal(jax.random.fold_in(key, 77),
+                                          (Np, 8), jnp.float32)
+                    D0 = gen_p(key)["A"]
+                    y = jnp.zeros((Np, 8), jnp.float32)
+                    # same block-row probe as the headline residual
+                    for j in range(NTp):
+                        Dj = D0[j * NB:(j + 1) * NB]
+                        d = Dj[:, j * NB:(j + 1) * NB]
+                        yj = 0.5 * (d + d.T) @ x[j * NB:(j + 1) * NB]
+                        if j < NTp - 1:
+                            tail = Dj[:, (j + 1) * NB:]
+                            yj = yj + tail @ x[(j + 1) * NB:]
+                            y = y.at[(j + 1) * NB:].add(
+                                tail.T @ x[j * NB:(j + 1) * NB])
+                        y = y.at[j * NB:(j + 1) * NB].add(yj)
+                    Lt = o["A"]
+                    z = jnp.concatenate(
+                        [Lt[j * NB:(j + 1) * NB, j * NB:] @ x[j * NB:]
+                         for j in range(NTp)], axis=0)
+                    y2 = jnp.concatenate(
+                        [Lt[0:(i + 1) * NB, i * NB:(i + 1) * NB].T @
+                         z[0:(i + 1) * NB] for i in range(NTp)], axis=0)
+                    return jnp.linalg.norm(y2 - y) / jnp.linalg.norm(y)
+
+                errp = float(jax.jit(resid_p)(op, jax.random.PRNGKey(3)))
+                del op
+                precision = {
+                    "n": Np, "matmul_precision": "highest",
+                    "trsm_hook": "solve",
+                    "gflops": round(potrf_flops(Np) / dtp / 1e9, 2),
+                    "rel_residual_check": float(f"{errp:.3e}")}
+            finally:
+                mca_param.unset("ops.matmul_precision")
+                mca_param.unset("potrf.trsm_hook")
+        except Exception as exc:  # noqa: BLE001
+            precision = {"error": str(exc)[:200]}
+
     # latency drifts on minute scales: re-sample immediately before the
     # peak-proxy timed run rather than reusing the POTRF-loop median
     lat_peak = sorted(_timed(lambda i=i: float(lat_f(jnp.float32(i))))
@@ -513,12 +657,13 @@ def main():
             "run_s": round(dt, 4),
             "link_latency_s": round(lat, 4),
             "rel_residual_check": float(f"{err:.3e}"),
+            "precision_variant": precision,
             "latency": latency,
             # flagship path memory: one donated Aᵀ array + the carry row
             # panel; XLA memory_analysis measured temp ≈ matrix size
-            # (in-place DUS chain). Bounded-budget execution (HBM
-            # manager + segmented executor, device.hbm_budget_mb) is
-            # exercised by tests/test_hbm.py.
+            # (in-place DUS chain). MANAGER-MEASURED budgeted execution
+            # (peak_bytes == budget, spills) is reported live in
+            # extra_configs.ooc_potrf.
             "hbm": {"matrix_bytes": N * N * 4,
                     "est_peak_bytes": 2 * N * N * 4 + NB * N * 4},
             # remaining BASELINE.md configs (DTD GEMM host-vs-compiled,

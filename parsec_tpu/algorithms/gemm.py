@@ -136,23 +136,27 @@ def _make_gemm_wave_fuser(alpha: float, beta: float):
     return fuser
 
 
+def _gemm_dtd_body(a, b, c, alpha, beta):
+    # module-level (stable identity): the pure-body jit cache is keyed
+    # by fn, so every GEMM taskpool in the process shares one compile
+    return gemm_tile(c, a, b, alpha=alpha, beta=beta)
+
+
 def insert_gemm_dtd(tp: "dtd.Taskpool", A: TiledMatrix, B: TiledMatrix,
                     C: TiledMatrix, alpha: float = 1.0,
                     beta: float = 1.0) -> None:
     """Insert the full tiled-GEMM DAG into a DTD taskpool (the
     dtd_test-style driver loop, insert_function.c varargs shape)."""
-    def body(a, b, c):
-        return gemm_tile(c, a, b, alpha=alpha, beta=beta)
-
     for m in range(C.mt):
         for n in range(C.nt):
             for k in range(A.nt):
                 tp.insert_task(
-                    body,
+                    _gemm_dtd_body,
                     dtd.TileArg(A, (m, k), dtd.INPUT),
                     dtd.TileArg(B, (k, n), dtd.INPUT),
                     dtd.TileArg(C, (m, n), dtd.INOUT, affinity=True),
-                    name=f"GEMM({m},{n},{k})")
+                    dtd.ValueArg(alpha), dtd.ValueArg(beta),
+                    name=f"GEMM({m},{n},{k})", pure=True)
 
 
 def gemm_flops(m: int, n: int, k: int) -> float:

@@ -245,15 +245,24 @@ def _potrf_wave_fuser(wave, geoms):
         if ms != list(range(ms[0], ms[0] + len(ms))):
             return None        # rows must be one contiguous panel
 
+        solve_mode = mca_param.get("potrf.trsm_hook", "gemm") == "solve"
+
         def do_trsm(st, k=k, lo=ms[0], hi=ms[-1] + 1):
+            import jax
             from ..ops.tile_kernels import tri_inv_tile
             D = st[geom.name]
             c = geom.cols(k)
-            # Lᵀ[k,k] stored upper → recover L, invert once per wave
-            inv = tri_inv_tile(D[c, geom.rows(k)].T)
+            # Lᵀ[k,k] stored upper → recover L
+            L = D[c, geom.rows(k)].T
+            rest = D[c, lo * mb:hi * mb]
+            if solve_mode:        # exact wide solve, no inversion
+                solved = jax.scipy.linalg.solve_triangular(
+                    L.astype(jnp.float32), rest.astype(jnp.float32),
+                    lower=True).astype(D.dtype)
+            else:                 # invert once per wave, solve as matmul
+                solved = mm(tri_inv_tile(L), rest).astype(D.dtype)
             # C ← C·L⁻ᵀ transposed: Cᵀ ← L⁻¹·Cᵀ, one contiguous row panel
-            st[geom.name] = D.at[c, lo * mb:hi * mb].set(
-                mm(inv, D[c, lo * mb:hi * mb]))
+            st[geom.name] = D.at[c, lo * mb:hi * mb].set(solved)
             return st
 
         return do_trsm
@@ -494,6 +503,8 @@ def _potrf_left_wave_fuser(wave, geoms):
 
         return do_update
 
+    solve_mode = mca_param.get("potrf.trsm_hook", "gemm") == "solve"
+
     if names == ["POTRF"]:
         (grp,) = wave
         if len(grp.tasks) != 1:
@@ -511,7 +522,8 @@ def _potrf_left_wave_fuser(wave, geoms):
             # the average form fuses cleanly)
             diag = 0.5 * (diag + diag.T)
             L = tile_chol(diag)
-            st["_potrf_inv"] = tri_inv_tile(L)
+            if not solve_mode:
+                st["_potrf_inv"] = tri_inv_tile(L)
             if last:
                 # no TRSM wave follows: this step's single write is ours
                 st[geom.name] = D.at[c, r].set(L.T)
@@ -537,23 +549,36 @@ def _potrf_left_wave_fuser(wave, geoms):
             return None
 
         def do_trsm(st, k=k, lo=ms[0], hi=ms[-1] + 1):
+            import jax
             from ..ops.tile_kernels import tri_inv_tile
             D = st[geom.name]
             c = geom.cols(k)
-            inv = st.pop("_potrf_inv", None)
             L = st.pop("_potrf_L", None)
-            if inv is None:      # robustness: recompute from the factor
-                inv = tri_inv_tile(D[c, geom.rows(k)].T)
             rest = st.pop("_rowk_rest", None)
             if rest is None:     # k = 0: no UPDATE wave preceded
                 rest = D[c, lo * mb:hi * mb]
-            solved = mm(inv, rest)
+            if solve_mode:
+                # exact wide triangular solve (potrf.trsm_hook=solve):
+                # no inversion, no condition-number squaring
+                if L is None:
+                    L = D[c, geom.rows(k)].T
+                st.pop("_potrf_inv", None)
+                solved = jax.scipy.linalg.solve_triangular(
+                    L.astype(jnp.float32), rest.astype(jnp.float32),
+                    lower=True)
+            else:
+                inv = st.pop("_potrf_inv", None)
+                if inv is None:  # robustness: recompute from the factor
+                    inv = tri_inv_tile(D[c, geom.rows(k)].T)
+                solved = mm(inv, rest)
             if L is not None and lo == k + 1:
                 # one contiguous row-panel write: Lᵀ diag + solved rest
                 st[geom.name] = D.at[c, k * mb:hi * mb].set(
-                    jnp.concatenate([L.T, solved], axis=1))
+                    jnp.concatenate([L.T, solved.astype(D.dtype)],
+                                    axis=1))
             else:
-                st[geom.name] = D.at[c, lo * mb:hi * mb].set(solved)
+                st[geom.name] = D.at[c, lo * mb:hi * mb].set(
+                    solved.astype(D.dtype))
             return st
 
         return do_trsm

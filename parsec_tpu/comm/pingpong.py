@@ -52,7 +52,7 @@ class _AlternatingVec:
     """1-D scalar-tile collection alternating ownership by index."""
 
     def __init__(self, n: int, nb_ranks: int, my_rank: int,
-                 payload_f32: int):
+                 payload_f32: int, device: bool = False):
         self.n = n
         self.nb_ranks = nb_ranks
         self.my_rank = my_rank
@@ -60,7 +60,11 @@ class _AlternatingVec:
         self.payload_f32 = payload_f32
         self.v = {}
         if self.rank_of((0,)) == my_rank:
-            self.v[0] = np.zeros(payload_f32, dtype=np.float32)
+            init = np.zeros(payload_f32, dtype=np.float32)
+            if device:
+                import jax
+                init = jax.device_put(init)
+            self.v[0] = init
 
     def _k(self, key):
         return key[0] if isinstance(key, (tuple, list)) else key
@@ -75,7 +79,7 @@ class _AlternatingVec:
         self.v[self._k(key)] = value
 
 
-def _build_chain(hops: int, A):
+def _build_chain(hops: int, A, device: bool = False):
     from ..dsl import ptg
 
     tp = ptg.Taskpool("pingpong", N=hops, A=A)
@@ -101,13 +105,20 @@ def _build_chain(hops: int, A):
     @tp.task_class_by_name("HOP").body(batchable=False)
     def hop_body(task, T):
         hop_times.append(time.perf_counter())
+        if device:
+            # device-resident payload round trip: the hop's work runs on
+            # the accelerator, so every wire crossing pays the real
+            # D2H-at-send / stage-to-device-at-receive path
+            import jax.numpy as jnp
+            return jnp.asarray(T) + 1.0
         return T + 1.0
 
     return tp, hop_times
 
 
 def _rank_main(rank: int, nb_ranks: int, base_port: int, hops: int,
-               payload_f32: int, eager_limit: int, q) -> None:
+               payload_f32: int, eager_limit: int, q,
+               device: bool = False) -> None:
     try:
         from ..comm.socket_engine import SocketCommEngine
         from ..core import context as ctx_mod
@@ -116,8 +127,9 @@ def _rank_main(rank: int, nb_ranks: int, base_port: int, hops: int,
         mca_param.set("comm.eager_limit", eager_limit)
         engine = SocketCommEngine(rank, nb_ranks, base_port=base_port)
         ctx = ctx_mod.init(nb_cores=1, comm=engine)
-        A = _AlternatingVec(hops, nb_ranks, rank, payload_f32)
-        tp, hop_times = _build_chain(hops, A)
+        A = _AlternatingVec(hops, nb_ranks, rank, payload_f32,
+                            device=device)
+        tp, hop_times = _build_chain(hops, A, device=device)
         ctx.add_taskpool(tp)
         t0 = time.perf_counter()
         ctx.start()         # enables the comm thread; hop stamps carry
@@ -141,16 +153,20 @@ def _rank_main(rank: int, nb_ranks: int, base_port: int, hops: int,
 
 def measure_latency(payload_bytes: int = 1024, hops: int = 200,
                     eager_limit: int = 256 * 1024,
-                    timeout: float = 300.0) -> Dict:
+                    timeout: float = 300.0,
+                    device_payload: bool = False) -> Dict:
     """Spawn 2 ranks, bounce a ``payload_bytes`` array ``hops`` times,
-    return percentile activate→data latencies in microseconds."""
+    return percentile activate→data latencies in microseconds.
+    ``device_payload=True``: the payload lives on the accelerator at
+    each end — hops measure the full device→wire→device path (D2H
+    snapshot at send, comm-thread device_put at receive)."""
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     base_port = _free_port_base()
     payload_f32 = max(payload_bytes // 4, 1)
     procs = [ctx.Process(target=_rank_main,
                          args=(r, 2, base_port, hops, payload_f32,
-                               eager_limit, q))
+                               eager_limit, q, device_payload))
              for r in range(2)]
     for p in procs:
         p.start()

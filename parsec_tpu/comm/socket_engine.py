@@ -48,6 +48,10 @@ mca_param.register("comm.eager_limit", 256 * 1024,
 mca_param.register("comm.aggregate", True,
                    help="coalesce same-peer activations into one frame "
                         "(parsec_param_enable_aggregate analog)")
+mca_param.register("comm.stage_recv", "auto",
+                   help="stage received array payloads to the device on "
+                        "the comm thread: auto (accelerator backends "
+                        "only) | 1 | 0")
 mca_param.register("comm.wireup_timeout_s", 30.0,
                    help="seconds to wait for the full mesh to connect")
 
@@ -564,8 +568,52 @@ class SocketCommEngine(CommEngine):
             return
         self._finish_activation(tp, src, msg, msg.get("value"))
 
+    @staticmethod
+    def stage_recv_value(value: Any):
+        """Stage received array payloads onto the accelerator on the
+        comm thread (async device_put): the consumer's body then starts
+        from device-resident operands instead of paying a synchronous
+        H2D at dispatch — the receive half of the reference's
+        registered-memory PUT landing in device-visible memory
+        (remote_dep_mpi.c:1594-1729). Gated by ``comm.stage_recv``
+        (auto = only when the default backend is an accelerator)."""
+        import sys
+        import numpy as np
+        mode = str(mca_param.get("comm.stage_recv", "auto"))
+        if mode in ("0", "off", "false"):
+            return value
+        # never INITIALIZE a backend from the comm thread: staging only
+        # applies when this process already uses jax (importing it here
+        # would spin up the accelerator runtime inside host-only rank
+        # processes — and raise/block on exclusive-access chips)
+        if "jax" not in sys.modules:
+            return value
+        try:
+            import jax
+            if mode == "auto" and jax.default_backend() == "cpu":
+                return value
+        except Exception:  # noqa: BLE001 — staging is best-effort
+            return value
+
+        def stage(v):
+            if isinstance(v, np.ndarray) and v.nbytes >= 4096:
+                try:
+                    return jax.device_put(v)
+                except Exception:  # noqa: BLE001 — staging is best-effort
+                    return v
+            if isinstance(v, tuple):
+                return tuple(stage(x) for x in v)
+            if isinstance(v, list):
+                return [stage(x) for x in v]
+            if isinstance(v, dict):
+                return {k: stage(x) for k, x in v.items()}
+            return v
+
+        return stage(value)
+
     def _finish_activation(self, tp, src: int, msg: Dict, value) -> None:
         from ..core.taskpool import SuccessorRef
+        value = self.stage_recv_value(value)
         tc = tp.get_task_class(msg["class"])
         ref = SuccessorRef(task_class=tc, locals=tuple(msg["locals"]),
                            flow_name=msg["flow"], value=value,

@@ -274,3 +274,27 @@ def test_panel_gemm_k_blocking_exact(kb, beta):
         ref = 2.0 * A_h[:, k * 64:(k + 1) * 64] @ \
             B_h[k * 64:(k + 1) * 64] + beta * ref
     assert np.allclose(C.to_array(), ref, atol=1e-3)
+
+
+@pytest.mark.parametrize("builder", ["left", "right"])
+def test_panel_potrf_trsm_solve_mode(builder):
+    """potrf.trsm_hook=solve: the fusers use exact triangular solves
+    (no inversion) and must match numpy chol closely."""
+    from parsec_tpu.algorithms.potrf import build_potrf, build_potrf_left
+    from parsec_tpu.utils import mca_param
+
+    rng = np.random.default_rng(9)
+    n, nb = 128, 32
+    M = rng.standard_normal((n, n)).astype(np.float64)
+    A_in = (M @ M.T + n * np.eye(n)).astype(np.float32)
+    A = TiledMatrix.from_array(A_in.copy(), nb, nb, name="A")
+    mca_param.set("potrf.trsm_hook", "solve")
+    try:
+        build = build_potrf_left if builder == "left" else build_potrf
+        ex = PanelExecutor(plan_taskpool(build(A)))
+        ex.run()
+    finally:
+        mca_param.unset("potrf.trsm_hook")
+    L = np.tril(A.to_array().astype(np.float64))
+    ref = np.linalg.cholesky(A_in.astype(np.float64))
+    np.testing.assert_allclose(L, ref, rtol=1e-4, atol=1e-4)
