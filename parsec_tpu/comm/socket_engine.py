@@ -422,6 +422,9 @@ class SocketCommEngine(CommEngine):
                     self.rank, tag, exc)
             import traceback
             traceback.print_exc()
+            from ..utils import debug_history
+            debug_history.dump_on_fatal(
+                f"rank {self.rank} AM handler tag={tag} raised")
 
     # ------------------------------------------------------------ send API
     def send_am(self, tag: int, dst_rank: int, msg: Any) -> None:
@@ -505,6 +508,12 @@ class SocketCommEngine(CommEngine):
         msg = {"taskpool": tp.name, "class": ref.task_class.name,
                "locals": tuple(ref.locals), "flow": ref.flow_name,
                "dep_index": ref.dep_index, "priority": ref.priority}
+        from ..utils import debug_history
+        if debug_history.enabled():   # DEBUG_MARK_CTL_MSG_ACTIVATE_SENT
+            debug_history.mark("ACTIVATE_SENT to=%d %s.%s%r flow=%s",
+                               target_rank, tp.name,
+                               ref.task_class.name, tuple(ref.locals),
+                               ref.flow_name)
         value = self.wire_value(ref.value)
         nbytes = self.payload_bytes(value)
         eager_limit = int(mca_param.get("comm.eager_limit", 256 * 1024))
@@ -550,6 +559,11 @@ class SocketCommEngine(CommEngine):
 
     def _deliver_activation(self, tp, src: int, msg: Dict) -> None:
         from ..core.taskpool import SuccessorRef
+        from ..utils import debug_history
+        if debug_history.enabled():   # DEBUG_MARK_CTL_MSG_ACTIVATE_RECV
+            debug_history.mark("ACTIVATE_RECV from=%d %s.%s%r flow=%s",
+                               src, tp.name, msg["class"],
+                               tuple(msg["locals"]), msg["flow"])
         self.record_msg("recv", "activate", src,
                         msg.get("nbytes",
                                 self.payload_bytes(msg.get("value"))))

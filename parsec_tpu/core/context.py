@@ -320,6 +320,8 @@ class Context:
                 warning("scheduling", "task %r raised: %s", task, exc)
                 import traceback
                 traceback.print_exc()
+                from ..utils import debug_history
+                debug_history.dump_on_fatal(f"task {task!r} raised")
                 # successors can never fire: abort the pool so waiters are
                 # released with the error instead of hanging (parsec_abort)
                 task.taskpool.abort(exc)
@@ -354,6 +356,11 @@ class Context:
         """__parsec_execute analog (scheduling.c:124-203): try incarnations
         in declaration order, skipping masked/vetoed ones."""
         tc = task.task_class
+        from ..utils import debug_history
+        if debug_history.enabled():     # DEBUG_MARK_EXE analog
+            debug_history.mark("EXE %s%r es=%s", tc.name,
+                               tuple(task.locals),
+                               getattr(es, "th_id", -1))
         for i, chore in enumerate(tc.incarnations):
             if not (task.chore_mask & (1 << i)):
                 continue

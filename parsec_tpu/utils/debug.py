@@ -1,9 +1,12 @@
-"""Leveled debug/output streams with a history ring.
+"""Leveled debug/output streams with a recent-log capture ring.
 
-Mirrors the reference's debug facility (parsec/utils/debug.h:39-76,
-utils/output.c): verbosity-leveled streams plus a fixed-size, thread-safe
-history ring buffer that captures recent messages for post-mortem dumps
-(the reference's ``parsec_debug_history``).
+Mirrors the reference's debug OUTPUT facility (parsec/utils/debug.h:
+39-76, utils/output.c): verbosity-leveled streams plus a fixed-size,
+thread-safe ring capturing recently FORMATTED log lines for post-mortem
+dumps. The structural-event history (the reference's
+``parsec_debug_history`` / debug_marks.h EXE/ACTIVATE marks) is the
+separate :mod:`~parsec_tpu.utils.debug_history` module — this ring
+records what was logged, that one records what the runtime did.
 """
 
 from __future__ import annotations
@@ -51,7 +54,9 @@ def fatal(stream: str, msg: str, *args) -> None:
 
 
 def history_dump() -> str:
-    """Dump the debug-history ring (debug.h:57-76 analog)."""
+    """Dump the recent-LOG capture ring (formatted lines). For the
+    structural EXE/ACTIVATE mark history use
+    ``parsec_tpu.utils.debug_history.dump``."""
     with _lock:
         lines = [f"{t:.6f} [{lvl}] {m}" for (t, lvl, m) in _history]
     return "\n".join(lines)

@@ -63,6 +63,7 @@ class ParamRegistry:
         self._file_values: Dict[str, str] = {}
         self._files_loaded = False
         self._lock = threading.Lock()
+        self._generation = 0
 
     # -- file layer -------------------------------------------------------
     def _load_files(self) -> None:
@@ -123,12 +124,21 @@ class ParamRegistry:
                 raise ValueError(f"MCA param {name} is read-only")
             p.override = value
             p.has_override = True
+            self._generation += 1
 
     def unset(self, name: str) -> None:
         with self._lock:
             p = self._params.get(name)
             if p is not None:
                 p.override, p.has_override = None, False
+                self._generation += 1
+
+    def generation(self) -> int:
+        """Monotonic counter bumped by set()/unset(): hot paths cache a
+        resolved value keyed by this instead of re-resolving per call
+        (env/file layers are fixed after startup; runtime overrides are
+        the only mid-process change channel)."""
+        return self._generation
 
     def dump(self) -> List[Dict[str, Any]]:
         """All registered params with current values (parsec --help analog)."""
@@ -148,6 +158,7 @@ get = _registry.get
 set = _registry.set
 unset = _registry.unset
 dump = _registry.dump
+generation = _registry.generation
 
 
 def parse_cli(argv: List[str]) -> List[str]:
