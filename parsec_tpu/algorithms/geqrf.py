@@ -254,9 +254,12 @@ def build_geqrf_hh(A: TiledMatrix) -> ptg.Taskpool:
     (Hᵀ·C = C − V·X⁻ᵀ·(Vᵀ·C)). Measured ~35× the flat-DAG tile-dict
     throughput on a v5e chip (see bench.py geqrf config).
 
-    Single-process taskpool (the potrf_left caveat): PANEL/REDUCE bodies
-    read sibling column tiles straight from the collection under the
-    CTL-gather ordering guarantee. Reference analog: the tree-reduction
+    Distribution: PANEL/REDUCE resolve gathered column operands with
+    the direct-memory pattern of reference JDF bodies — local tiles
+    from the collection, remote tiles through the one-sided
+    :meth:`~..comm.engine.CommEngine.fetch_tile` (CTL-gather ordering
+    makes both race-free) — so the same taskpool runs single-process
+    panel-fused AND multi-rank. Reference analog: the tree-reduction
     dgeqrf family (reference parsec/data_dist/matrix/reduce_col.jdf) —
     the panel here plays the whole reduction tree in one fused kernel.
     """
@@ -406,16 +409,21 @@ def build_geqrf_hh(A: TiledMatrix) -> ptg.Taskpool:
         ])
 
     # the CTL-gather contract guarantees every gathered APPLY has
-    # written its tile back before these bodies run, so direct
-    # collection reads are safe (single process)
+    # written its tile back (on its owner rank) before these bodies
+    # run; local tiles read directly, remote tiles through the
+    # CONCURRENT one-sided batch fetch (comm.engine.resolve_column_tiles
+    # — the potrf_left pattern; same taskpool runs single-process
+    # panel-fused AND multi-rank). No caching: unlike POTRF's final
+    # factored columns, trailing tiles change every step.
     @PANEL.body(batchable=False)
     def panel_body(task, C, Vv):
         import numpy as np
+        from ..comm.engine import resolve_column_tiles
         g = task.taskpool.g
         (k,) = task.locals
         col = [np.asarray(C, dtype=np.float32)]
-        for m in range(k + 1, g.MT):
-            col.append(np.asarray(g.A.data_of((m, k)), dtype=np.float32))
+        col += resolve_column_tiles(
+            task, g.A, [(m, k) for m in range(k + 1, g.MT)])
         P = np.concatenate(col, axis=0)
         Qr, R = np.linalg.qr(P)                 # reduced: (mk, nb), (nb, nb)
         d = np.diagonal(Qr[:nb])
@@ -438,12 +446,13 @@ def build_geqrf_hh(A: TiledMatrix) -> ptg.Taskpool:
     @REDUCE.body(batchable=False)
     def reduce_body(task, V, Yv):
         import numpy as np
+        from ..comm.engine import resolve_column_tiles
         g = task.taskpool.g
         n, k = task.locals
         Vp, Xinv = V
         C = np.concatenate(
-            [np.asarray(g.A.data_of((m, n)), dtype=np.float32)
-             for m in range(k, g.MT)], axis=0)
+            resolve_column_tiles(
+                task, g.A, [(m, n) for m in range(k, g.MT)]), axis=0)
         # Hᵀ·C = C − V·X⁻¹·(Vᵀ·C)  (H = I − V·X⁻ᵀ·Vᵀ)
         return {"Y": Xinv @ (Vp.T @ C)}
 

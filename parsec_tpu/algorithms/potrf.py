@@ -428,23 +428,33 @@ def build_potrf_left(A: TiledMatrix) -> ptg.Taskpool:
     @UPDATE.body(batchable=False)
     def update_body(task, C):
         import numpy as np
+        from ..comm.engine import resolve_column_tiles
         g = task.taskpool.g
         ctx = task.taskpool.context
         cache = task.taskpool._fetch_cache
         m, k = task.locals
+        remote = ctx is not None and ctx.nb_ranks > 1
+        my = ctx.my_rank if remote else 0
+        # resolve the two gathered rows up front: local reads inline,
+        # uncached remote tiles in ONE concurrent batch fetch (a
+        # sequential fetch per tile would serialize ~2k link RTTs)
+        keys = []
+        for row in (m, k) if m != k else (m,):
+            for j in range(k):
+                key = (row, j)
+                if remote and g.A.rank_of(key) != my \
+                        and key not in cache:
+                    keys.append(key)
+        if keys:
+            for key, v in zip(keys,
+                              resolve_column_tiles(task, g.A, keys)):
+                cache[key] = v          # benign race: idempotent value
 
         def tile(row, j):
-            owner = g.A.rank_of((row, j))
-            if ctx is None or ctx.nb_ranks == 1 or owner == ctx.my_rank:
-                return np.asarray(g.A.data_of((row, j)), dtype=np.float32)
             hit = cache.get((row, j))
-            if hit is None:
-                hit = np.asarray(
-                    ctx.comm.fetch_tile(g.A, (row, j), owner,
-                                        scope=task.taskpool.name),
-                    dtype=np.float32)
-                cache[(row, j)] = hit   # benign race: idempotent value
-            return hit
+            if hit is not None:
+                return hit
+            return np.asarray(g.A.data_of((row, j)), dtype=np.float32)
 
         acc = np.asarray(C, dtype=np.float32).copy()
         for j in range(k):

@@ -196,28 +196,54 @@ class CommEngine:
         owner's :meth:`expose_collection` scope (the taskpool name).
         The caller is responsible for ordering (the tile must be final
         on the owner)."""
-        if owner == self.rank or self.nb_ranks == 1:
-            return dc.data_of(key)
+        return self.fetch_tiles(dc, [(key, owner)], timeout=timeout,
+                                scope=scope)[0]
+
+    def fetch_tiles(self, dc, keys_owners, timeout: float = 120.0,
+                    scope: str = "") -> list:
+        """Concurrent multi-tile GET: fire every request, then wait —
+        one link round trip for the batch instead of one per tile
+        (sequential blocking fetches on a ~100 ms-class link serialize
+        brutally). ``keys_owners``: iterable of (key, owner); local
+        tiles resolve inline. Returns values in order."""
         from ..core.future import Future
-        fut = Future()
-        with self._fetch_lock:
-            req = self._fetch_next
-            self._fetch_next += 1
-            self._fetch_futures[req] = fut
-        self.send_am(AMTag.TILE_FETCH, owner,
-                     {"name": dc.name, "scope": scope, "key": tuple(key),
-                      "req": req})
-        try:
-            status, value = fut.get(timeout=timeout)
-        finally:
-            # reply handler pops on fulfillment; a timeout must not
-            # leak the future (or let a stale late reply fulfill it)
+        slots: list = []
+        reqs: list = []
+        for key, owner in keys_owners:
+            if owner == self.rank or self.nb_ranks == 1:
+                slots.append(("local", dc.data_of(key), key, owner))
+                continue
+            fut = Future()
             with self._fetch_lock:
-                self._fetch_futures.pop(req, None)
-        if status == "error":
-            raise RuntimeError(f"tile fetch ({dc.name!r}, {key}) from "
-                               f"rank {owner} failed: {value}")
-        return value
+                req = self._fetch_next
+                self._fetch_next += 1
+                self._fetch_futures[req] = fut
+            reqs.append(req)
+            self.send_am(AMTag.TILE_FETCH, owner,
+                         {"name": dc.name, "scope": scope,
+                          "key": tuple(key), "req": req})
+            slots.append(("fut", (fut, req), key, owner))
+        out = []
+        try:
+            for kind, payload, key, owner in slots:
+                if kind == "local":
+                    out.append(payload)
+                    continue
+                fut, req = payload
+                status, value = fut.get(timeout=timeout)
+                if status == "error":
+                    raise RuntimeError(
+                        f"tile fetch ({dc.name!r}, {key}) from rank "
+                        f"{owner} failed: {value}")
+                out.append(value)
+        finally:
+            # reply handler pops on fulfillment; a timeout/error on ANY
+            # slot must not leak the remaining futures (or let stale
+            # late replies fulfill abandoned ones)
+            with self._fetch_lock:
+                for req in reqs:
+                    self._fetch_futures.pop(req, None)
+        return out
 
     # -- progress ---------------------------------------------------------
     def progress(self) -> int:
@@ -228,6 +254,16 @@ class CommEngine:
         pass
 
     # -- runtime services built on the engine -----------------------------
+    def remote_dep_activate_multi(self, task, target_rank: int,
+                                  refs) -> None:
+        """Forward SEVERAL satisfied deps that share one produced value
+        to one rank. The reference sends one data per (dep, rank)
+        (remote_dep.c aggregated activations); transports that can pack
+        a multi-target activation override this — the base engine loops
+        the single-dep path."""
+        for ref in refs:
+            self.remote_dep_activate(task, ref, target_rank)
+
     def remote_dep_activate(self, task, ref, target_rank: int) -> None:
         """parsec_remote_dep_activate analog — forward one satisfied dep to
         the rank owning the successor."""
@@ -238,3 +274,20 @@ class CommEngine:
 
     def broadcast_user_trigger(self, monitor) -> None:
         raise NotImplementedError
+
+
+def resolve_column_tiles(task, dc, keys, dtype=None) -> list:
+    """Resolve a task body's gathered operands: local tiles read from
+    the collection, remote tiles fetched CONCURRENTLY through the
+    owner's comm thread (``CommEngine.fetch_tiles``) under the caller's
+    dataflow-ordering guarantee (CTL-gather). The shared helper of the
+    direct-memory gathered-operand pattern (build_potrf_left UPDATE,
+    build_geqrf_hh PANEL/REDUCE)."""
+    import numpy as np
+    dtype = dtype or np.float32
+    ctx = task.taskpool.context
+    if ctx is None or ctx.nb_ranks <= 1:
+        return [np.asarray(dc.data_of(k), dtype=dtype) for k in keys]
+    pairs = [(k, dc.rank_of(k)) for k in keys]
+    vals = ctx.comm.fetch_tiles(dc, pairs, scope=task.taskpool.name)
+    return [np.asarray(v, dtype=dtype) for v in vals]

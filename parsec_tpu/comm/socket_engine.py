@@ -441,25 +441,32 @@ class SocketCommEngine(CommEngine):
 
     # ----------------------------------------------------------- one-sided
     @staticmethod
-    def wire_value(value: Any) -> Any:
+    def wire_value(value: Any, _dev_seen: Optional[list] = None) -> Any:
         """Snapshot device-resident values (jax.Array) to host numpy at
         the comm boundary — the calling worker thread pays the D2H sync,
         not the comm thread, and the wire then ships raw array bytes.
         (Reference: datatype pack/unpack, parsec_comm_engine.h:113-183.)
-        numpy arrays, scalars and containers pass through."""
+        numpy arrays, scalars and containers pass through.
+        ``_dev_seen``: a one-element list set True when any device array
+        was snapshotted — the sender-side tag that tells the receiver
+        this payload belongs on the device (stage_recv_value)."""
         import numpy as np
         if value is None or isinstance(
                 value, (bool, int, float, complex, str, bytes, bytearray,
                         np.ndarray, np.generic)):
             return value
         if isinstance(value, tuple):
-            return tuple(SocketCommEngine.wire_value(v) for v in value)
+            return tuple(SocketCommEngine.wire_value(v, _dev_seen)
+                         for v in value)
         if isinstance(value, list):
-            return [SocketCommEngine.wire_value(v) for v in value]
+            return [SocketCommEngine.wire_value(v, _dev_seen)
+                    for v in value]
         if isinstance(value, dict):
-            return {k: SocketCommEngine.wire_value(v)
+            return {k: SocketCommEngine.wire_value(v, _dev_seen)
                     for k, v in value.items()}
         if hasattr(value, "__array__"):     # jax.Array et al.
+            if _dev_seen is not None:
+                _dev_seen[0] = True
             return np.asarray(value)
         return value
 
@@ -502,19 +509,35 @@ class SocketCommEngine(CommEngine):
         """parsec_remote_dep_activate analog: enqueue one activation for
         the comm thread; value rides inline below the eager limit, else
         through the registered-memory rendezvous."""
+        self.remote_dep_activate_multi(task, target_rank, [ref])
+
+    def remote_dep_activate_multi(self, task, target_rank: int,
+                                  refs) -> None:
+        """Packed multi-target activation: N deps of ONE produced value
+        to one rank ship the payload ONCE (the reference's one-data-per-
+        (dep, rank) aggregation, remote_dep.c) — a PANEL factor fanning
+        out to a whole wave of remote consumers would otherwise
+        re-serialize the same array per consumer."""
         tp = task.taskpool
         monitor = tp.monitor
         monitor.outgoing_message_start(target_rank)
-        msg = {"taskpool": tp.name, "class": ref.task_class.name,
-               "locals": tuple(ref.locals), "flow": ref.flow_name,
-               "dep_index": ref.dep_index, "priority": ref.priority}
+        targets = [{"class": ref.task_class.name,
+                    "locals": tuple(ref.locals), "flow": ref.flow_name,
+                    "dep_index": ref.dep_index,
+                    "priority": ref.priority} for ref in refs]
+        msg = {"taskpool": tp.name, "targets": targets}
         from ..utils import debug_history
         if debug_history.enabled():   # DEBUG_MARK_CTL_MSG_ACTIVATE_SENT
-            debug_history.mark("ACTIVATE_SENT to=%d %s.%s%r flow=%s",
-                               target_rank, tp.name,
-                               ref.task_class.name, tuple(ref.locals),
-                               ref.flow_name)
-        value = self.wire_value(ref.value)
+            for t in targets:
+                debug_history.mark("ACTIVATE_SENT to=%d %s.%s%r flow=%s",
+                                   target_rank, tp.name, t["class"],
+                                   t["locals"], t["flow"])
+        dev_seen = [False]
+        value = self.wire_value(refs[0].value, dev_seen)
+        if dev_seen[0]:
+            # receiver stages this payload back onto its device (the
+            # consumer side of a device-resident dataflow edge)
+            msg["dev"] = True
         nbytes = self.payload_bytes(value)
         eager_limit = int(mca_param.get("comm.eager_limit", 256 * 1024))
         if value is not None and nbytes > eager_limit:
@@ -561,9 +584,10 @@ class SocketCommEngine(CommEngine):
         from ..core.taskpool import SuccessorRef
         from ..utils import debug_history
         if debug_history.enabled():   # DEBUG_MARK_CTL_MSG_ACTIVATE_RECV
-            debug_history.mark("ACTIVATE_RECV from=%d %s.%s%r flow=%s",
-                               src, tp.name, msg["class"],
-                               tuple(msg["locals"]), msg["flow"])
+            for t in msg["targets"]:
+                debug_history.mark("ACTIVATE_RECV from=%d %s.%s%r "
+                                   "flow=%s", src, tp.name, t["class"],
+                                   tuple(t["locals"]), t["flow"])
         self.record_msg("recv", "activate", src,
                         msg.get("nbytes",
                                 self.payload_bytes(msg.get("value"))))
@@ -583,18 +607,24 @@ class SocketCommEngine(CommEngine):
         self._finish_activation(tp, src, msg, msg.get("value"))
 
     @staticmethod
-    def stage_recv_value(value: Any):
+    def stage_recv_value(value: Any, tagged: bool = False):
         """Stage received array payloads onto the accelerator on the
         comm thread (async device_put): the consumer's body then starts
         from device-resident operands instead of paying a synchronous
         H2D at dispatch — the receive half of the reference's
         registered-memory PUT landing in device-visible memory
-        (remote_dep_mpi.c:1594-1729). Gated by ``comm.stage_recv``
-        (auto = only when the default backend is an accelerator)."""
+        (remote_dep_mpi.c:1594-1729). Gated by ``comm.stage_recv``:
+        ``auto`` stages only payloads the SENDER tagged device-resident
+        (``tagged``) on an accelerator backend — staging host-born
+        payloads onto a slow link makes things WORSE (measured: a host
+        pingpong over the tunnel went 3.8 ms -> 145 ms/hop when every
+        payload was device_put); ``1`` forces, ``0`` disables."""
         import sys
         import numpy as np
         mode = str(mca_param.get("comm.stage_recv", "auto"))
         if mode in ("0", "off", "false"):
+            return value
+        if mode == "auto" and not tagged:
             return value
         # never INITIALIZE a backend from the comm thread: staging only
         # applies when this process already uses jax (importing it here
@@ -627,15 +657,19 @@ class SocketCommEngine(CommEngine):
 
     def _finish_activation(self, tp, src: int, msg: Dict, value) -> None:
         from ..core.taskpool import SuccessorRef
-        value = self.stage_recv_value(value)
-        tc = tp.get_task_class(msg["class"])
-        ref = SuccessorRef(task_class=tc, locals=tuple(msg["locals"]),
-                           flow_name=msg["flow"], value=value,
-                           dep_index=msg["dep_index"],
-                           priority=msg["priority"])
-        new_task = tp.activate_dep(ref)
-        if new_task is not None:
-            self._context.schedule(None, [new_task])
+        value = self.stage_recv_value(value, tagged=msg.get("dev", False))
+        ready = []
+        for t in msg["targets"]:        # one payload, N dependent tasks
+            tc = tp.get_task_class(t["class"])
+            ref = SuccessorRef(task_class=tc, locals=tuple(t["locals"]),
+                               flow_name=t["flow"], value=value,
+                               dep_index=t["dep_index"],
+                               priority=t["priority"])
+            new_task = tp.activate_dep(ref)
+            if new_task is not None:
+                ready.append(new_task)
+        if ready:
+            self._context.schedule(None, ready)
         tp.monitor.incoming_message_end(src)
 
     def _on_get(self, src: int, msg: Dict) -> None:

@@ -450,8 +450,11 @@ class Taskpool(CoreTaskpool):
                     if holder == my_rank:
                         # current version is local: snapshot the
                         # program-order value now (immutable arrays keep
-                        # the snapshot valid)
-                        task.data[fname] = a.collection.data_of(a.key)
+                        # the snapshot valid); stage-through so one H2D
+                        # serves every reader (Context.stage_read)
+                        task.data[fname] = self.context.stage_read(
+                            a.collection, a.key,
+                            a.collection.data_of(a.key))
                     else:
                         # version held remotely: the holder replays this
                         # insert as a shell and pushes the value eagerly

@@ -261,6 +261,12 @@ class PTGTaskClass(TaskClass):
             if dep.data is not None:
                 dc, key = dep.data(g, *task.locals)
                 value = dc.data_of(key)
+                ctx = self.tp.context
+                if ctx is not None:
+                    # stage-through: the collection keeps the device
+                    # copy so one H2D serves every reader (Context.
+                    # stage_read; no-op without an accelerator)
+                    value = ctx.stage_read(dc, key, value)
             elif dep.new is not None:
                 value = dep.new(g, *task.locals)
             else:

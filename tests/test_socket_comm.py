@@ -250,6 +250,93 @@ def scenario_potrf_left(ctx, engine, rank, nb_ranks, n=192, nb=32):
     return len(list(A.local_keys()))
 
 
+def scenario_geqrf_hh(ctx, engine, rank, nb_ranks, m=128, n=64, nb=32):
+    """Blocked-Householder QR multi-rank: PANEL/REDUCE resolve remote
+    column operands through fetch_tile; (V, Xinv) values cross ranks as
+    activation payloads."""
+    from parsec_tpu.algorithms.geqrf import build_geqrf_hh
+    from parsec_tpu.data.matrix import TiledMatrix, TwoDimBlockCyclic
+
+    rng = np.random.default_rng(0)
+    A_host = rng.standard_normal((m, n)).astype(np.float32)
+    dist = TwoDimBlockCyclic(P=nb_ranks, Q=1)
+    A = TiledMatrix.from_array(A_host.copy(), nb, nb, dist=dist,
+                               myrank=rank, name="A")
+    tp = build_geqrf_hh(A)
+    ctx.add_taskpool(tp)
+    ctx.start()
+    assert ctx.wait(timeout=90), \
+        f"rank {rank}: geqrf_hh did not terminate"
+    # validate my local tiles of R against a full-gather reference:
+    # AtA == RtR is global, so instead check tiles vs numpy qr with the
+    # same sign fix applied per panel is overkill — use the invariant
+    # on the locally-reconstructable pieces: lower tiles are zero, and
+    # the assembled R from ALL ranks (via fetch) satisfies AtA = RtR
+    # on rank 0.
+    for (i, j) in A.local_keys():
+        if i > j:
+            np.testing.assert_allclose(
+                np.asarray(A.data_of((i, j))), 0.0, atol=1e-4)
+    if rank == 0:
+        R = np.zeros((m, n), np.float32)
+        for i in range(m // nb):
+            for j in range(n // nb):
+                owner = A.rank_of((i, j))
+                t = A.data_of((i, j)) if owner == 0 else \
+                    engine.fetch_tile(A, (i, j), owner, scope=tp.name)
+                R[i*nb:(i+1)*nb, j*nb:(j+1)*nb] = np.asarray(t)
+        np.testing.assert_allclose(R.T @ R, A_host.T @ A_host,
+                                   rtol=2e-3, atol=2e-2)
+    return 1
+
+
+def scenario_multi_activate(ctx, engine, rank, nb_ranks):
+    """One produced value fanning out to several consumers on one rank
+    must cross the wire ONCE (the reference's one-data-per-(dep, rank)
+    aggregation): assert a single activation message delivered."""
+    from parsec_tpu.dsl import ptg
+
+    A = _DistVec(8, nb_ranks, rank)
+    tp = ptg.Taskpool("fan", A=A, NC=3)
+    tp.task_class(
+        "SRC", params=("k",),
+        space=lambda g: ((0,),),
+        affinity=lambda g, k: (g.A, (0,)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, k: (g.A, (0,)))],
+            outs=[ptg.Out(dst=("CONS",
+                               lambda g, k: [(j,) for j in range(g.NC)],
+                               "X"))])])
+    tp.task_class(
+        "CONS", params=("j",),
+        space=lambda g: ((j,) for j in range(g.NC)),
+        affinity=lambda g, j: (g.A, (1,)),       # ALL on rank 1
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(src=("SRC", lambda g, j: (0,), "X"))],
+            outs=[ptg.Out(data=lambda g, j: (g.A, (2 + j,)))])])
+
+    @tp.task_class_by_name("SRC").body
+    def src_body(task, X):
+        return np.full(1024, 7.0, dtype=np.float32)
+
+    @tp.task_class_by_name("CONS").body
+    def cons_body(task, X):
+        return X.sum()
+
+    ctx.add_taskpool(tp)
+    ctx.start()
+    assert ctx.wait(timeout=60)
+    engine.sync()
+    if rank == 1:      # consumer rank: 3 deps, ONE activation message
+        assert engine.stats["activations_recv"] == 1, engine.stats
+        for j in range(3):
+            if A.rank_of((2 + j,)) == rank:
+                assert float(A.v[2 + j]) == 7.0 * 1024
+    return engine.stats["activations_recv"]
+
+
 def scenario_jax_values(ctx, engine, rank, nb_ranks, n=4096):
     """Bodies produce device-resident jax.Arrays that cross rank
     boundaries: the engine must snapshot them to host numpy at the comm
@@ -338,6 +425,14 @@ def test_potrf_left_2ranks():
 
 def test_potrf_left_3ranks():
     _run_ranks("scenario_potrf_left", 3)
+
+
+def test_geqrf_hh_2ranks():
+    _run_ranks("scenario_geqrf_hh", 2)
+
+
+def test_multi_activate_dedup_2ranks():
+    _run_ranks("scenario_multi_activate", 2)
 
 
 def test_jax_values_2ranks():
