@@ -1,0 +1,520 @@
+"""Tiled LU factorization without pivoting (dgetrf_nopiv) as PTG taskpools.
+
+Completes the DPLASMA-class dense-factorization trio next to
+:mod:`~.potrf` and :mod:`~.geqrf`. The right-looking form mirrors the
+classic dgetrf JDF:
+
+    GETRF(k):     A[k,k] ← packed LU (unit-lower L, upper U)
+    TRSM_U(k,n):  A[k,n] ← L[k,k]⁻¹·A[k,n]       (row panel, n > k)
+    TRSM_L(m,k):  A[m,k] ← A[m,k]·U[k,k]⁻¹       (column panel, m > k)
+    GEMM(m,n,k):  A[m,n] −= A[m,k]·A[k,n]
+
+No pivoting: valid for the diagonally-dominant / well-conditioned
+regime the accelerator tile-LU formulation targets (the reference
+ships the same contract in its nopiv PTG examples; pivoted in-tile
+fallback = ``jax.lax.linalg.lu`` at user level). On completion A holds
+the packed factors (L unit-lower below the diagonal, U on/above).
+
+:func:`build_getrf_left` is the panel-fused flagship form — the LU
+analog of :func:`~.potrf.build_potrf_left`: UPDC/UPDR concentrate each
+tile's updates at its step, ASAP leveling yields three waves per step
+([UPDC(·,k)+UPDR(k,·)], [GETRF(k)], [TRSM_L(·,k)+TRSM_U(k,·)]), and the
+wave fuser lowers each to one or two large matmuls over the Aᵀ store.
+"""
+
+from __future__ import annotations
+
+from ..dsl import ptg
+from ..data.matrix import TiledMatrix
+from ..ops.tile_kernels import (gemm_tile, getrf_nopiv_tile,
+                                trsm_lower_unit, trsm_upper_right)
+from ..utils import mca_param
+
+
+def _check(A: TiledMatrix) -> int:
+    if A.mt != A.nt:
+        raise ValueError("GETRF needs a square tile grid")
+    if A.mb != A.nb:
+        raise ValueError("GETRF needs square tiles (mb == nb)")
+    return A.nt
+
+
+def build_getrf(A: TiledMatrix) -> ptg.Taskpool:
+    """Right-looking tiled LU (the dgetrf JDF shape)."""
+    NT = _check(A)
+    tp = ptg.Taskpool("getrf", A=A, NT=NT)
+
+    GETRF = tp.task_class(
+        "GETRF", params=("k",),
+        space=lambda g: ((k,) for k in range(g.NT)),
+        affinity=lambda g, k: (g.A, (k, k)),
+        priority=lambda g, k: 3 * (g.NT - k) ** 2,
+        flows=[ptg.FlowSpec(
+            "T", ptg.RW,
+            tile=lambda g, k: (g.A, (k, k)),
+            ins=[ptg.In(data=lambda g, k: (g.A, (k, k)),
+                        guard=lambda g, k: k == 0),
+                 ptg.In(src=("GEMM", lambda g, k: (k, k, k - 1), "C"),
+                        guard=lambda g, k: k > 0)],
+            outs=[ptg.Out(dst=("TRSM_L",
+                               lambda g, k: [(m, k)
+                                             for m in range(k + 1, g.NT)],
+                               "T")),
+                  ptg.Out(dst=("TRSM_U",
+                               lambda g, k: [(k, n)
+                                             for n in range(k + 1, g.NT)],
+                               "T")),
+                  ptg.Out(data=lambda g, k: (g.A, (k, k)))])])
+
+    TRSM_L = tp.task_class(
+        "TRSM_L", params=("m", "k"),
+        space=lambda g: ((m, k) for k in range(g.NT)
+                         for m in range(k + 1, g.NT)),
+        affinity=lambda g, m, k: (g.A, (m, k)),
+        priority=lambda g, m, k: 2 * (g.NT - k) ** 2 - m,
+        flows=[
+            ptg.FlowSpec(
+                "T", ptg.READ,
+                tile=lambda g, m, k: (g.A, (k, k)),
+                ins=[ptg.In(src=("GETRF", lambda g, m, k: (k,), "T"))]),
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                tile=lambda g, m, k: (g.A, (m, k)),
+                ins=[ptg.In(data=lambda g, m, k: (g.A, (m, k)),
+                            guard=lambda g, m, k: k == 0),
+                     ptg.In(src=("GEMM", lambda g, m, k: (m, k, k - 1),
+                                 "C"),
+                            guard=lambda g, m, k: k > 0)],
+                outs=[ptg.Out(dst=("GEMM",
+                                   lambda g, m, k: [(m, n, k)
+                                                    for n in
+                                                    range(k + 1, g.NT)],
+                                   "L")),
+                      ptg.Out(data=lambda g, m, k: (g.A, (m, k)))])])
+
+    TRSM_U = tp.task_class(
+        "TRSM_U", params=("k", "n"),
+        space=lambda g: ((k, n) for k in range(g.NT)
+                         for n in range(k + 1, g.NT)),
+        affinity=lambda g, k, n: (g.A, (k, n)),
+        priority=lambda g, k, n: 2 * (g.NT - k) ** 2 - n,
+        flows=[
+            ptg.FlowSpec(
+                "T", ptg.READ,
+                tile=lambda g, k, n: (g.A, (k, k)),
+                ins=[ptg.In(src=("GETRF", lambda g, k, n: (k,), "T"))]),
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                tile=lambda g, k, n: (g.A, (k, n)),
+                ins=[ptg.In(data=lambda g, k, n: (g.A, (k, n)),
+                            guard=lambda g, k, n: k == 0),
+                     ptg.In(src=("GEMM", lambda g, k, n: (k, n, k - 1),
+                                 "C"),
+                            guard=lambda g, k, n: k > 0)],
+                outs=[ptg.Out(dst=("GEMM",
+                                   lambda g, k, n: [(m, n, k)
+                                                    for m in
+                                                    range(k + 1, g.NT)],
+                                   "U")),
+                      ptg.Out(data=lambda g, k, n: (g.A, (k, n)))])])
+
+    GEMM = tp.task_class(
+        "GEMM", params=("m", "n", "k"),
+        space=lambda g: ((m, n, k) for k in range(g.NT)
+                         for m in range(k + 1, g.NT)
+                         for n in range(k + 1, g.NT)),
+        affinity=lambda g, m, n, k: (g.A, (m, n)),
+        priority=lambda g, m, n, k: (g.NT - k) ** 2 - m - n,
+        flows=[
+            ptg.FlowSpec(
+                "L", ptg.READ,
+                tile=lambda g, m, n, k: (g.A, (m, k)),
+                ins=[ptg.In(src=("TRSM_L", lambda g, m, n, k: (m, k),
+                                 "C"))]),
+            ptg.FlowSpec(
+                "U", ptg.READ,
+                tile=lambda g, m, n, k: (g.A, (k, n)),
+                ins=[ptg.In(src=("TRSM_U", lambda g, m, n, k: (k, n),
+                                 "C"))]),
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                tile=lambda g, m, n, k: (g.A, (m, n)),
+                ins=[ptg.In(data=lambda g, m, n, k: (g.A, (m, n)),
+                            guard=lambda g, m, n, k: k == 0),
+                     ptg.In(src=("GEMM",
+                                 lambda g, m, n, k: (m, n, k - 1), "C"),
+                            guard=lambda g, m, n, k: k > 0)],
+                outs=[
+                    ptg.Out(dst=("GEMM",
+                                 lambda g, m, n, k: (m, n, k + 1), "C"),
+                            guard=lambda g, m, n, k:
+                            k + 1 < min(m, n)),
+                    ptg.Out(dst=("GETRF", lambda g, m, n, k: (k + 1,),
+                                 "T"),
+                            guard=lambda g, m, n, k: m == k + 1 and
+                            n == k + 1),
+                    ptg.Out(dst=("TRSM_L", lambda g, m, n, k: (m, k + 1),
+                                 "C"),
+                            guard=lambda g, m, n, k: n == k + 1 and
+                            m > k + 1),
+                    ptg.Out(dst=("TRSM_U", lambda g, m, n, k: (k + 1, n),
+                                 "C"),
+                            guard=lambda g, m, n, k: m == k + 1 and
+                            n > k + 1),
+                ])])
+
+    @GETRF.body
+    def getrf_body(task, T):
+        return getrf_nopiv_tile(T)
+
+    @TRSM_L.body
+    def trsm_l_body(task, T, C):
+        return {"C": trsm_upper_right(T, C)}
+
+    @TRSM_U.body
+    def trsm_u_body(task, T, C):
+        return {"C": trsm_lower_unit(T, C)}
+
+    @GEMM.body
+    def gemm_body(task, L, U, C):
+        return gemm_tile(C, L, U, alpha=-1.0, beta=1.0)
+
+    return tp
+
+
+def build_getrf_left(A: TiledMatrix) -> ptg.Taskpool:
+    """Left-looking tiled LU — the panel-fused flagship form (the
+    :func:`~.potrf.build_potrf_left` analog). Each column-panel tile
+    (UPDC) and row-panel tile (UPDR) receives ALL its k' < k
+    contributions in one task that CTL-gathers its producer TRSMs and
+    resolves their tiles with the direct-memory gathered-operand
+    pattern (local reads / one-sided batched fetches) — the same
+    taskpool runs single-process panel-fused AND multi-rank."""
+    NT = _check(A)
+    tp = ptg.Taskpool("getrf_left", A=A, NT=NT)
+
+    # producers gathered by UPDC(m, k): column k's operands L[m, j<k]
+    # and U[j<k, k]; by UPDR(k, n): L[k, j<k] and U[j<k, n]
+    UPDC = tp.task_class(
+        "UPDC", params=("m", "k"),
+        space=lambda g: ((m, k) for k in range(1, g.NT)
+                         for m in range(k, g.NT)),
+        affinity=lambda g, m, k: (g.A, (m, k)),
+        priority=lambda g, m, k: 2 * (g.NT - k) ** 2 - m + 1,
+        flows=[
+            ptg.FlowSpec(
+                "GL", ptg.CTL,
+                ins=[ptg.In(src=("TRSM_L",
+                                 lambda g, m, k: [(m, j)
+                                                  for j in range(k)],
+                                 "G"),
+                            gather=True)]),
+            ptg.FlowSpec(
+                "GU", ptg.CTL,
+                ins=[ptg.In(src=("TRSM_U",
+                                 lambda g, m, k: [(j, k)
+                                                  for j in range(k)],
+                                 "G"),
+                            gather=True)]),
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                tile=lambda g, m, k: (g.A, (m, k)),
+                ins=[ptg.In(data=lambda g, m, k: (g.A, (m, k)))],
+                outs=[ptg.Out(dst=("GETRF", lambda g, m, k: (k,), "T"),
+                              guard=lambda g, m, k: m == k),
+                      ptg.Out(dst=("TRSM_L", lambda g, m, k: (m, k), "C"),
+                              guard=lambda g, m, k: m > k)])])
+
+    UPDR = tp.task_class(
+        "UPDR", params=("k", "n"),
+        space=lambda g: ((k, n) for k in range(1, g.NT)
+                         for n in range(k + 1, g.NT)),
+        affinity=lambda g, k, n: (g.A, (k, n)),
+        priority=lambda g, k, n: 2 * (g.NT - k) ** 2 - n + 1,
+        flows=[
+            ptg.FlowSpec(
+                "GL", ptg.CTL,
+                ins=[ptg.In(src=("TRSM_L",
+                                 lambda g, k, n: [(k, j)
+                                                  for j in range(k)],
+                                 "G"),
+                            gather=True)]),
+            ptg.FlowSpec(
+                "GU", ptg.CTL,
+                ins=[ptg.In(src=("TRSM_U",
+                                 lambda g, k, n: [(j, n)
+                                                  for j in range(k)],
+                                 "G"),
+                            gather=True)]),
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                tile=lambda g, k, n: (g.A, (k, n)),
+                ins=[ptg.In(data=lambda g, k, n: (g.A, (k, n)))],
+                outs=[ptg.Out(dst=("TRSM_U", lambda g, k, n: (k, n),
+                                   "C"))])])
+
+    GETRF = tp.task_class(
+        "GETRF", params=("k",),
+        space=lambda g: ((k,) for k in range(g.NT)),
+        affinity=lambda g, k: (g.A, (k, k)),
+        priority=lambda g, k: 3 * (g.NT - k) ** 2,
+        flows=[ptg.FlowSpec(
+            "T", ptg.RW,
+            tile=lambda g, k: (g.A, (k, k)),
+            ins=[ptg.In(data=lambda g, k: (g.A, (k, k)),
+                        guard=lambda g, k: k == 0),
+                 ptg.In(src=("UPDC", lambda g, k: (k, k), "C"),
+                        guard=lambda g, k: k > 0)],
+            outs=[ptg.Out(dst=("TRSM_L",
+                               lambda g, k: [(m, k)
+                                             for m in range(k + 1, g.NT)],
+                               "T")),
+                  ptg.Out(dst=("TRSM_U",
+                               lambda g, k: [(k, n)
+                                             for n in range(k + 1, g.NT)],
+                               "T")),
+                  ptg.Out(data=lambda g, k: (g.A, (k, k)))])])
+
+    TRSM_L = tp.task_class(
+        "TRSM_L", params=("m", "k"),
+        space=lambda g: ((m, k) for k in range(g.NT)
+                         for m in range(k + 1, g.NT)),
+        affinity=lambda g, m, k: (g.A, (m, k)),
+        priority=lambda g, m, k: 2 * (g.NT - k) ** 2 - m,
+        flows=[
+            ptg.FlowSpec(
+                "T", ptg.READ,
+                tile=lambda g, m, k: (g.A, (k, k)),
+                ins=[ptg.In(src=("GETRF", lambda g, m, k: (k,), "T"))]),
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                tile=lambda g, m, k: (g.A, (m, k)),
+                ins=[ptg.In(data=lambda g, m, k: (g.A, (m, k)),
+                            guard=lambda g, m, k: k == 0),
+                     ptg.In(src=("UPDC", lambda g, m, k: (m, k), "C"),
+                            guard=lambda g, m, k: k > 0)],
+                outs=[ptg.Out(data=lambda g, m, k: (g.A, (m, k)))]),
+            ptg.FlowSpec(
+                "G", ptg.CTL,
+                outs=[ptg.Out(
+                    dst=("UPDC",
+                         lambda g, m, k: [(m, kk)
+                                          for kk in range(k + 1,
+                                                          min(m, g.NT - 1)
+                                                          + 1)],
+                         "GL")),
+                    ptg.Out(
+                    dst=("UPDR",
+                         lambda g, m, k: [(m, n)
+                                          for n in range(m + 1, g.NT)],
+                         "GL"))])])
+
+    TRSM_U = tp.task_class(
+        "TRSM_U", params=("k", "n"),
+        space=lambda g: ((k, n) for k in range(g.NT)
+                         for n in range(k + 1, g.NT)),
+        affinity=lambda g, k, n: (g.A, (k, n)),
+        priority=lambda g, k, n: 2 * (g.NT - k) ** 2 - n,
+        flows=[
+            ptg.FlowSpec(
+                "T", ptg.READ,
+                tile=lambda g, k, n: (g.A, (k, k)),
+                ins=[ptg.In(src=("GETRF", lambda g, k, n: (k,), "T"))]),
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                tile=lambda g, k, n: (g.A, (k, n)),
+                ins=[ptg.In(data=lambda g, k, n: (g.A, (k, n)),
+                            guard=lambda g, k, n: k == 0),
+                     ptg.In(src=("UPDR", lambda g, k, n: (k, n), "C"),
+                            guard=lambda g, k, n: k > 0)],
+                outs=[ptg.Out(data=lambda g, k, n: (g.A, (k, n)))]),
+            ptg.FlowSpec(
+                "G", ptg.CTL,
+                outs=[ptg.Out(
+                    dst=("UPDR",
+                         lambda g, k, n: [(kk, n)
+                                          for kk in range(k + 1, n)],
+                         "GU")),
+                    ptg.Out(
+                    dst=("UPDC",
+                         lambda g, k, n: [(m, n)
+                                          for m in range(n, g.NT)],
+                         "GU"))])])
+
+    @UPDC.body(batchable=False)
+    def updc_body(task, C):
+        import numpy as np
+        from ..comm.engine import resolve_column_tiles
+        g = task.taskpool.g
+        m, k = task.locals
+        Ls = resolve_column_tiles(task, g.A, [(m, j) for j in range(k)])
+        Us = resolve_column_tiles(task, g.A, [(j, k) for j in range(k)])
+        acc = np.asarray(C, dtype=np.float32).copy()
+        for Lj, Uj in zip(Ls, Us):
+            acc -= Lj @ Uj
+        return acc.astype(np.asarray(C).dtype)
+
+    @UPDR.body(batchable=False)
+    def updr_body(task, C):
+        import numpy as np
+        from ..comm.engine import resolve_column_tiles
+        g = task.taskpool.g
+        k, n = task.locals
+        Ls = resolve_column_tiles(task, g.A, [(k, j) for j in range(k)])
+        Us = resolve_column_tiles(task, g.A, [(j, n) for j in range(k)])
+        acc = np.asarray(C, dtype=np.float32).copy()
+        for Lj, Uj in zip(Ls, Us):
+            acc -= Lj @ Uj
+        return acc.astype(np.asarray(C).dtype)
+
+    @GETRF.body
+    def getrf_body(task, T):
+        return getrf_nopiv_tile(T)
+
+    @TRSM_L.body(batchable=False)
+    def trsm_l_body(task, T, C):
+        return {"C": trsm_upper_right(T, C)}
+
+    @TRSM_U.body(batchable=False)
+    def trsm_u_body(task, T, C):
+        return {"C": trsm_lower_unit(T, C)}
+
+    tp.wave_fuser = _getrf_left_wave_fuser
+    tp.requires_fuser = True     # UPDC/UPDR bodies resolve gathered
+    #                              operands outside per-tile flows
+    return tp
+
+
+def _getrf_left_wave_fuser(wave, geoms):
+    """Lower one left-looking LU wave to Aᵀ-dense ops (compiled.panels
+    contract). Wave shapes per step k:
+    [UPDC(·,k)+UPDR(k,·)] → two large matmuls into the carry;
+    [GETRF(k)] → in-tile packed LU (Schur recursion);
+    [TRSM_L(·,k)+TRSM_U(k,·)] → two triangular applies + two DUS."""
+    (geom,) = geoms.values()
+    import jax
+    import jax.numpy as jnp
+    from ..ops.tile_kernels import (getrf_nopiv_tile, lu_split,
+                                    matmul_precision, tri_inv_tile)
+
+    prec = matmul_precision()
+
+    def mm(a, b):
+        return jnp.matmul(a, b, preferred_element_type=jnp.float32,
+                          precision=prec)
+
+    names = sorted(g.tc.name for g in wave)
+    mb, nb = geom.mb, geom.nb
+    MT, NT = geom.mt, geom.nt
+    inv_mode = mca_param.get("potrf.trsm_hook", "gemm") == "gemm"
+
+    if names in (["UPDC"], ["UPDC", "UPDR"]):
+        updc = next(g for g in wave if g.tc.name == "UPDC")
+        ks = {t[1] for t in updc.tasks}
+        if len(ks) != 1:
+            return None
+        k = ks.pop()
+        if sorted(updc.tasks) != [(m, k) for m in range(k, MT)]:
+            return None
+        updr = next((g for g in wave if g.tc.name == "UPDR"), None)
+        if updr is not None and sorted(updr.tasks) != \
+                [(k, n) for n in range(k + 1, NT)]:
+            return None
+
+        def do_update(st, k=k):
+            D = st[geom.name]
+            r0 = k * nb
+            # column panel (Aᵀ rows = block-col k): Uᵀ[:k,k]·Lᵀ[k:,:k]
+            Ut = D[r0:r0 + nb, 0:k * mb]          # (nb, k*mb)
+            Lt = D[0:k * nb, k * mb:]             # (k*nb, mk)
+            st["_lu_col"] = D[r0:r0 + nb, k * mb:] - mm(Ut, Lt)
+            if k + 1 < NT:
+                # row panel (Aᵀ col strip = block-row k over rows > k)
+                Ut2 = D[(k + 1) * nb:, 0:k * mb]  # (T, k*mb)
+                Lt2 = D[0:k * nb, k * mb:(k + 1) * mb]   # (k*nb, nb)
+                st["_lu_row"] = D[(k + 1) * nb:,
+                                  k * mb:(k + 1) * mb] - mm(Ut2, Lt2)
+            return st
+
+        return do_update
+
+    if names == ["GETRF"]:
+        (grp,) = wave
+        if len(grp.tasks) != 1:
+            return None
+        (k,) = grp.tasks[0]
+
+        def do_getrf(st, k=k, last=(k == NT - 1)):
+            D = st[geom.name]
+            c = slice(k * nb, (k + 1) * nb)
+            colk = st.pop("_lu_col", None)
+            diag = colk[:, :nb].T if colk is not None \
+                else D[c, k * mb:(k + 1) * mb].T
+            LU = getrf_nopiv_tile(diag)
+            st["_lu_T"] = LU
+            if last:
+                st[geom.name] = D.at[c, k * mb:].set(LU.T)
+            else:
+                if colk is not None:
+                    st["_lu_col_rest"] = colk[:, nb:]
+            return st
+
+        return do_getrf
+
+    if names in (["TRSM_L"], ["TRSM_L", "TRSM_U"]):
+        tl = next(g for g in wave if g.tc.name == "TRSM_L")
+        ks = {t[1] for t in tl.tasks}
+        if len(ks) != 1:
+            return None
+        k = ks.pop()
+        if sorted(tl.tasks) != [(m, k) for m in range(k + 1, MT)]:
+            return None
+        tu = next((g for g in wave if g.tc.name == "TRSM_U"), None)
+        if tu is not None and sorted(tu.tasks) != \
+                [(k, n) for n in range(k + 1, NT)]:
+            return None
+
+        def do_trsm(st, k=k):
+            D = st[geom.name]
+            c = slice(k * nb, (k + 1) * nb)
+            LU = st.pop("_lu_T", None)
+            if LU is None:
+                LU = D[c, k * mb:(k + 1) * mb].T
+            L, U = lu_split(LU)
+            col = st.pop("_lu_col_rest", None)
+            if col is None:       # k == 0: no update wave preceded
+                col = D[c, (k + 1) * mb:]
+            row = st.pop("_lu_row", None)
+            if row is None:
+                row = D[(k + 1) * nb:, k * mb:(k + 1) * mb]
+            if inv_mode:
+                # MAGMA-style: invert the nb-sized factors once, every
+                # panel solve becomes one MXU matmul
+                Uinv = tri_inv_tile(U.T).T     # via lower-tri inversion
+                Linv = tri_inv_tile(L)
+                solved_col = mm(Uinv.T, col)       # (U^-T)·colᵀ
+                solved_row = mm(row, Linv.T)       # rowᵀ·(L^-T)
+            else:
+                solved_col = jax.lax.linalg.triangular_solve(
+                    U, col, left_side=True, lower=False,
+                    transpose_a=True)
+                solved_row = jax.lax.linalg.triangular_solve(
+                    L, row, left_side=False, lower=True,
+                    transpose_a=True, unit_diagonal=True)
+            # panel row write: packed LUᵀ + solved column panel
+            D = D.at[c, k * mb:].set(
+                jnp.concatenate([LU.T, solved_col.astype(D.dtype)],
+                                axis=1))
+            D = D.at[(k + 1) * nb:, k * mb:(k + 1) * mb].set(
+                solved_row.astype(D.dtype))
+            st[geom.name] = D
+            return st
+
+        return do_trsm
+
+    return None
+
+
+def getrf_flops(n: int) -> float:
+    """Useful FLOPs of an n×n LU (LAPACK count)."""
+    return 2.0 * n ** 3 / 3.0 - n ** 2 / 2.0 + 5.0 * n / 6.0

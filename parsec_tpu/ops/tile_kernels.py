@@ -166,6 +166,83 @@ def scale_tile(A, alpha):
     return alpha * A
 
 
+# ---- tiled-LU kernels (DPLASMA dgetrf_nopiv tile operations) -----------
+# In-tile LU without pivoting: XLA has no unpivoted-LU primitive (and
+# lax.linalg.lu's row permutation would have to flow through the whole
+# block row), so the factorization is a Schur-complement recursion whose
+# every flop above the tiny base case is a matmul or triangular solve —
+# the same MXU-first reformulation as potrf_tile_blocked. Valid for the
+# diagonally-dominant / well-conditioned regime tile LU targets (the
+# no-pivot variant is the standard accelerator formulation; pivoted
+# fallback = jax.lax.linalg.lu at user level).
+
+def _lu_base(T):
+    """Masked rank-1 eliminations as ONE fori_loop — a handful of traced
+    ops regardless of the block size (an unrolled loop would put ~n ops
+    per tile into the fused whole-DAG program)."""
+    n = T.shape[0]
+    idx = jnp.arange(n)
+
+    def step(i, M):
+        piv = M[i, i]
+        col = jnp.where(idx > i, M[:, i] / piv, 0.0)   # multipliers
+        row = jnp.where(idx > i, M[i, :], 0.0)         # U row, cols > i
+        M = M - col[:, None] * row[None, :]
+        return M.at[:, i].set(jnp.where(idx > i, col, M[:, i]))
+
+    return jax.lax.fori_loop(0, n - 1, step, T)
+
+
+def getrf_nopiv_tile(A, base: int = 64):
+    """A ← packed LU (unit-lower L below the diagonal, U on/above)
+    without pivoting, via blocked Schur recursion."""
+    Af = jnp.asarray(A, jnp.float32)
+
+    def rec(T):
+        n = T.shape[0]
+        if n <= base or n % 2:
+            return _lu_base(T)
+        h = n // 2
+        A11 = rec(T[:h, :h])
+        # A12 <- L11^-1 A12 (unit-lower), A21 <- A21 U11^-1
+        A12 = jax.lax.linalg.triangular_solve(
+            A11, T[:h, h:], left_side=True, lower=True,
+            unit_diagonal=True)
+        A21 = jax.lax.linalg.triangular_solve(
+            A11, T[h:, :h], left_side=False, lower=False)
+        S = T[h:, h:] - jnp.matmul(A21, A12,
+                                   preferred_element_type=jnp.float32,
+                                   precision=_prec())
+        A22 = rec(S)
+        top = jnp.concatenate([A11, A12], axis=1)
+        return jnp.concatenate(
+            [top, jnp.concatenate([A21, A22], axis=1)], axis=0)
+
+    return rec(Af).astype(A.dtype)
+
+
+def lu_split(LU):
+    """Unpack (L unit-lower, U upper) from a packed LU tile."""
+    L = jnp.tril(LU, -1) + jnp.eye(LU.shape[0], dtype=LU.dtype)
+    return L, jnp.triu(LU)
+
+
+def trsm_lower_unit(LU, C):
+    """C ← L⁻¹·C with L the unit-lower factor of a packed LU tile (the
+    dgetrf row-panel update, left solve)."""
+    return jax.lax.linalg.triangular_solve(
+        jnp.asarray(LU, jnp.float32), jnp.asarray(C, jnp.float32),
+        left_side=True, lower=True, unit_diagonal=True).astype(C.dtype)
+
+
+def trsm_upper_right(LU, C):
+    """C ← C·U⁻¹ with U the upper factor of a packed LU tile (the
+    dgetrf column-panel update, right solve)."""
+    return jax.lax.linalg.triangular_solve(
+        jnp.asarray(LU, jnp.float32), jnp.asarray(C, jnp.float32),
+        left_side=False, lower=False).astype(C.dtype)
+
+
 # ---- tiled-QR kernels (DPLASMA dgeqrf tile operations) -----------------
 # Functional variant: the reference's Householder kernels (GEQRT/TSQRT/
 # UNMQR/TSMQR with compact V+T storage) are re-expressed with explicit
