@@ -40,9 +40,9 @@ def main():
     B = TiledMatrix.from_array(G.copy(), nb, nb, name="B")
     PanelExecutor(plan_taskpool(build_geqrf_hh(B))).run()
     R = B.to_array().astype(np.float64)
+    GtG = G.astype(np.float64).T @ G
     print("geqrf  residual:",
-          np.linalg.norm(R.T @ R - G.T.astype(np.float64) @ G) /
-          np.linalg.norm(G.T.astype(np.float64) @ G))
+          np.linalg.norm(R.T @ R - GtG) / np.linalg.norm(GtG))
 
     # GETRF: diagonally dominant (no-pivot contract), packed L\\U result
     D = (rng.standard_normal((n, n)) + n * np.eye(n)).astype(np.float32)
