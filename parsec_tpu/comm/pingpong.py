@@ -125,6 +125,13 @@ def _rank_main(rank: int, nb_ranks: int, base_port: int, hops: int,
         from ..utils import mca_param
 
         mca_param.set("comm.eager_limit", eager_limit)
+        if not device:
+            # host-payload latency rows measure the WIRE: without this,
+            # stage-through reads + receive staging route every payload
+            # through the accelerator (measured 3.8 ms -> ~170 ms/hop
+            # through the axon tunnel)
+            mca_param.set("runtime.stage_reads", "0")
+            mca_param.set("comm.stage_recv", "0")
         engine = SocketCommEngine(rank, nb_ranks, base_port=base_port)
         ctx = ctx_mod.init(nb_cores=1, comm=engine)
         A = _AlternatingVec(hops, nb_ranks, rank, payload_f32,

@@ -37,6 +37,10 @@ from ..utils.debug import debug_verbose, warning
 from .. import termdet as termdet_mod
 
 mca_param.register("runtime.nb_cores", 0, help="worker streams (0 = os.cpu_count())")
+mca_param.register("runtime.stage_reads", "auto",
+                   help="stage-through collection reads to the "
+                        "accelerator: auto (when a non-CPU device is "
+                        "registered) | 1 | 0")
 mca_param.register("runtime.backoff_min_us", 50, help="starvation backoff floor")
 mca_param.register("runtime.backoff_max_us", 2000, help="starvation backoff ceiling")
 mca_param.register("vpmap", "flat",
@@ -191,21 +195,34 @@ class Context:
     @property
     def stage_reads(self) -> bool:
         """True when collection reads should stage-through to the
-        accelerator (a real non-CPU device is registered). The
-        reference keeps per-device data copies with coherency
-        (device_gpu stage-in attaches the GPU copy to the data object);
-        here the collection's stored tile is REPLACED by its staged
-        device array on first read, so every later reader reuses the
-        single H2D transfer — re-staging per task measured 100×-class
-        slowdowns on remote-tunnel backends where host transfers are
-        synchronous."""
-        cached = self.__dict__.get("_stage_reads")
-        if cached is None:
-            cached = any(
+        accelerator (``runtime.stage_reads``: auto = a real non-CPU
+        device is registered). The reference keeps per-device data
+        copies with coherency (device_gpu stage-in attaches the GPU
+        copy to the data object); here the collection's stored tile is
+        REPLACED by its staged device array on first read, so every
+        later reader reuses the single H2D transfer — re-staging per
+        task measured 100×-class slowdowns on remote-tunnel backends
+        where host transfers are synchronous. Set ``0`` for host-pure
+        workloads (e.g. wire-latency harnesses: staging would route
+        every payload through the accelerator)."""
+        # per-read hot path: cache the resolved answer against the MCA
+        # registry generation (one int compare) instead of taking the
+        # registry lock per collection read
+        gen = mca_param.generation()
+        cached = self.__dict__.get("_stage_reads_gen")
+        if cached is not None and cached[0] == gen:
+            return cached[1]
+        mode = str(mca_param.get("runtime.stage_reads", "auto"))
+        if mode in ("0", "off", "false"):
+            result = False
+        elif mode in ("1", "on", "true"):
+            result = True
+        else:
+            result = any(
                 getattr(d, "platform", "cpu") not in ("cpu",)
                 for d in getattr(self.devices, "devices", []))
-            self.__dict__["_stage_reads"] = cached
-        return cached
+        self.__dict__["_stage_reads_gen"] = (gen, result)
+        return result
 
     def stage_read(self, dc, key, value):
         """Stage-through one collection read (see :attr:`stage_reads`):
