@@ -101,6 +101,9 @@ class Context:
         vp_ids = _parse_vpmap(nb_cores)
         self.streams = [ExecutionStream(self, i, vp_ids[i])
                         for i in range(nb_cores)]
+        # context-level counters (tasks completed by device managers,
+        # which have no owning stream — ASYNC contract)
+        self.stats: Dict[str, int] = {"device_completed": 0}
 
         self.scheduler = sched_mod.new_scheduler(scheduler)
         self.scheduler.install(self)
@@ -273,6 +276,8 @@ class Context:
         for es in self.streams:
             if es.thread is not None:
                 es.thread.join(timeout=5.0)
+        for dev in self.devices.devices:
+            dev.shutdown()
         if self.comm is not None:
             self.comm.disable()
         self.scheduler.remove(self)
@@ -457,6 +462,12 @@ class Context:
         tc = task.task_class
         if es is not None:
             es.stats["executed"] += 1
+        else:
+            # device-manager completion (ASYNC contract): attribute
+            # here so TASKS_EXECUTED still covers every task
+            with self._lock:
+                self.stats["device_completed"] = \
+                    self.stats.get("device_completed", 0) + 1
         self.pins.exec_end(es, task)
         self.pins.complete_exec_begin(es, task)
         if self.trace is not None:

@@ -43,6 +43,10 @@ class Device:
     def execute(self, es, task: Task, chore: Chore) -> HookReturn:
         raise NotImplementedError
 
+    def shutdown(self) -> None:
+        """Stop any device-owned threads (called from Context.fini);
+        base devices have none."""
+
     def release_load(self) -> None:
         """Release the in-flight work unit ``Registry.device_for`` added.
         The context releases it automatically when ``execute`` returns
@@ -105,8 +109,15 @@ class Registry:
                 devs = jax.devices()
                 if limit > 0:
                     devs = devs[:limit]
-                for jd in devs:
-                    self.add(TPUDevice(jd))
+                added = [self.add(TPUDevice(jd)) for jd in devs]
+                if any(d.platform != "cpu" for d in added):
+                    # a REAL accelerator is registered: the CPU device's
+                    # eager jnp ops would dispatch op-by-op to the same
+                    # chip (~0.3 s/task through a remote tunnel) — make
+                    # it a last resort, not a load-balancing peer
+                    # (reference: the GFLOPS weight table keeps CPU
+                    # cores ~100x below GPUs, device_cuda_module.c:53)
+                    self.devices[0].weight = 0.01
             except Exception as exc:  # jax missing/broken → CPU-only context
                 debug_verbose(2, "device", "TPU device unavailable: %s", exc)
 
@@ -131,7 +142,11 @@ class Registry:
             if dev.device_type == DeviceType.RECURSIVE and \
                     device_type != DeviceType.RECURSIVE:
                 continue
-            score = dev.load / dev.weight
+            # (load+1)/weight, not load/weight: an IDLE low-weight
+            # device must not win ties against an accelerator whose
+            # manager holds queued work (a 0.01-weight CPU device then
+            # only wins when the accelerator is ~10000 deep)
+            score = (dev.load + 1.0) / dev.weight
             if best_score is None or score < best_score or \
                     (score == best_score and dev.weight > best.weight):
                 best, best_score = dev, score

@@ -11,4 +11,29 @@ class CPUDevice(Device):
     name = "cpu"
 
     def execute(self, es, task: Task, chore: Chore) -> HookReturn:
+        self._reconcile_devices(task)
         return self._run_hook(task, chore)
+
+    @staticmethod
+    def _reconcile_devices(task: Task) -> None:
+        """Inputs produced by different accelerator modules arrive
+        committed to different devices; eager jnp ops on such a mix
+        raise ("incompatible devices"). Re-commit every jax input onto
+        the FIRST jax input's device so the body sees one consistent
+        placement (device_put is a no-op for already-resident
+        buffers)."""
+        import sys
+        if "jax" not in sys.modules:
+            return
+        import jax
+        target = None
+        arrays = []
+        for name, v in task.data.items():
+            if isinstance(v, jax.Array):
+                dev = getattr(v, "device", None)
+                if target is None:
+                    target = dev
+                elif dev is not None and dev != target:
+                    arrays.append(name)
+        for name in arrays:
+            task.data[name] = jax.device_put(task.data[name], target)

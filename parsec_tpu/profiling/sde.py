@@ -80,7 +80,8 @@ def install_runtime_counters(context,
                        lambda: context.scheduler.pending_tasks())
     reg.register_gauge(f"{prefix}::TASKS_EXECUTED",
                        lambda: sum(es.stats["executed"]
-                                   for es in context.streams))
+                                   for es in context.streams) +
+                       context.stats.get("device_completed", 0))
     reg.register_gauge(f"{prefix}::TASKS_STOLEN",
                        lambda: sum(es.stats["stolen"]
                                    for es in context.streams))

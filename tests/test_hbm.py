@@ -122,6 +122,10 @@ def test_host_runtime_collection_spill():
 
     n, nb = 1024, 64        # 136 written lower tiles = 2.2 MiB
     mca_param.set("device.hbm_budget_mb", 1)   # 1 MiB = 64 tiles
+    # one device module → one zone: with 8 virtual devices the batching
+    # manager spreads tiles over 8 per-device zones and the budget is
+    # never exercised
+    mca_param.set("device.tpu.max_devices", 1)
     try:
         A_host = _spd(n)
         A = TiledMatrix.from_array(A_host.copy(), nb, nb, name="A")
@@ -140,6 +144,7 @@ def test_host_runtime_collection_spill():
         assert peak <= 1 << 20
     finally:
         mca_param.set("device.hbm_budget_mb", 0)
+        mca_param.unset("device.tpu.max_devices")
 
 
 def test_sweep_drops_dead_collection_entries():

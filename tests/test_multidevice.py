@@ -46,3 +46,53 @@ def test_dtd_gemm_load_splits_across_devices():
     assert np.allclose(C.to_array(), C_h + A_h @ B_h, atol=1e-3)
     busy = [n for n, c in per_dev.items() if c > 0]
     assert len(busy) >= 2, f"no load split: {per_dev}"
+
+
+def test_batch_dispatch_manager(rng):
+    """The per-device manager batches same-class ready tasks into one
+    vmapped dispatch (progress_stream analog): a wide independent wave
+    must complete correctly AND register multi-task batches."""
+    import parsec_tpu as parsec
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl import ptg
+    from parsec_tpu.utils import mca_param
+
+    NT = 32
+    store = LocalCollection(
+        "S", {("x", i): rng.standard_normal((16, 16)).astype(np.float32)
+              for i in range(NT)} | {("y", i): None for i in range(NT)})
+    mca_param.set("device.tpu.max_devices", 1)   # one manager: big batches
+    mca_param.set("device.tpu.batch_dispatch", 1)
+    try:
+        ctx = parsec.init(nb_cores=2)
+        ctx.start()
+        tp = ptg.Taskpool("wide", N=NT, S=store)
+        tp.task_class(
+            "W", params=("i",),
+            space=lambda g: ((i,) for i in range(g.N)),
+            flows=[ptg.FlowSpec(
+                "X", ptg.RW,
+                ins=[ptg.In(data=lambda g, i: (g.S, ("x", i)))],
+                outs=[ptg.Out(data=lambda g, i: (g.S, ("y", i)))])])
+
+        @tp.task_class_by_name("W").body
+        def w_body(task, X):
+            import jax.numpy as jnp
+            return jnp.asarray(X) * 2.0 + 1.0
+
+        ctx.add_taskpool(tp)
+        assert ctx.wait(timeout=60)
+        tpu_stats = [d.dump_statistics() for d in ctx.devices.devices
+                     if d.name.startswith("tpu")]
+        parsec.fini(ctx)
+        for i in range(NT):
+            np.testing.assert_allclose(
+                np.asarray(store.data_of(("y", i))),
+                np.asarray(store.data_of(("x", i))) * 2.0 + 1.0,
+                rtol=1e-6)
+        batched = sum(s.get("batched_tasks", 0) for s in tpu_stats)
+        batches = sum(s.get("batches", 0) for s in tpu_stats)
+        assert batched > batches >= 1, (batched, batches)
+    finally:
+        mca_param.unset("device.tpu.max_devices")
+        mca_param.unset("device.tpu.batch_dispatch")
