@@ -152,3 +152,32 @@ def test_early_activation_parks_until_taskpool_registered():
     finally:
         for ctx in ctxs:
             parsec.fini(ctx)
+
+
+def test_fetch_tiles_cleans_futures_on_error():
+    """A failing slot in a concurrent batch fetch must not leak the
+    remaining registered futures (stale late replies would fulfill
+    abandoned entries)."""
+    import pytest
+    from parsec_tpu.comm.engine import CommEngine
+
+    class _Probe(CommEngine):
+        def __init__(self):
+            super().__init__(rank=0, nb_ranks=2)
+            self.sent = []
+
+        def send_am(self, tag, dst, msg):
+            self.sent.append(msg)
+            # reply: first request errors, the rest never answered
+            if len(self.sent) == 1:
+                self._on_tile_fetch(1, {"reply": True, "req": msg["req"],
+                                        "error": "boom"})
+
+    class _DC:
+        name = "A"      # all slots remote: data_of is never consulted
+
+    eng = _Probe()
+    with pytest.raises(RuntimeError, match="boom"):
+        eng.fetch_tiles(_DC(), [((0, 0), 1), ((0, 1), 1), ((0, 2), 1)],
+                        timeout=5)
+    assert eng._fetch_futures == {}, "futures leaked"

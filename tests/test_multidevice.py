@@ -96,3 +96,62 @@ def test_batch_dispatch_manager(rng):
     finally:
         mca_param.unset("device.tpu.max_devices")
         mca_param.unset("device.tpu.batch_dispatch")
+
+
+def test_batch_dispatch_uses_batch_hook(rng):
+    """A class with a hand-batched hook (shared-flow TRSM shape) must
+    dispatch through it when the shared flow holds ONE value across the
+    group — and produce the same results."""
+    import parsec_tpu as parsec
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl import ptg
+    from parsec_tpu.utils import mca_param
+
+    NT = 8
+    L = rng.standard_normal((16, 16)).astype(np.float32)
+    store = LocalCollection(
+        "S", {("l",): L} |
+        {("c", i): rng.standard_normal((16, 16)).astype(np.float32)
+         for i in range(NT)} | {("y", i): None for i in range(NT)})
+    calls = {"hook": 0}
+
+    def batch_hook(Ls, Cs):
+        calls["hook"] += 1
+        import jax.numpy as jnp
+        return jnp.matmul(Cs, Ls[0].T)      # one shared factor
+
+    mca_param.set("device.tpu.max_devices", 1)
+    mca_param.set("device.tpu.batch_dispatch", 1)
+    try:
+        ctx = parsec.init(nb_cores=2)
+        ctx.start()
+        tp = ptg.Taskpool("trsmish", N=NT, S=store)
+        TC = tp.task_class(
+            "T", params=("i",),
+            space=lambda g: ((i,) for i in range(g.N)),
+            flows=[
+                ptg.FlowSpec(
+                    "L", ptg.READ,
+                    ins=[ptg.In(data=lambda g, i: (g.S, ("l",)))]),
+                ptg.FlowSpec(
+                    "C", ptg.RW,
+                    ins=[ptg.In(data=lambda g, i: (g.S, ("c", i)))],
+                    outs=[ptg.Out(data=lambda g, i: (g.S, ("y", i)))])])
+
+        @TC.body(batch_hook=batch_hook, batch_hook_shared=("L",))
+        def t_body(task, L_, C_):
+            import jax.numpy as jnp
+            return {"C": jnp.matmul(C_, L_.T)}
+
+        ctx.add_taskpool(tp)
+        assert ctx.wait(timeout=60)
+        parsec.fini(ctx)
+    finally:
+        mca_param.unset("device.tpu.max_devices")
+        mca_param.unset("device.tpu.batch_dispatch")
+    for i in range(NT):
+        np.testing.assert_allclose(
+            np.asarray(store.data_of(("y", i))),
+            np.asarray(store.data_of(("c", i))) @ L.T, rtol=1e-5,
+            atol=1e-5)
+    assert calls["hook"] >= 1, "batch_hook never engaged"
