@@ -204,42 +204,71 @@ def _measure_extras(jax, jnp, np, on_tpu):
         return timed_median(once, reps=reps) / K
 
     # -- DTD tiled GEMM, host runtime vs compiled -------------------------
+    # The host-runtime run happens in a FRESH subprocess: host<->device
+    # dispatch in THIS process degrades ~10x after the flagship's large
+    # programs (remote-backend behavior), which would misreport the
+    # runtime's actual dispatch capability — the same isolation the
+    # latency harness uses.
     try:
         n, nb = (2048, 512) if on_tpu else (512, 128)
+        flops = 2.0 * n ** 3
+        host_child = f"""
+import os, time, numpy as np
+_plat = os.environ.get("PARSEC_BENCH_PLATFORM")
+if _plat:                      # the axon plugin overrides JAX_PLATFORMS
+    import jax
+    jax.config.update("jax_platforms", _plat)
+import parsec_tpu as parsec
+from parsec_tpu import dtd
+from parsec_tpu.algorithms import insert_gemm_dtd
+from parsec_tpu.data.matrix import TiledMatrix
+from parsec_tpu.utils.compile_cache import enable_compile_cache
+enable_compile_cache()
+import jax
+n, nb = {n}, {nb}
+rng = np.random.default_rng(0)
+A_h = rng.standard_normal((n, n)).astype(np.float32)
+B_h = rng.standard_normal((n, n)).astype(np.float32)
+ctx = parsec.init(nb_cores=4)
+ctx.start()
+A = TiledMatrix.from_array(A_h, nb, nb, name="A")
+B = TiledMatrix.from_array(B_h, nb, nb, name="B")
+best = None
+for rep in range(3):      # rep 0 warms the per-process jit
+    C = TiledMatrix.from_array(np.zeros((n, n), np.float32), nb, nb,
+                               name="C%d" % rep)
+    tp = dtd.Taskpool("g%d" % rep)
+    ctx.add_taskpool(tp)
+    t0 = time.perf_counter()
+    insert_gemm_dtd(tp, A, B, C)
+    tp.wait()
+    jax.block_until_ready([C.data_of(k) for k in C.local_keys()])
+    dt = time.perf_counter() - t0
+    if rep and (best is None or dt < best):
+        best = dt
+err = float(np.abs(C.to_array() - A_h @ B_h).max() /
+            np.abs(A_h @ B_h).max())
+parsec.fini(ctx)
+print("HOST_RESULT %.6f %.3e" % (best, err))
+"""
+        import subprocess
+        proc = subprocess.run(
+            [sys.executable, "-c", host_child], capture_output=True,
+            text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        line = next((ln for ln in proc.stdout.splitlines()
+                     if ln.startswith("HOST_RESULT")), None)
+        if line is None:
+            # surface the child's failure, not an empty StopIteration
+            raise RuntimeError(
+                f"host-runtime child rc={proc.returncode}: "
+                f"{proc.stderr[-300:]}")
+        host_s = float(line.split()[1])
+        host_err = float(line.split()[2])
+
         A_h = rng.standard_normal((n, n)).astype(np.float32)
         B_h = rng.standard_normal((n, n)).astype(np.float32)
         C_h = np.zeros((n, n), np.float32)
-
-        ctx = parsec.init(nb_cores=4)
-        try:
-            ctx.start()
-            A = TiledMatrix.from_array(A_h.copy(), nb, nb, name="A")
-            B = TiledMatrix.from_array(B_h.copy(), nb, nb, name="B")
-            # warm run: the pure-body jit compiles once per process;
-            # the reference similarly excludes CUDA module load/compile
-            # from its steady-state device numbers
-            Cw = TiledMatrix.from_array(C_h.copy(), nb, nb, name="Cw")
-            tpw = dtd.Taskpool("gemm_warm")
-            ctx.add_taskpool(tpw)
-            insert_gemm_dtd(tpw, A, B, Cw)
-            tpw.wait()
-            jax.block_until_ready(
-                [Cw.data_of(k) for k in Cw.local_keys()])
-            C = TiledMatrix.from_array(C_h.copy(), nb, nb, name="C")
-            tp = dtd.Taskpool("gemm_bench")
-            ctx.add_taskpool(tp)
-            t0 = time.perf_counter()
-            insert_gemm_dtd(tp, A, B, C)
-            tp.wait()
-            # force: the final tiles are async jax values
-            jax.block_until_ready(
-                [C.data_of(k) for k in C.local_keys()])
-            host_s = time.perf_counter() - t0
-        finally:
-            # a leaked context would leave worker threads skewing the
-            # geqrf/transformer sections below
-            parsec.fini(ctx)
-        flops = 2.0 * n ** 3
 
         A2 = TiledMatrix.from_array(A_h.copy(), nb, nb, name="A")
         B2 = TiledMatrix.from_array(B_h.copy(), nb, nb, name="B")
@@ -278,12 +307,14 @@ def _measure_extras(jax, jnp, np, on_tpu):
             "panel_fused_n": np_,
             "n": n, "tile": nb,
             "host_runtime_gflops": round(flops / host_s / 1e9, 1),
+            "host_runtime_rel_err": float(f"{host_err:.3e}"),
             "compiled_gflops": round(flops / comp_s / 1e9, 1),
             "host_vs_compiled": round(comp_s / host_s, 4),
-            "note": "host runtime: pure-body jitted DTD dispatch "
-                    "(dsl/dtd.py pure=True) pipelines asynchronously "
-                    "on accelerator-first device selection; per-task "
-                    "cost approaches the ~1.4 ms link dispatch floor",
+            "note": "host runtime measured in a fresh subprocess "
+                    "(in-process dispatch degrades ~10x after the "
+                    "flagship's large programs on this remote "
+                    "backend): pure-body jitted DTD dispatch + "
+                    "accelerator-first device selection",
         }
     except Exception as exc:  # noqa: BLE001
         out["dtd_gemm"] = {"error": str(exc)[:200]}
