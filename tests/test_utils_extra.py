@@ -190,3 +190,37 @@ def test_thread_binding_best_effort():
         assert got["core"] == cores[3 % len(cores)]
     finally:
         mca_param.set("runtime.bind_workers", 0)
+
+
+def test_compile_cache_enable(tmp_path):
+    """enable_compile_cache points JAX's persistent cache at the given
+    (or default) dir and is idempotent; PARSEC_COMPILE_CACHE=0 disables."""
+    import os
+    import jax
+    from parsec_tpu.utils.compile_cache import enable_compile_cache
+
+    d = str(tmp_path / "cache")
+    assert enable_compile_cache(d) == d
+    assert jax.config.jax_compilation_cache_dir == d
+    assert enable_compile_cache(d) == d        # idempotent
+    old = os.environ.get("PARSEC_COMPILE_CACHE")
+    os.environ["PARSEC_COMPILE_CACHE"] = "0"
+    try:
+        assert enable_compile_cache() is None
+    finally:
+        if old is None:
+            del os.environ["PARSEC_COMPILE_CACHE"]
+        else:
+            os.environ["PARSEC_COMPILE_CACHE"] = old
+
+
+def test_mca_generation_counter():
+    """set/unset bump the registry generation (hot-path caches key off
+    it: debug_history, Context.stage_reads)."""
+    from parsec_tpu.utils import mca_param
+    g0 = mca_param.generation()
+    mca_param.set("test.gen_probe", 1)
+    g1 = mca_param.generation()
+    assert g1 > g0
+    mca_param.unset("test.gen_probe")
+    assert mca_param.generation() > g1
