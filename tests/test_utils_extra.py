@@ -192,26 +192,25 @@ def test_thread_binding_best_effort():
         mca_param.set("runtime.bind_workers", 0)
 
 
-def test_compile_cache_enable(tmp_path):
+def test_compile_cache_enable(tmp_path, monkeypatch):
     """enable_compile_cache points JAX's persistent cache at the given
-    (or default) dir and is idempotent; PARSEC_COMPILE_CACHE=0 disables."""
-    import os
+    (or default) dir and is idempotent; PARSEC_COMPILE_CACHE=0
+    disables. Prior config is restored — the cache dir is process
+    state."""
     import jax
     from parsec_tpu.utils.compile_cache import enable_compile_cache
 
+    prev = jax.config.jax_compilation_cache_dir
+    monkeypatch.delenv("PARSEC_COMPILE_CACHE", raising=False)
     d = str(tmp_path / "cache")
-    assert enable_compile_cache(d) == d
-    assert jax.config.jax_compilation_cache_dir == d
-    assert enable_compile_cache(d) == d        # idempotent
-    old = os.environ.get("PARSEC_COMPILE_CACHE")
-    os.environ["PARSEC_COMPILE_CACHE"] = "0"
     try:
+        assert enable_compile_cache(d) == d
+        assert jax.config.jax_compilation_cache_dir == d
+        assert enable_compile_cache(d) == d        # idempotent
+        monkeypatch.setenv("PARSEC_COMPILE_CACHE", "0")
         assert enable_compile_cache() is None
     finally:
-        if old is None:
-            del os.environ["PARSEC_COMPILE_CACHE"]
-        else:
-            os.environ["PARSEC_COMPILE_CACHE"] = old
+        jax.config.update("jax_compilation_cache_dir", prev)
 
 
 def test_mca_generation_counter():
@@ -224,3 +223,5 @@ def test_mca_generation_counter():
     assert g1 > g0
     mca_param.unset("test.gen_probe")
     assert mca_param.generation() > g1
+    # drop the probe's auto-registration: the registry is process-global
+    mca_param._registry._params.pop("test.gen_probe", None)
