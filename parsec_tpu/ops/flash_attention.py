@@ -42,7 +42,8 @@ _MINLANE = 128        # f32 lane tile: scalar-per-row state is stored
 
 
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-               l_ref, *, scale: float, causal: bool, bq: int, bk: int):
+               l_ref, *, scale: float, causal: bool, bq: int, bk: int,
+               prec=None):
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -63,7 +64,8 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         k = k_ref[0]                 # (bk, dh)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32,
+            precision=prec) * scale
 
         if causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(
@@ -83,7 +85,7 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         l_new = l_prev * corr + jnp.sum(p, axis=-1)
         acc_ref[...] = acc_ref[...] * corr[:, None] + jax.lax.dot_general(
             p, v_ref[0], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=prec)
         m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
@@ -148,8 +150,18 @@ def flash_attention(q, k, v, causal: bool = False,
         pad = [(0, 0), (0, 0), (0, dh_p - dh)]
         qT, kT, vT = (jnp.pad(x, pad) for x in (qT, kT, vT))
 
+    # honor the global MXU precision knob like every other tile kernel
+    # (ops.matmul_precision): "highest" runs the kernel's dots in full
+    # f32 — the TPU test mode and precision-variant benches rely on it.
+    # Mosaic's dot lowering supports only DEFAULT and HIGHEST; "high"
+    # (3-pass, fine for jnp kernels) maps to HIGHEST here rather than
+    # failing to compile.
+    from .tile_kernels import matmul_precision
+    prec = matmul_precision()
+    if prec == "high":
+        prec = "highest"
     kern = functools.partial(_fa_kernel, scale=scale, causal=causal,
-                             bq=bq, bk=bk)
+                             bq=bq, bk=bk, prec=prec)
     out, lse = pl.pallas_call(
         kern,
         grid=(H, S // bq, Sk // bk),
