@@ -83,21 +83,6 @@ def _make_lat_probe():
     return lambda i=0: float(lat_f(jnp.float32(i)))
 
 
-def _timed_median(f, probe, reps=3):
-    """Median of reps, each with a fresh link-latency sample subtracted
-    (remote-tunnel measurement hygiene: a single call at these sizes is
-    otherwise dominated by the ~0.1 s roundtrip)."""
-    s = []
-    for i in range(reps):
-        t0 = time.perf_counter()
-        probe(i)
-        lat = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        f()
-        s.append(max(time.perf_counter() - t0 - lat, 1e-6))
-    return sorted(s)[reps // 2]
-
-
 def _chain_timed(step_fn, state0, K, probe, reps=3, agg="median"):
     """Time K data-chained async dispatches with one final fetch —
     workloads shorter than the link roundtrip are unmeasurable any
@@ -256,7 +241,7 @@ def _section_gemm():
     import jax.numpy as jnp
     from parsec_tpu.algorithms.gemm import build_gemm_ptg
     from parsec_tpu.compiled.panels import PanelExecutor
-    from parsec_tpu.compiled.wavefront import WavefrontExecutor, plan_taskpool
+    from parsec_tpu.compiled.wavefront import plan_taskpool
     from parsec_tpu.data.matrix import TiledMatrix
 
     on_tpu = jax.default_backend() == "tpu"
@@ -264,14 +249,18 @@ def _section_gemm():
     rng = np.random.default_rng(0)
     out = {}
 
-    # panel-fused: one deep matmul per C pass (k-blocked fuser)
-    np_, nbp = (8192, 1024) if on_tpu else (512, 128)
+    # panel-fused: one deep matmul per C pass (k-blocked fuser).
+    # n=16384: the 61 ms/pass puts the timed region (K*REP passes)
+    # near 0.5 s, where tunnel jitter stops mattering — at n=8192 the
+    # 96 ms region produced 83-210 TF/s swings (round-3's 48% capture
+    # was this noise, not the fuser: re-measured 143-147 TF/s stable)
+    np_, nbp = (16384, 1024) if on_tpu else (512, 128)
     np_ = int(os.environ.get("PARSEC_BENCH_GEMM_N", np_))
     A3 = TiledMatrix(np_, np_, nbp, nbp, name="A")
     B3 = TiledMatrix(np_, np_, nbp, nbp, name="B")
     C3 = TiledMatrix(np_, np_, nbp, nbp, name="C")
     exp = PanelExecutor(plan_taskpool(build_gemm_ptg(A3, B3, C3)))
-    REP = 8                       # repeats inside ONE jit: a single
+    REP = 4 if on_tpu else 8      # repeats inside ONE jit: a single
     #                               pass is shorter than the link rtt
 
     def multi(st):
@@ -304,11 +293,37 @@ def _section_gemm():
                    "(in-process dispatch degrades ~10x after large "
                    "programs on this remote backend)")
 
-    # compiled per-tile executor at a smaller (n, nb)
+    return {"dtd_gemm": out}
+
+
+def _section_hostdtd():
+    """DTD host-runtime GEMM — the honest test that the RUNTIME (insert/
+    dep-track/schedule/dispatch), not just the compiled path, can use the
+    chip. Its own section child so nothing LARGE precedes it: this is
+    the most dispatch-state-sensitive number in the bench (round 3:
+    985 GF/s fresh-first vs ~46 measured late in a heavy process).
+    The per-tile compiled executor row runs first in this child (it is
+    a small program — not the multi-GB kind that degrades dispatch) so
+    host_vs_compiled compares rows from one process."""
+    import numpy as np
+    import jax
+    import parsec_tpu as parsec
+    from parsec_tpu import dtd
+    from parsec_tpu.algorithms import insert_gemm_dtd
+    from parsec_tpu.algorithms.gemm import build_gemm_ptg
+    from parsec_tpu.compiled.wavefront import WavefrontExecutor, plan_taskpool
+    from parsec_tpu.data.matrix import TiledMatrix
+
+    on_tpu = jax.default_backend() == "tpu"
+    probe = _make_lat_probe()
+    rng = np.random.default_rng(0)
+    n, nb = (2048, 512) if on_tpu else (512, 128)
+    flops = 2.0 * n ** 3
+    A_h = rng.standard_normal((n, n)).astype(np.float32)
+    B_h = rng.standard_normal((n, n)).astype(np.float32)
+
+    comp_s = None
     try:
-        n, nb = (2048, 512) if on_tpu else (512, 128)
-        A_h = rng.standard_normal((n, n)).astype(np.float32)
-        B_h = rng.standard_normal((n, n)).astype(np.float32)
         A2 = TiledMatrix.from_array(A_h.copy(), nb, nb, name="A")
         B2 = TiledMatrix.from_array(B_h.copy(), nb, nb, name="B")
         C2 = TiledMatrix.from_array(np.zeros((n, n), np.float32), nb, nb,
@@ -316,33 +331,8 @@ def _section_gemm():
         ex = WavefrontExecutor(plan_taskpool(build_gemm_ptg(A2, B2, C2)))
         red = jax.jit(ex.run_tile_dict)    # dict -> dict: chainable
         comp_s = _chain_timed(red, ex.make_tiles(), K=8, probe=probe)
-        out.update({"n": n, "tile": nb,
-                    "compiled_gflops": round(2.0 * n ** 3 / comp_s / 1e9,
-                                             1)})
-    except Exception as exc:  # noqa: BLE001 — keep the panel row
-        out["compiled_error"] = str(exc)[:200]
-    return {"dtd_gemm": out}
-
-
-def _section_hostdtd():
-    """DTD host-runtime GEMM — the honest test that the RUNTIME (insert/
-    dep-track/schedule/dispatch), not just the compiled path, can use the
-    chip. Its own section child with NOTHING before it: this is the most
-    dispatch-state-sensitive number in the bench (round 3: 985 GF/s
-    fresh-first vs ~46 measured late in a heavy process)."""
-    import numpy as np
-    import jax
-    import parsec_tpu as parsec
-    from parsec_tpu import dtd
-    from parsec_tpu.algorithms import insert_gemm_dtd
-    from parsec_tpu.data.matrix import TiledMatrix
-
-    on_tpu = jax.default_backend() == "tpu"
-    rng = np.random.default_rng(0)
-    n, nb = (2048, 512) if on_tpu else (512, 128)
-    flops = 2.0 * n ** 3
-    A_h = rng.standard_normal((n, n)).astype(np.float32)
-    B_h = rng.standard_normal((n, n)).astype(np.float32)
+    except Exception:  # noqa: BLE001 — ratio row degrades gracefully
+        pass
 
     ctx = parsec.init(nb_cores=4)
     ctx.start()
@@ -367,10 +357,13 @@ def _section_hostdtd():
     out = {"n": n, "tile": nb,
            "host_runtime_gflops": round(flops / best / 1e9, 1),
            "host_runtime_rel_err": float(f"{host_err:.3e}"),
-           "note": "own fresh subprocess, nothing before it: pure-body "
-                   "jitted DTD dispatch + accelerator-first device "
-                   "selection; host_vs_compiled computed by the parent "
-                   "against the gemm section's fresh compiled row"}
+           "note": "own fresh subprocess: pure-body jitted DTD dispatch "
+                   "+ accelerator-first device selection; compiled "
+                   "per-tile row measured first in the same child "
+                   "(small program — comparable process states)"}
+    if comp_s:
+        out["compiled_gflops"] = round(flops / comp_s / 1e9, 1)
+        out["host_vs_compiled"] = round(comp_s / best, 4)
     return {"host_dtd": out}
 
 
@@ -979,14 +972,6 @@ def main():
     if os.environ.get("PARSEC_BENCH_EXTRAS", "1") != "0":
         for name in ("hostdtd", "gemm", "flash", "geqrf", "getrf", "ooc"):
             extras.update(_run_section(name))
-        # host-vs-compiled ratio across the two fresh children (each row
-        # measured first-thing in its own process — comparable states)
-        try:
-            h = extras["host_dtd"]["host_runtime_gflops"]
-            c = extras["dtd_gemm"]["compiled_gflops"]
-            extras["host_dtd"]["host_vs_compiled"] = round(h / c, 4)
-        except (KeyError, TypeError, ZeroDivisionError):
-            pass
     # the device-payload pingpong hammers the link for minutes → LAST
     latency.update(_measure_latency(device_row=True))
 

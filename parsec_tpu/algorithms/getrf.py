@@ -390,7 +390,19 @@ def _getrf_left_wave_fuser(wave, geoms):
     contract). Wave shapes per step k:
     [UPDC(·,k)+UPDR(k,·)] → two large matmuls into the carry;
     [GETRF(k)] → in-tile packed LU (Schur recursion);
-    [TRSM_L(·,k)+TRSM_U(k,·)] → two triangular applies + two DUS."""
+    [TRSM_L(·,k)+TRSM_U(k,·)] → two triangular applies + two DUS.
+
+    Storage: TWO stores, each with a SINGLE row-panel DUS chain —
+    the L/diag panels land in the collection's Aᵀ store
+    (write [c, k·mb:], exactly POTRF's shape) and the U row panels in
+    an A-layout carry ``st["_us"]`` (write [k·nb:(k+1)·nb, (k+1)·mb:]).
+    Interleaving both chains on ONE array defeats XLA's in-place DUS
+    scheduling and costs a full store copy per step — measured 7 ms/step
+    (= 168 ms of the 314 ms round-3 total) at N=24576 on a v5e; the
+    two-store split is ~0 ms/step. The final GETRF wave merges the U
+    store back with one transpose+select (us.T lands exactly on the
+    Aᵀ-store's U-tile region), so the executor's output contract (one
+    packed-LU array per collection) is unchanged."""
     (geom,) = geoms.values()
     import jax
     import jax.numpy as jnp
@@ -423,14 +435,17 @@ def _getrf_left_wave_fuser(wave, geoms):
 
         def do_update(st, k=k):
             D = st[geom.name]
+            us = st["_us"]       # exists: TRSM(0) precedes every update
             r0 = k * nb
-            # column panel (Aᵀ rows = block-col k): Uᵀ[:k,k]·Lᵀ[k:,:k]
-            Ut = D[r0:r0 + nb, 0:k * mb]          # (nb, k*mb)
+            # column panel (Aᵀ rows = block-col k): Uᵀ[:k,k]·Lᵀ[k:,:k];
+            # U factors read from the A-layout U store (transpose folds
+            # into the dot), L factors from the Aᵀ collection store
+            Ut = us[0:k * nb, k * mb:(k + 1) * mb].T   # (nb, k*nb)
             Lt = D[0:k * nb, k * mb:]             # (k*nb, mk)
             st["_lu_col"] = D[r0:r0 + nb, k * mb:] - mm(Ut, Lt)
             if k + 1 < NT:
                 # row panel (Aᵀ col strip = block-row k over rows > k)
-                Ut2 = D[(k + 1) * nb:, 0:k * mb]  # (T, k*mb)
+                Ut2 = us[0:k * nb, (k + 1) * mb:].T    # (T, k*nb)
                 Lt2 = D[0:k * nb, k * mb:(k + 1) * mb]   # (k*nb, nb)
                 st["_lu_row"] = D[(k + 1) * nb:,
                                   k * mb:(k + 1) * mb] - mm(Ut2, Lt2)
@@ -453,7 +468,17 @@ def _getrf_left_wave_fuser(wave, geoms):
             LU = getrf_nopiv_tile(diag)
             st["_lu_T"] = LU
             if last:
-                st[geom.name] = D.at[c, k * mb:].set(LU.T)
+                D = D.at[c, k * mb:].set(LU.T)
+                us = st.pop("_us", None)
+                if us is not None:
+                    # fold the U store back into the collection store:
+                    # us.T is Uᵀ in Aᵀ layout, i.e. every U tile (k, j>k)
+                    # already sits at its Aᵀ-store position — one
+                    # transpose+select instead of NT strided DUS
+                    bi = jnp.arange(D.shape[0]) // nb
+                    bj = jnp.arange(D.shape[1]) // mb
+                    D = jnp.where(bi[:, None] > bj[None, :], us.T, D)
+                st[geom.name] = D
             else:
                 if colk is not None:
                     st["_lu_col_rest"] = colk[:, nb:]
@@ -501,12 +526,18 @@ def _getrf_left_wave_fuser(wave, geoms):
                 solved_row = jax.lax.linalg.triangular_solve(
                     L, row, left_side=False, lower=True,
                     transpose_a=True, unit_diagonal=True)
-            # panel row write: packed LUᵀ + solved column panel
+            # panel writes, ONE DUS chain per store: L/diag row panel
+            # into the Aᵀ collection store, U row panel into the
+            # A-layout U carry (two chains on one array would cost a
+            # full store copy per step — see the fuser docstring)
             D = D.at[c, k * mb:].set(
                 jnp.concatenate([LU.T, solved_col.astype(D.dtype)],
                                 axis=1))
-            D = D.at[(k + 1) * nb:, k * mb:(k + 1) * mb].set(
-                solved_row.astype(D.dtype))
+            us = st.get("_us")
+            if us is None:
+                us = jnp.zeros_like(D)
+            st["_us"] = us.at[k * nb:(k + 1) * nb, (k + 1) * mb:].set(
+                solved_row.T.astype(D.dtype))
             st[geom.name] = D
             return st
 
