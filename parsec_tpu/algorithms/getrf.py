@@ -418,7 +418,7 @@ def _getrf_left_wave_fuser(wave, geoms):
     names = sorted(g.tc.name for g in wave)
     mb, nb = geom.mb, geom.nb
     MT, NT = geom.mt, geom.nt
-    inv_mode = mca_param.get("potrf.trsm_hook", "gemm") == "gemm"
+    inv_mode = mca_param.get("potrf.trsm_hook", "solve") == "gemm"
 
     if names in (["UPDC"], ["UPDC", "UPDR"]):
         updc = next(g for g in wave if g.tc.name == "UPDC")

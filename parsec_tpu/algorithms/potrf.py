@@ -22,14 +22,22 @@ from ..ops.tile_kernels import (gemm_tile, potrf_tile, potrf_tile_blocked,
                                 trsm_tiles_gemm, trsm_tiles_wide)
 from ..utils import mca_param
 
-# The compiled path's batched kernels. "gemm" inverts the shared diagonal
-# factor once per wave and runs every solve as an MXU matmul (MAGMA-style;
-# measured ~5-8x the wide-solve throughput at nb=2048) at the cost of
-# squaring the factor's condition-number contribution — fine for the
-# well-conditioned dense-LA regime DPLASMA targets; set "solve" for the
-# exact wide triangular solve.
-mca_param.register("potrf.trsm_hook", "gemm",
-                   help="compiled-path TRSM wave kernel: gemm|solve")
+# The compiled path's batched kernels. "solve" (default) is the exact
+# wide triangular solve — reference numerics (dplasma TRSM). "gemm"
+# inverts the shared diagonal factor once per wave and runs every solve
+# as an MXU matmul (MAGMA-style; measured ~5-8x the wide-solve
+# throughput at nb=2048) at the cost of squaring the factor's
+# condition-number contribution — fine for the well-conditioned
+# dense-LA regime DPLASMA targets, and what bench.py opts into for the
+# headline (measured bound at N=40960 bf16: residual 4.1e-6 gemm vs the
+# solve+highest variant's 4.5e-7; see PARITY.md divergence notes).
+# Default "solve": a library default must not silently diverge from
+# reference numerics for ill-conditioned inputs.
+mca_param.register("potrf.trsm_hook", "solve",
+                   help="compiled-path TRSM wave kernel: solve (exact, "
+                        "reference numerics) | gemm (inverted-triangle "
+                        "MXU multiply, ~5-8x faster, squares the "
+                        "condition-number contribution)")
 mca_param.register("potrf.blocked_tile_chol", 1,
                    help="use the matmul-rich blocked in-tile Cholesky in "
                         "the compiled path (0 = XLA cholesky)")
@@ -160,7 +168,7 @@ def build_potrf(A: TiledMatrix) -> ptg.Taskpool:
         return potrf_tile(T)
 
     def _trsm_hook(Ls, Cs):
-        if mca_param.get("potrf.trsm_hook", "gemm") == "gemm":
+        if mca_param.get("potrf.trsm_hook", "solve") == "gemm":
             return trsm_tiles_gemm(Ls[0], Cs)
         return trsm_tiles_wide(Ls[0], Cs)
 
@@ -245,7 +253,7 @@ def _potrf_wave_fuser(wave, geoms):
         if ms != list(range(ms[0], ms[0] + len(ms))):
             return None        # rows must be one contiguous panel
 
-        solve_mode = mca_param.get("potrf.trsm_hook", "gemm") == "solve"
+        solve_mode = mca_param.get("potrf.trsm_hook", "solve") == "solve"
 
         def do_trsm(st, k=k, lo=ms[0], hi=ms[-1] + 1):
             import jax
@@ -513,7 +521,7 @@ def _potrf_left_wave_fuser(wave, geoms):
 
         return do_update
 
-    solve_mode = mca_param.get("potrf.trsm_hook", "gemm") == "solve"
+    solve_mode = mca_param.get("potrf.trsm_hook", "solve") == "solve"
 
     if names == ["POTRF"]:
         (grp,) = wave

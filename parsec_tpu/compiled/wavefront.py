@@ -119,7 +119,10 @@ def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
     succs: List[List[int]] = [[] for _ in range(n)]
     edges: List[Tuple[int, int, str]] = []   # (producer, consumer, flow)
     # (consumer tid, flow) -> composed producer∘consumer ReshapeSpec
+    # (None recorded for spec-less edges so mixed spec/no-spec fan-ins
+    # are detectable; consumers treat stored-None as missing)
     edge_specs: Dict[Tuple[int, str], Any] = {}
+    _NO_SPEC = object()
     indeg = np.zeros(n, dtype=np.int64)
     for i, (tc, p) in enumerate(tasks):
         dry = Task(tp, tc, p)
@@ -132,8 +135,25 @@ def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
             j = tid[(ref.task_class.name, tuple(ref.locals))]
             succs[i].append(j)
             edges.append((i, j, ref.flow_name))
-            if ref.reshape_spec is not None:
-                edge_specs[(j, ref.flow_name)] = ref.reshape_spec
+            # conflicting per-(consumer, flow) reshape specs — including
+            # a reshaped edge mixed with an unreshaped one — would
+            # silently apply one edge's spec to every gathered operand;
+            # detect at plan time and direct such DAGs to the host
+            # runtime (which applies specs per edge)
+            prev = edge_specs.get((j, ref.flow_name), _NO_SPEC)
+            new_name = (ref.reshape_spec.name
+                        if ref.reshape_spec is not None else None)
+            if prev is not _NO_SPEC and \
+                    (prev.name if prev is not None else None) != new_name:
+                ctc, cp = tasks[j]
+                raise ValueError(
+                    f"task {ctc.name}{cp} flow {ref.flow_name!r} "
+                    f"receives conflicting reshape specs "
+                    f"({(prev.name if prev is not None else None)!r} vs "
+                    f"{new_name!r}) on different incoming edges; the "
+                    "compiled executors apply one spec per gathered "
+                    "flow — run this taskpool on the host runtime")
+            edge_specs[(j, ref.flow_name)] = ref.reshape_spec
             indeg[j] += 1
 
     # ---- Kahn leveling (batched in the C++ core when available)

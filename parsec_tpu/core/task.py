@@ -98,6 +98,16 @@ class Chore:
     # executor verifies this per group and falls back to vmap otherwise.
     batch_hook: Optional[Callable[..., Any]] = None
     batch_hook_shared: Optional[Sequence[str]] = None
+    # Hooks that are NOT batchable as-is (they read per-task metadata,
+    # e.g. DTD's woven argspec) can still opt into manager batching by
+    # providing BOTH of: ``batch_sig(task) -> hashable`` — an extra
+    # grouping key such that tasks with equal keys share one pure body —
+    # and ``batch_body(task) -> fn(*flow_values)`` — that pure body
+    # (UNJITTED; the device jits the vmapped wrapper). Used by
+    # dtd.insert_task(pure=True) so same-shape DTD tiles batch like
+    # PTG tasks do.
+    batch_sig: Optional[Callable[["Task"], Any]] = None
+    batch_body: Optional[Callable[["Task"], Callable[..., Any]]] = None
 
 
 _task_counter = itertools.count()

@@ -48,7 +48,9 @@ mca_param.register(
          "into one vmapped/batch_hook dispatch (the reference's "
          "progress_stream pipeline, device_cuda_module.c:1961-2097); "
          "0 = dispatch tasks synchronously from the worker threads "
-         "(faster through remote-tunnel backends — see module note)")
+         "(faster through remote-tunnel backends — see module note). "
+         "Assumes single-incarnation task classes: a chore returning "
+         "NEXT cannot fall through to a later incarnation here")
 
 
 class TPUDevice(Device):
@@ -105,18 +107,21 @@ class TPUDevice(Device):
         # Bodies that need task metadata (locals) opt out of the jit cache
         # by setting chore.batchable = False → called directly (they may
         # jit internally with locals as static args).
-        if not chore.batchable:
-            return self._run_hook(task, chore)
-        if int(mca_param.get("device.tpu.batch_dispatch", 0)):
+        if (chore.batchable or chore.batch_body is not None) and \
+                int(mca_param.get("device.tpu.batch_dispatch", 0)):
             # manager path (progress_stream analog): enqueue and return
             # ASYNC — the manager thread batches same-class ready tasks
             # into one vmapped dispatch and completes them; this device
-            # keeps its in-flight load unit until then
+            # keeps its in-flight load unit until then. Non-batchable
+            # hooks participate when they provide batch_sig/batch_body
+            # (DTD pure woven bodies).
             self._ensure_manager()
             with self._mgr_cv:
                 self._pending.append((task, chore))
                 self._mgr_cv.notify()
             return HookReturn.ASYNC
+        if not chore.batchable:
+            return self._run_hook(task, chore)
         return self._run_sync(task, chore)
 
     def _run_sync(self, task: Task, chore: Chore) -> HookReturn:
@@ -159,7 +164,11 @@ class TPUDevice(Device):
     def shutdown(self) -> None:
         """Stop the batching manager (Context.fini): signal, wake,
         join — a leaked manager would spin its condition-wait forever
-        and could complete tasks against a finalized context."""
+        and could complete tasks against a finalized context. Any tasks
+        still queued (fini on an abort path with work in flight) are
+        drained and their taskpools aborted so ASYNC waiters are
+        released instead of hanging on a completion that will never
+        come."""
         t = self._mgr_thread
         if t is None:
             return
@@ -168,6 +177,19 @@ class TPUDevice(Device):
             self._mgr_cv.notify()
         t.join(timeout=5.0)
         self._mgr_thread = None
+        with self._mgr_cv:
+            leftover = list(self._pending)
+            self._pending.clear()
+        if leftover:
+            warning("device", "%s manager shutdown with %d queued "
+                    "task(s); aborting their taskpools", self.name,
+                    len(leftover))
+            err = RuntimeError(
+                f"{self.name}: batching manager shut down with the "
+                "task still queued")
+            for (task, _chore) in leftover:
+                self.release_load()
+                task.taskpool.abort(err)
 
     def _context(self):
         reg = self.registry
@@ -217,7 +239,8 @@ class TPUDevice(Device):
         return True
 
     def _vmapped(self, tp_id, tc, chore: Chore, sig: Tuple, Bp: int,
-                 treedefs, use_hook: bool) -> Callable:
+                 treedefs, use_hook: bool, bsig=None,
+                 body_override: Callable = None) -> Callable:
         """Jitted batched dispatcher taking the batch as FLAT per-leaf
         arguments and stacking INSIDE the program — eager jnp.stack
         calls per batch are themselves slow dispatches on remote
@@ -230,11 +253,13 @@ class TPUDevice(Device):
         wide-solve reformulation is ~1 ms)."""
         # taskpool_id in the key (like _jitted): id(chore) of a
         # GC'd pool's chore can be reused and would silently serve the
-        # old pool's jitted body
-        key = (tp_id, tc.tc_id, id(chore), sig, Bp, use_hook)
+        # old pool's jitted body; bsig distinguishes woven-body variants
+        # of one batch_body chore (different value payloads/precision)
+        key = (tp_id, tc.tc_id, id(chore), bsig, sig, Bp, use_hook)
         fn = self._vmap_cache.get(key)
         if fn is None:
-            body = chore.batch_hook if use_hook else chore.hook
+            body = chore.batch_hook if use_hook else \
+                (body_override or chore.hook)
             mask = tuple(s is not None for s in sig)
             # READ-flow mask in non-CTL declaration order (batch_hook
             # receives only gathered READ flows, stacked)
@@ -245,8 +270,11 @@ class TPUDevice(Device):
             # (treedef, n_leaves) per non-None position, in order
             pos_info = [(td, td.num_leaves) for td in treedefs]
 
+            _is_override = body_override is not None
+
             def batched(*flat, _b=body, _mask=mask, _info=pos_info,
-                        _Bp=Bp, _rm=read_mask, _hook=use_hook):
+                        _Bp=Bp, _rm=read_mask, _hook=use_hook,
+                        _ovr=_is_override):
                 tu = self.jax.tree_util
                 jnp = self.jax.numpy
                 it = iter(flat)
@@ -270,6 +298,11 @@ class TPUDevice(Device):
                     return _b(*reads)
 
                 def one(*vals):
+                    if _ovr:
+                        # pure woven body: positional flow values only
+                        # (no task arg, no None placeholders — the
+                        # grouping refuses None-valued flows)
+                        return _b(*vals)
                     it2 = iter(vals)
                     args = [next(it2) if m else None for m in _mask]
                     return _b(None, *args)
@@ -285,15 +318,29 @@ class TPUDevice(Device):
         """Dispatch one same-signature group as a single vmapped call
         and complete every task (ASYNC contract: release_load + context
         complete_task per task). ``entries``: (task, chore, values,
-        sig) tuples — values/sig computed once at grouping time."""
+        sig, bsig) tuples — values/sig computed once at grouping
+        time."""
         ctx = self._context()
-        group = [(t, c) for (t, c, _v, _s) in entries]
+        group = [(t, c) for (t, c, _v, _s, _b) in entries]
         (t0_, chore) = group[0]
         tc = t0_.task_class
-        per_task = [v for (_t, _c, v, _s) in entries]
+        per_task = [v for (_t, _c, v, _s, _b) in entries]
         try:
             if len(group) == 1:
-                self._run_sync(t0_, chore)
+                # batch_body chores self-jit in their hook — _run_sync's
+                # jit wrapper would double-jit them
+                hr = self._run_sync(t0_, chore) if chore.batchable \
+                    else self._run_hook(t0_, chore)
+                # the manager cannot fall through to a later chore the
+                # way Context._execute_task does (batch_dispatch assumes
+                # single-incarnation task classes — see the knob help):
+                # surface a non-DONE return instead of silently
+                # completing with stale/no outputs
+                if hr != HookReturn.DONE:
+                    raise RuntimeError(
+                        f"{tc.name}: singleton dispatch returned "
+                        f"{hr!r}; batch_dispatch supports only "
+                        "single-incarnation (DONE) task classes")
             else:
                 tu = self.jax.tree_util
                 sig = entries[0][3]
@@ -324,10 +371,15 @@ class TPUDevice(Device):
                                     leaf, self.jax_device)
                             flat.append(leaf)
                 use_hook = self._hook_ok(tc, chore, group)
+                bsig = entries[0][4]
+                body_override = chore.batch_body(t0_) \
+                    if (chore.batch_body is not None and not use_hook) \
+                    else None
                 with self.jax.default_device(self.jax_device):
                     res = self._vmapped(
                         t0_.taskpool.taskpool_id, tc, chore, sig, Bp,
-                        treedefs, use_hook)(*flat)
+                        treedefs, use_hook, bsig=bsig,
+                        body_override=body_override)(*flat)
                 outs_by_task = [
                     self._normalize(tc, self.jax.tree_util.tree_map(
                         lambda x, b=b: x[b], res))
@@ -395,12 +447,21 @@ class TPUDevice(Device):
             for (task, chore) in drained:
                 values = task.input_values()
                 sig = self._sig(values)
+                # batch_body chores additionally group by batch_sig
+                # (equal keys ⇒ identical woven bodies) and cannot
+                # batch None-valued flows (the woven call passes flow
+                # values positionally, no None placeholders)
+                bsig = None
+                if chore.batch_sig is not None:
+                    bsig = chore.batch_sig(task)
+                    if sig is not None and any(s is None for s in sig):
+                        sig = None
                 key = (task.taskpool.taskpool_id,
-                       task.task_class.tc_id, id(chore),
+                       task.task_class.tc_id, id(chore), bsig,
                        sig if sig is not None else ("solo", id(task)))
                 if key not in groups:
                     groups[key] = []
                     order.append(key)
-                groups[key].append((task, chore, values, sig))
+                groups[key].append((task, chore, values, sig, bsig))
             for key in order:
                 self._complete_batch(groups[key])

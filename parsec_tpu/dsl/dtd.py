@@ -267,13 +267,38 @@ class Taskpool(CoreTaskpool):
                         elif isinstance(payload, (int, float, str, bool,
                                                   type(None))):
                             parts.append(("value", payload))
-                        else:   # unhashable payload: identity-keyed
+                        else:
+                            # unhashable payload: identity-keyed. The
+                            # closure keeps the object alive (no id
+                            # reuse), but the payload's CONTENTS are
+                            # baked in at trace time — mutating an
+                            # array payload in place between inserts
+                            # would silently serve the stale compile.
+                            # Contract (insert_task docstring): ValueArg
+                            # payloads under pure=True are immutable.
                             parts.append(("value", id(payload)))
                     return tuple(parts)
 
+                def _make_woven(spec, _fn=fn):
+                    import jax.numpy as jnp
+
+                    def woven(*fv, _spec=tuple(spec)):
+                        args: List[Any] = []
+                        it = iter(fv)
+                        for (kind, payload) in _spec:
+                            if kind == "tile":
+                                args.append(next(it))
+                            elif kind == "value":
+                                args.append(payload)
+                            else:
+                                args.append(jnp.zeros(
+                                    payload[0], dtype=payload[1]))
+                        return _fn(*args)
+
+                    return woven
+
                 def _hook(task: Task, *flow_vals, _fn=fn):
                     import jax
-                    import jax.numpy as jnp
                     from ..ops.tile_kernels import matmul_precision
                     spec = task.dsl["argspec"]
                     # the MXU precision knob is read at TRACE time by
@@ -289,22 +314,24 @@ class Taskpool(CoreTaskpool):
                     with jit_lock:
                         jf = jit_cache.get(skey)
                         if jf is None:
-                            def woven(*fv, _spec=tuple(spec)):
-                                args: List[Any] = []
-                                it = iter(fv)
-                                for (kind, payload) in _spec:
-                                    if kind == "tile":
-                                        args.append(next(it))
-                                    elif kind == "value":
-                                        args.append(payload)
-                                    else:
-                                        args.append(jnp.zeros(
-                                            payload[0], dtype=payload[1]))
-                                return _fn(*args)
-
-                            jf = jax.jit(woven)
+                            jf = jax.jit(_make_woven(spec))
                             jit_cache[skey] = jf
                     return jf(*flow_vals)
+
+                # manager batching (device.tpu.batch_dispatch): tasks
+                # whose woven bodies are identical — same argspec
+                # signature at the same precision — may be vmapped into
+                # one dispatch even though the hook itself reads
+                # per-task metadata
+                def _batch_sig(task: Task):
+                    # fn identity is already in the manager's group key
+                    # via id(chore)
+                    from ..ops.tile_kernels import matmul_precision
+                    return (_spec_key(task.dsl["argspec"]),
+                            matmul_precision())
+
+                def _batch_body(task: Task):
+                    return _make_woven(task.dsl["argspec"])
             else:
                 def _hook(task: Task, *flow_vals, _fn=fn):
                     args: List[Any] = []
@@ -319,7 +346,16 @@ class Taskpool(CoreTaskpool):
                                                  dtype=payload[1]))
                     return _fn(*args)
 
-            tc.add_chore(Chore(device, _hook, batchable=False))
+            if pure:
+                # batchable=False: the hook self-jits (the device's
+                # _run_sync wrapper would double-jit); batch_sig/
+                # batch_body let the batching manager vmap same-woven
+                # groups anyway
+                tc.add_chore(Chore(device, _hook, batchable=False,
+                                   batch_sig=_batch_sig,
+                                   batch_body=_batch_body))
+            else:
+                tc.add_chore(Chore(device, _hook, batchable=False))
             self.add_task_class(tc)
             self._classes[key] = tc
             return tc
@@ -352,7 +388,11 @@ class Taskpool(CoreTaskpool):
         ``pure=True`` declares ``fn`` a pure function of its arguments:
         the body is jitted (per arg-shape/value signature) so device
         dispatch is asynchronous — the performance path for tile math
-        (side-effecting Python bodies must keep the default)."""
+        (side-effecting Python bodies must keep the default). Non-scalar
+        ``ValueArg`` payloads are baked into the compiled body at trace
+        time and cached by object identity, so they must be treated as
+        IMMUTABLE once inserted — mutating an array payload in place
+        between inserts would silently serve the stale compile."""
         if self.error is not None:
             raise RuntimeError(
                 f"taskpool {self.name} aborted: {self.error}") from self.error

@@ -12,7 +12,7 @@ from . import pins
 from .pins import PinsManager, PinsEvent
 from . import pins_modules
 from .pins_modules import TaskProfiler, PrintSteals, Alperf, \
-    IteratorsChecker, new_module, install_selected
+    Counters, IteratorsChecker, new_module, install_selected
 from .trace import Trace
 from .grapher import Grapher
 from .ptg_to_dtd import replay_ptg_through_dtd

@@ -5,9 +5,11 @@ Reference: parsec/mca/pins/ — modules hook the runtime's callback chains
 (writes task begin/end to the trace), print_steals (per-stream steal
 counters), alperf (per-class activity/performance), iterators_checker
 (runtime sanity of successor iterators) and papi (hardware counters —
-no analog here; the SDE-style software counters live in
-profiling.sde). Modules are selected MCA-style via the ``pins`` param
-(comma-separated names) and installed at context init.
+analog here: the ``counters`` module below, rusage-backed since this
+environment has no PAPI and no portable TPU hardware counters; the
+SDE-style software counters live in profiling.sde). Modules are
+selected MCA-style via the ``pins`` param (comma-separated names) and
+installed at context init.
 """
 
 from __future__ import annotations
@@ -24,7 +26,7 @@ from ..utils.debug import debug_verbose
 mca_param.register("pins", "",
                    help="comma-separated PINS modules to install at init "
                         "(task_profiler, print_steals, alperf, "
-                        "iterators_checker)")
+                        "iterators_checker, counters)")
 
 
 class PinsModule:
@@ -210,11 +212,85 @@ class IteratorsChecker(PinsModule):
         return {"tasks_checked": self.checked}
 
 
+class Counters(PinsModule):
+    """mca/pins/papi analog (pins_papi.c:1-592): read a counter set at
+    EXEC begin/end per execution stream and accumulate the deltas per
+    task class. This environment exposes no PAPI and no portable TPU
+    hardware counters (PARITY.md N/A table), so the counter source is
+    ``resource.getrusage(RUSAGE_THREAD)`` — per-thread CPU time, page
+    faults and context switches — plus the monotonic clock. The
+    frame structure matches the reference module: sample at begin,
+    delta at end, aggregate per (class, counter)."""
+
+    name = "counters"
+
+    #: counter name -> rusage attribute
+    _FIELDS = {
+        "utime_s": "ru_utime",
+        "stime_s": "ru_stime",
+        "minflt": "ru_minflt",
+        "majflt": "ru_majflt",
+        "nvcsw": "ru_nvcsw",
+        "nivcsw": "ru_nivcsw",
+    }
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._begin: Dict[int, tuple] = {}      # task id -> sample
+        self.totals: Dict[str, Dict[str, float]] = defaultdict(
+            lambda: defaultdict(float))
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _sample():
+        import resource
+        who = getattr(resource, "RUSAGE_THREAD", resource.RUSAGE_SELF)
+        return (resource.getrusage(who), time.perf_counter(),
+                threading.get_ident())
+
+    def install(self, context) -> "Counters":
+        super().install(context)
+        self._sub(PinsEvent.EXEC_BEGIN, self._exec_begin)
+        self._sub(PinsEvent.EXEC_END, self._exec_end)
+        return self
+
+    def _exec_begin(self, es, task) -> None:
+        self._begin[id(task)] = self._sample()
+
+    def _exec_end(self, es, task) -> None:
+        b = self._begin.pop(id(task), None)
+        if b is None:
+            return
+        (ru0, t0, tid0), (ru1, t1, tid1) = b, self._sample()
+        key = task.task_class.name
+        with self._lock:
+            tot = self.totals[key]
+            tot["tasks"] += 1
+            tot["wall_s"] += t1 - t0
+            if tid0 != tid1:
+                # ASYNC completion (e.g. the batching manager): END
+                # fires on a different thread, so a RUSAGE_THREAD delta
+                # would subtract one thread's counters from another's.
+                # Only wall time is cross-thread meaningful.
+                tot["async_tasks"] += 1
+                return
+            for cname, attr in self._FIELDS.items():
+                # ru_utime/ru_stime are float seconds in Python's
+                # resource module; the rest are ints
+                tot[cname] += float(getattr(ru1, attr) -
+                                    getattr(ru0, attr))
+
+    def report(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self.totals.items()}
+
+
 _MODULES = {
     "task_profiler": TaskProfiler,
     "print_steals": PrintSteals,
     "alperf": Alperf,
     "iterators_checker": IteratorsChecker,
+    "counters": Counters,
 }
 
 

@@ -167,3 +167,92 @@ def test_batch_dispatch_uses_batch_hook(rng):
             np.asarray(store.data_of(("c", i))) @ L.T, rtol=1e-5,
             atol=1e-5)
     assert calls["hook"] >= 1, "batch_hook never engaged"
+
+
+# ---- batching manager under 2-rank distribution (VERDICT r3 #8) --------
+# Reference bar: the CUDA manager thread under MPI
+# (device_cuda_module.c:2573-2589 + distributed DTD tests) — both ranks
+# must batch-dispatch their local DTD GEMM tiles while values cross the
+# socket wire.
+
+def _mgr_dist_child(rank, nb_ranks, base_port, q):
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as _np
+        from parsec_tpu.comm.socket_engine import SocketCommEngine
+        from parsec_tpu.core import context as ctx_mod
+        from parsec_tpu import dtd as _dtd
+        from parsec_tpu.algorithms import insert_gemm_dtd as _ins
+        from parsec_tpu.data.matrix import TiledMatrix as _TM, \
+            TwoDimBlockCyclic
+        from parsec_tpu.utils import mca_param
+
+        mca_param.set("device.tpu.max_devices", 1)  # one manager/rank
+        mca_param.set("device.tpu.batch_dispatch", 1)
+        engine = SocketCommEngine(rank, nb_ranks, base_port=base_port)
+        ctx = ctx_mod.init(nb_cores=2, comm=engine)
+        ctx.start()
+        rng = _np.random.default_rng(0)            # same data all ranks
+        m, kdim, nb = 256, 64, 64
+        A_h = rng.standard_normal((m, kdim)).astype(_np.float32)
+        B_h = rng.standard_normal((kdim, m)).astype(_np.float32)
+        C_h = rng.standard_normal((m, m)).astype(_np.float32)
+        dist = TwoDimBlockCyclic(nb_ranks, 1)
+        A = _TM.from_array(A_h, nb, nb, dist=dist, myrank=rank, name="A")
+        B = _TM.from_array(B_h, nb, nb, dist=dist, myrank=rank, name="B")
+        C = _TM.from_array(C_h.copy(), nb, nb, dist=dist, myrank=rank,
+                           name="C")
+        tp = _dtd.Taskpool("mgr_gemm")
+        ctx.add_taskpool(tp)
+        _ins(tp, A, B, C)          # kdim/nb = 1: independent GEMM tasks
+        tp.wait()
+        tp.flush(C)
+        ref = C_h + A_h @ B_h
+        for (i, j) in list(C.local_keys()):
+            _np.testing.assert_allclose(
+                _np.asarray(C.data_of((i, j))),
+                ref[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb],
+                rtol=1e-4, atol=1e-4)
+        stats = [d.dump_statistics() for d in ctx.devices.devices
+                 if d.name.startswith("tpu")]
+        engine.sync()
+        ctx.fini()
+        q.put((rank, "ok",
+               {"batches": sum(s.get("batches", 0) for s in stats),
+                "batched": sum(s.get("batched_tasks", 0)
+                               for s in stats)}))
+    except BaseException as exc:  # noqa: BLE001 — report to parent
+        import traceback
+        q.put((rank, "error", f"{exc}\n{traceback.format_exc()}"))
+
+
+def test_batch_dispatch_manager_2rank_socket():
+    """Both ranks run the per-device batching manager while DTD GEMM
+    values cross the socket wire: results correct on every rank's local
+    tiles AND each rank registered at least one multi-task batch."""
+    import multiprocessing as mp
+    from parsec_tpu.comm.pingpong import _free_port_base
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    base_port = _free_port_base(2)
+    procs = [ctx.Process(target=_mgr_dist_child, args=(r, 2, base_port, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(2):
+            rank, status, payload = q.get(timeout=180)
+            if status != "ok":
+                raise AssertionError(f"rank {rank} failed:\n{payload}")
+            results[rank] = payload
+    finally:
+        for p in procs:
+            p.join(timeout=10.0)
+            if p.is_alive():
+                p.terminate()
+    for rank, r in results.items():
+        assert r["batches"] >= 1, (rank, r)
+        assert r["batched"] >= 2, (rank, r)

@@ -87,4 +87,4 @@ def test_trsm_hook_knob_switches_kernel():
                    np.linalg.norm(A_host))
             assert err < 1e-4, (hook, err)
         finally:
-            mca_param.set("potrf.trsm_hook", "gemm")
+            mca_param.unset("potrf.trsm_hook")

@@ -50,6 +50,23 @@ def test_alperf_counts_per_class(ctx):
     mod.uninstall()
 
 
+def test_counters_accumulates_rusage_deltas(ctx):
+    """papi-analog counters module: per-class rusage/wall deltas at
+    EXEC begin/end (pins_papi.c contract: sample, delta, aggregate)."""
+    from parsec_tpu.profiling import Counters
+    mod = Counters().install(ctx)
+    store = LocalCollection("S", {("x",): 0})
+    ctx.add_taskpool(_chain_tp(12, store))
+    assert ctx.wait(timeout=30)
+    rep = mod.report()
+    assert rep["T"]["tasks"] == 12
+    assert rep["T"]["wall_s"] >= 0.0
+    for field in ("utime_s", "stime_s", "minflt", "majflt",
+                  "nvcsw", "nivcsw"):
+        assert field in rep["T"]
+    mod.uninstall()
+
+
 def test_task_profiler_traces_tasks(ctx):
     mod = TaskProfiler().install(ctx)
     store = LocalCollection("S", {("x",): 0})
