@@ -606,6 +606,9 @@ def _section_ooc():
     from parsec_tpu.data.matrix import TiledMatrix
     from parsec_tpu.device.hbm import HBMManager
 
+    # benchmark fast path (library default = exact solves) — keeps this
+    # section comparable with its round-3 capture
+    mca_param.set("potrf.trsm_hook", "gemm")
     on_tpu = jax.default_backend() == "tpu"
     rng = np.random.default_rng(0)
     no, nbo, budget_mb = (8192, 1024, 128) if on_tpu else (512, 128, 1)

@@ -15,7 +15,10 @@ SURVEY §4; DCN between TPU hosts is the production transport this models):
 - full-mesh wireup: rank r listens on ``base_port + r``; higher ranks
   connect to lower ranks and identify themselves;
 - ONE comm thread per rank owns every socket (funnelled); worker threads
-  only enqueue commands;
+  only enqueue commands. ``comm.thread_multiple=1`` is the
+  MPI_THREAD_MULTIPLE analog (parsec_param_comm_thread_multiple): worker
+  threads write frames to the peer socket directly under per-peer send
+  locks, receives/handlers stay on the comm thread;
 - per-peer aggregation: all ACTIVATE commands drained in one progress
   iteration and bound for the same peer ship as one frame, ordered by
   priority (remote_dep_mpi.c:1089-1139);
@@ -54,6 +57,16 @@ mca_param.register("comm.stage_recv", "auto",
                         "only) | 1 | 0")
 mca_param.register("comm.wireup_timeout_s", 30.0,
                    help="seconds to wait for the full mesh to connect")
+mca_param.register("comm.thread_multiple", 0,
+                   help="MPI_THREAD_MULTIPLE analog (parsec_param_comm_"
+                        "thread_multiple, remote_dep.h:166): worker "
+                        "threads write frames to the peer socket "
+                        "directly (per-peer send locks keep the byte "
+                        "stream framed) instead of funnelling through "
+                        "the comm-thread command queue; receives and AM "
+                        "handlers stay on the comm thread. Direct sends "
+                        "skip per-peer activation aggregation. "
+                        "0 = funnelled (the reference default)")
 
 _HDR = struct.Struct("!Q")     # frame length prefix
 _U32 = struct.Struct("!I")     # pickle-section length prefix
@@ -82,7 +95,12 @@ class SocketCommEngine(CommEngine):
         self.base_port = base_port
         self._socks: Dict[int, socket.socket] = {}
         self._rxbuf: Dict[int, bytearray] = {}
-        self._txbuf: Dict[int, bytearray] = {}   # comm-thread-only
+        self._txbuf: Dict[int, bytearray] = {}   # guarded by _send_locks
+        # per-peer send locks: the comm thread and (under
+        # comm.thread_multiple) worker threads serialize frame writes so
+        # the byte stream never interleaves mid-frame
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._stats_lock = threading.Lock()
         self._cmd_q: "queue.Queue[Tuple]" = queue.Queue()
         self._mem: Dict[int, Any] = {}
         self._mem_next = 0
@@ -178,6 +196,7 @@ class SocketCommEngine(CommEngine):
         self._socks[peer] = s
         self._rxbuf[peer] = bytearray()
         self._txbuf[peer] = bytearray()
+        self._send_locks[peer] = threading.Lock()
 
     # ----------------------------------------------------------- lifecycle
     def enable(self) -> None:
@@ -246,6 +265,7 @@ class SocketCommEngine(CommEngine):
         sockets. Each iteration drains the command queue (with per-peer
         aggregation) then progresses receives."""
         from ..utils import binding
+        self._comm_tid = threading.get_ident()
         binding.bind_comm_thread()        # remote_dep_bind_thread analog
         while not self._stop.is_set():
             queued = self._drain_commands()
@@ -304,49 +324,105 @@ class SocketCommEngine(CommEngine):
             self._send_frame(dst, tag, msg)
         return n
 
-    def _send_frame(self, dst: int, tag: int, msg: Any) -> None:
-        """Queue one frame on the peer's outbound buffer (comm thread
-        only). Non-blocking sends prevent the head-of-line deadlock of two
-        ranks pushing large frames at each other with full TCP buffers.
-
-        Wire format (raw-bytes framing for array payloads — the
-        reference's datatype pack path, parsec_comm_engine.h:113-183):
-        ``!Q total_len``, ``!I pickle_len``, the protocol-5 pickle, then
-        each out-of-band buffer as ``!Q len`` + raw bytes. Contiguous
-        numpy array payloads travel as raw memory (one memcpy into the
-        tx buffer) instead of being re-serialized through the pickle
-        stream."""
+    def _encode_frame(self, tag: int, msg: Any) -> bytearray:
+        """Serialize one frame. Wire format (raw-bytes framing for array
+        payloads — the reference's datatype pack path,
+        parsec_comm_engine.h:113-183): ``!Q total_len``, ``!I
+        pickle_len``, the protocol-5 pickle, then each out-of-band
+        buffer as ``!Q len`` + raw bytes. Contiguous numpy array
+        payloads travel as raw memory (one memcpy into the tx buffer)
+        instead of being re-serialized through the pickle stream."""
         bufs: List[pickle.PickleBuffer] = []
         payload = pickle.dumps((int(tag), self.rank, msg),
                                protocol=5, buffer_callback=bufs.append)
         raws = [b.raw() for b in bufs]
         total = _U32.size + len(payload) + sum(
             _HDR.size + r.nbytes for r in raws)
-        out = self._txbuf[dst]
+        out = bytearray()
         out += _HDR.pack(total)
         out += _U32.pack(len(payload))
         out += payload
         for r in raws:
             out += _HDR.pack(r.nbytes)
             out += r
-        self._stats["frames_sent"] += 1
-        self._stats["bytes_sent"] += _HDR.size + total
+        return out
+
+    def _count_sent(self, frame_bytes: int) -> None:
+        with self._stats_lock:
+            self._stats["frames_sent"] += 1
+            self._stats["bytes_sent"] += frame_bytes
+
+    def _send_frame(self, dst: int, tag: int, msg: Any) -> None:
+        """Queue one frame on the peer's outbound buffer (comm thread).
+        Non-blocking sends prevent the head-of-line deadlock of two
+        ranks pushing large frames at each other with full TCP
+        buffers."""
+        frame = self._encode_frame(tag, msg)
+        with self._send_locks[dst]:
+            self._txbuf[dst] += frame
+        self._count_sent(len(frame))
+
+    def _direct_send(self, dst: int, tag: int, msg: Any) -> None:
+        """comm.thread_multiple send path: write the frame to the peer
+        socket from the CALLING thread. The per-peer lock keeps frames
+        whole; any bytes already queued for the comm thread go first
+        (stream order). Blocking here is safe — the comm thread keeps
+        draining receives, so the peer's TCP buffer empties."""
+        frame = self._encode_frame(tag, msg)
+        nbytes = len(frame)
+        lock = self._send_locks[dst]
+        s = self._socks[dst]
+        queued = False
+        with lock:
+            pending = self._txbuf[dst]
+            if pending:
+                pending += frame      # keep ordering behind queued bytes
+                queued = True
+            else:
+                view = memoryview(frame)
+                while view.nbytes:
+                    try:
+                        n = s.send(view)
+                        view = view[n:]
+                    except BlockingIOError:
+                        import select as _select
+                        _select.select([], [s], [], 0.05)
+                    except OSError as exc:
+                        # peer gone: degrade like the funnelled path
+                        # (workers must survive a crashed rank; termdet
+                        # surfaces the failure)
+                        warning("comm", "rank %d: direct send to %d "
+                                "failed: %s", self.rank, dst, exc)
+                        break
+        self._count_sent(nbytes)
+        if queued:                    # kick the comm thread to flush
+            try:
+                self._wake_w.send(b"x")
+            except (BlockingIOError, OSError):
+                pass
 
     def _flush_sends(self) -> int:
-        """Push queued outbound bytes as far as the kernel accepts."""
+        """Push queued outbound bytes as far as the kernel accepts.
+        Per-peer try-lock: under comm.thread_multiple a worker may be
+        mid-direct-send; skipping the peer this iteration is cheaper
+        than stalling the receive loop."""
         n = 0
         for dst, buf in self._txbuf.items():
             if not buf:
                 continue
+            lock = self._send_locks[dst]
+            if not lock.acquire(blocking=False):
+                continue
             try:
-                sent = self._socks[dst].send(buf)
-            except BlockingIOError:
-                continue
-            except OSError:
-                continue
-            if sent:
-                del buf[:sent]
-                n += sent
+                try:
+                    sent = self._socks[dst].send(buf)
+                except (BlockingIOError, OSError):
+                    continue
+                if sent:
+                    del buf[:sent]
+                    n += sent
+            finally:
+                lock.release()
         return n
 
     def _progress_recv(self, block_s: float) -> int:
@@ -427,6 +503,16 @@ class SocketCommEngine(CommEngine):
                 f"rank {self.rank} AM handler tag={tag} raised")
 
     # ------------------------------------------------------------ send API
+    def _thread_multiple(self) -> bool:
+        # Never take the direct (potentially blocking) path FROM the
+        # comm thread itself: an AM handler blocking in a send while
+        # the peer does the same would deadlock both receive loops —
+        # exactly the head-of-line hazard the non-blocking txbuf design
+        # exists to prevent. Handler-originated sends stay funnelled.
+        return self._thread is not None and \
+            threading.get_ident() != getattr(self, "_comm_tid", None) and \
+            bool(int(mca_param.get("comm.thread_multiple", 0)))
+
     def send_am(self, tag: int, dst_rank: int, msg: Any) -> None:
         if dst_rank == self.rank:
             # self-sends are queued too, so EVERY handler runs on the comm
@@ -436,6 +522,9 @@ class SocketCommEngine(CommEngine):
                 self._post_cmd(("self", tag, msg))
             else:
                 self._dispatch(tag, self.rank, msg)
+            return
+        if self._thread_multiple():
+            self._direct_send(dst_rank, tag, msg)
             return
         self._post_cmd(("am", tag, dst_rank, msg))
 
@@ -546,7 +635,13 @@ class SocketCommEngine(CommEngine):
         else:
             msg["value"] = value
         self.record_msg("sent", "activate", target_rank, nbytes)
-        self._post_cmd(("activate", target_rank, msg))
+        if target_rank != self.rank and self._thread_multiple():
+            # THREAD_MULTIPLE: the worker ships the activation itself
+            # (one [msg] frame — direct sends skip per-peer aggregation,
+            # like the reference's non-funnelled path)
+            self._direct_send(target_rank, AMTag.ACTIVATE, [msg])
+        else:
+            self._post_cmd(("activate", target_rank, msg))
         monitor.outgoing_message_end(target_rank)
 
     def install_activate_handler(self, context) -> None:

@@ -176,6 +176,14 @@ class TPUDevice(Device):
             self._mgr_stop = True
             self._mgr_cv.notify()
         t.join(timeout=5.0)
+        if t.is_alive():
+            # stuck mid-batch (e.g. a minutes-long remote compile):
+            # keep the thread reference so a later execute() cannot
+            # spawn a SECOND manager racing this one on _pending, and
+            # leave _pending for the live manager to drain
+            warning("device", "%s manager did not stop within 5 s; "
+                    "leaving it flagged to stop", self.name)
+            return
         self._mgr_thread = None
         with self._mgr_cv:
             leftover = list(self._pending)

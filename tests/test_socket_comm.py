@@ -516,3 +516,38 @@ def test_stage_recv_value_gating():
         assert out is arr
     finally:
         mca_param.unset("comm.stage_recv")
+
+
+# ---- comm.thread_multiple (MPI_THREAD_MULTIPLE analog) ------------------
+
+def scenario_chain_thread_multiple(ctx, engine, rank, nb_ranks,
+                                   n_steps=12):
+    """Same cross-rank chain, but worker threads send frames directly
+    (per-peer send locks) instead of funnelling through the comm
+    thread's command queue."""
+    from parsec_tpu.utils import mca_param
+    mca_param.set("comm.thread_multiple", 1)
+    try:
+        return scenario_chain(ctx, engine, rank, nb_ranks,
+                              n_steps=n_steps)
+    finally:
+        mca_param.unset("comm.thread_multiple")
+
+
+def scenario_potrf_thread_multiple(ctx, engine, rank, nb_ranks):
+    from parsec_tpu.utils import mca_param
+    mca_param.set("comm.thread_multiple", 1)
+    try:
+        return scenario_potrf(ctx, engine, rank, nb_ranks)
+    finally:
+        mca_param.unset("comm.thread_multiple")
+
+
+def test_chain_2ranks_thread_multiple():
+    res = _run_ranks("scenario_chain_thread_multiple", 2)
+    assert len(res) == 2
+
+
+def test_potrf_2ranks_thread_multiple():
+    res = _run_ranks("scenario_potrf_thread_multiple", 2)
+    assert len(res) == 2
