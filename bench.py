@@ -160,6 +160,14 @@ def _measure_peak_gemm(n=8192, dtype="float32", iters=64, latency_s=0.0):
     return 2.0 * n ** 3 / sorted(ts)[1] / 1e9
 
 
+# peak-proxy chain length: 192 x ~6.5 ms = ~1.25 s timed region. At the
+# round-1..3 value of 64 the ~0.4 s region left the subtracted link
+# latency (~110 ms, drifting +-50) able to swing the proxy +-12% — run 1
+# of round 4 measured 173 TF/s against the usual 155-168, flipping
+# vs_baseline red with an unchanged flagship. Longer region, same method.
+_PEAK_ITERS = 192
+
+
 def _measure_latency(device_row: bool = False):
     """BASELINE's second metric: p50 activate→data latency over the
     socket comm engine. ``device_row=False`` → the eager + rendezvous
@@ -204,10 +212,22 @@ def _measure_latency(device_row: bool = False):
                     # double-count a full link roundtrip here
                     jax.block_until_ready(y_d)
                     h2d_s.append(time.perf_counter() - t0)
-                link_us = (sorted(d2h_s)[3] + sorted(h2d_s)[3]) * 1e6
+                d2h_us = sorted(d2h_s)[3] * 1e6
+                h2d_us = sorted(h2d_s)[3] * 1e6
+                link_us = d2h_us + h2d_us
+                out["device_64k_d2h_us"] = round(d2h_us, 1)
+                out["device_64k_h2d_us"] = round(h2d_us, 1)
                 out["device_64k_link_us"] = round(link_us, 1)
                 out["device_64k_runtime_us"] = round(
                     max(r["p50_us"] - link_us, 0.0), 1)
+                if link_us >= r["p50_us"]:
+                    # each raw transfer above pays its own blocking
+                    # roundtrip; the hop pipeline overlaps part of that,
+                    # so the sum can exceed the hop p50 — the row then
+                    # reads "hop time fully accounted for by link cost"
+                    out["device_64k_split_note"] = (
+                        "link cost >= hop p50: runtime share ~0 (hop "
+                        "time is tunnel D2H/H2D, not runtime overhead)")
             except Exception as exc:  # noqa: BLE001
                 out["device_64k_split_error"] = str(exc)[:120]
             return out
@@ -800,6 +820,13 @@ def main():
     compile_s = time.perf_counter() - t0
     del out
 
+    # CH chained passes per sample: one pass is ~0.21 s, within reach of
+    # the drifting ~110+-50 ms link latency being subtracted; chaining
+    # re-runs the (donated, same-shape) program on its own output, which
+    # is numerically garbage but timing-valid — verified on-chip:
+    # chained per-pass within ~5% of single-pass, values stay finite
+    # (diag dominance), and separate executions cannot CSE
+    CH = 3 if backend == "tpu" else 1
     iters = 5
     samples, lats = [], []
     for i in range(iters):
@@ -808,8 +835,10 @@ def main():
         lat_i = _timed(lambda i=i: float(lat_f(jnp.float32(i))))
         t0 = time.perf_counter()
         tot, out = red(state)
+        for _ in range(CH - 1):
+            tot, out = red(out)
         float(tot)
-        samples.append(max(time.perf_counter() - t0 - lat_i, 1e-6))
+        samples.append(max(time.perf_counter() - t0 - lat_i, 1e-6) / CH)
         lats.append(lat_i)
         if i < iters - 1:
             del out          # keep HBM headroom for the next gen
@@ -863,7 +892,13 @@ def main():
     # bf16 noise: force full-precision dots inside the probe regardless
     # of the kernels' precision knob (without this the reported residual
     # floors at the probe's ~2-3e-3, masking e.g. the highest-precision
-    # variant's true ~1e-7)
+    # variant's true ~1e-7). The timed loop's final ``out`` is a
+    # CH-times-refactored garbage state — regenerate and run ONE clean
+    # pass for the checked factor (CH=1 already ends clean).
+    if CH > 1:
+        del out
+        tot, out = red(gen_j(jax.random.PRNGKey(0)))
+        float(tot)
     with jax.default_matmul_precision("highest"):
         err = float(jax.jit(residual)(out, jax.random.PRNGKey(0)))
     del out
@@ -969,7 +1004,7 @@ def main():
     lat_peak = sorted(_timed(lambda i=i: float(lat_f(jnp.float32(i))))
                       for i in range(3))[1]
     if backend == "tpu":
-        peak_proxy = _measure_peak_gemm(n=8192, iters=64,
+        peak_proxy = _measure_peak_gemm(n=8192, iters=_PEAK_ITERS,
                                         dtype="float32", latency_s=lat_peak)
     else:   # CPU smoke path: keep the proxy seconds-scale
         peak_proxy = _measure_peak_gemm(n=1024, iters=8,
