@@ -257,7 +257,6 @@ def _section_gemm():
     the flagship had degraded the process vs ~79% fresh). The
     host-runtime DTD row lives in its own section (it is the most
     dispatch-sensitive number of all)."""
-    import numpy as np
     import jax
     import jax.numpy as jnp
     from parsec_tpu.algorithms.gemm import build_gemm_ptg
@@ -267,7 +266,6 @@ def _section_gemm():
 
     on_tpu = jax.default_backend() == "tpu"
     probe = _make_lat_probe()
-    rng = np.random.default_rng(0)
     out = {}
 
     # panel-fused: one deep matmul per C pass (k-blocked fuser).
@@ -295,9 +293,12 @@ def _section_gemm():
             st["A"] = st["A"].at[:1, :].add(1e-30 * st["C"][:1, :])
         return st
 
-    st0 = {nm: jnp.asarray(
-        rng.standard_normal((g.nb * g.nt, g.mb * g.mt)), jnp.float32)
-        for nm, g in exp.geoms.items()}
+    # generate ON DEVICE: 3 host arrays at n=16384 are ~3 GB, which
+    # through the ~6 MB/s tunnel H2D dominates the whole section
+    key0 = jax.random.PRNGKey(0)
+    st0 = {nm: jax.random.normal(jax.random.fold_in(key0, i),
+                                 (g.nb * g.nt, g.mb * g.mt), jnp.float32)
+           for i, (nm, g) in enumerate(sorted(exp.geoms.items()))}
     mj = jax.jit(multi)
     t0 = time.perf_counter()
     o0 = mj(st0)
