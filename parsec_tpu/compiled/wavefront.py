@@ -30,12 +30,12 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..core.task import DeviceType, FlowAccess, Task
-from ..core.taskpool import DataRef, SuccessorRef
+from ..core.taskpool import DataRef
 from ..dsl.ptg import PTGTaskClass, Taskpool as PTGTaskpool
 from ..utils.debug import debug_verbose
 

@@ -551,3 +551,22 @@ def test_chain_2ranks_thread_multiple():
 def test_potrf_2ranks_thread_multiple():
     res = _run_ranks("scenario_potrf_thread_multiple", 2)
     assert len(res) == 2
+
+
+def scenario_rendezvous_thread_multiple(ctx, engine, rank, nb_ranks):
+    """Rendezvous GET/PUT with direct worker sends: the activation ships
+    from a worker thread (direct path) while the GET reply and PUT land
+    on the comm thread (which must stay funnelled — the comm-thread
+    identity guard — or the blocking PUT would deadlock the receive
+    loops)."""
+    from parsec_tpu.utils import mca_param
+    mca_param.set("comm.thread_multiple", 1)
+    try:
+        return scenario_rendezvous(ctx, engine, rank, nb_ranks)
+    finally:
+        mca_param.unset("comm.thread_multiple")
+
+
+def test_rendezvous_2ranks_thread_multiple():
+    res = _run_ranks("scenario_rendezvous_thread_multiple", 2)
+    assert len(res) == 2
