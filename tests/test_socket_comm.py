@@ -570,3 +570,41 @@ def scenario_rendezvous_thread_multiple(ctx, engine, rank, nb_ranks):
 def test_rendezvous_2ranks_thread_multiple():
     res = _run_ranks("scenario_rendezvous_thread_multiple", 2)
     assert len(res) == 2
+
+
+def scenario_getrf_left_2ranks(ctx, engine, rank, nb_ranks, n=192, nb=32):
+    """The left-looking LU taskpool multi-rank: UPDC/UPDR's gathered L/U
+    operands resolve remote tiles through the one-sided fetch service
+    (same pattern as potrf_left; no-pivot LU on a diagonally-dominant
+    input)."""
+    import scipy.linalg as sla
+    from parsec_tpu.algorithms.getrf import build_getrf_left
+    from parsec_tpu.data.matrix import TiledMatrix, TwoDimBlockCyclic
+
+    rng = np.random.default_rng(4)
+    A_host = (rng.standard_normal((n, n)) + 2.0 * n * np.eye(n)) \
+        .astype(np.float32)
+    dist = TwoDimBlockCyclic(P=nb_ranks, Q=1)
+    A = TiledMatrix.from_array(A_host.copy(), nb, nb, dist=dist,
+                               myrank=rank, name="A")
+    tp = build_getrf_left(A)
+    ctx.add_taskpool(tp)
+    ctx.start()
+    assert ctx.wait(timeout=90), \
+        f"rank {rank}: getrf_left did not terminate"
+    # no-pivot LU reference: diagonal dominance makes partial pivoting
+    # pick the diagonal, so scipy's P is the identity
+    P, L_ref, U_ref = sla.lu(A_host.astype(np.float64))
+    assert np.allclose(P, np.eye(n)), "reference pivoted unexpectedly"
+    packed_ref = np.tril(L_ref, -1) + U_ref
+    for (i, j) in A.local_keys():
+        tile = np.asarray(A.data_of((i, j)), dtype=np.float64)
+        ref = packed_ref[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb]
+        err = np.linalg.norm(tile - ref) / max(1e-30, np.linalg.norm(ref))
+        assert err < 1e-3, f"rank {rank} tile ({i},{j}) err {err}"
+    return len(list(A.local_keys()))
+
+
+def test_getrf_left_2ranks():
+    res = _run_ranks("scenario_getrf_left_2ranks", 2)
+    assert len(res) == 2
