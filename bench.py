@@ -718,21 +718,34 @@ def _run_section(name):
     else — never two TPU processes at once through the tunnel) and
     return its dict; failures become {"error": ...} rows under the
     section's canonical result keys instead of sinking the flagship
-    metric."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--section", name],
-            capture_output=True, text=True,
-            timeout=_SECTION_TIMEOUT.get(name, 1800), cwd=_HERE)
-        line = next((ln for ln in proc.stdout.splitlines()
-                     if ln.startswith("SECTION_RESULT ")), None)
-        if line is None:
-            raise RuntimeError(
-                f"section child rc={proc.returncode}: {proc.stderr[-300:]}")
-        return json.loads(line[len("SECTION_RESULT "):])
-    except Exception as exc:  # noqa: BLE001
-        err = str(exc)[:200]
-        return {k: {"error": err} for k in _SECTION_KEYS[name]}
+    metric. One retry: the tunnel's remote-compile service transiently
+    drops connections, and the official capture is a single run."""
+    last_err = "unknown"
+    for attempt in (0, 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--section", name],
+                capture_output=True, text=True,
+                timeout=_SECTION_TIMEOUT.get(name, 1800), cwd=_HERE)
+            line = next((ln for ln in proc.stdout.splitlines()
+                         if ln.startswith("SECTION_RESULT ")), None)
+            if line is None:
+                raise RuntimeError(
+                    f"section child rc={proc.returncode}: "
+                    f"{proc.stderr[-300:]}")
+            return json.loads(line[len("SECTION_RESULT "):])
+        except subprocess.TimeoutExpired as exc:
+            # a hung section already burned its full budget — an
+            # identical retry would double it and risk pushing the
+            # serialized capture past the driver's window
+            last_err = str(exc)[:200]
+            break
+        except Exception as exc:  # noqa: BLE001
+            last_err = str(exc)[:200]
+            if attempt == 0:
+                time.sleep(10)
+    return {k: {"error": last_err} for k in _SECTION_KEYS[name]}
 
 
 def _compact_summary(result):
