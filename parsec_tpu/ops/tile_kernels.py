@@ -115,6 +115,52 @@ def tri_inv_tile(L, base: int = 0):
     return rec(Lf).astype(L.dtype)
 
 
+def chol_inv_tile(A, base: int = 128):
+    """(L, L⁻¹) of an SPD tile in ONE recursion. Sharing the traversal
+    beats chol-then-invert two ways: the panel solve uses the already-
+    computed I11 as a matmul (L21 = A21·I11ᵀ) instead of a wide
+    triangular solve, and the inverse assembles from blocks the chol
+    recursion already has (I21 = −I22·L21·I11). Measured 5.9 vs 7.3
+    ms/step at nb=1024 on a v5e against separate potrf_tile_blocked +
+    tri_inv_tile — but that delta is inter-dispatch overhead: INSIDE
+    one fused XLA program the two forms run identically (105-107 TF/s
+    flagship both ways) and the fused program deserializes slower from
+    the persistent cache, so the panel fusers keep chol-then-invert.
+    Kept (tested) as the standalone-dispatch form of the pair."""
+    Af = jnp.asarray(A, jnp.float32)
+
+    def rec(T):
+        n = T.shape[0]
+        if n <= base or n % 2:
+            L = jnp.linalg.cholesky(T)
+            return L, jax.lax.linalg.triangular_solve(
+                L, jnp.eye(n, dtype=T.dtype), left_side=True, lower=True)
+        h = n // 2
+        L11, I11 = rec(T[:h, :h])
+        L21 = jnp.matmul(T[h:, :h], I11.T,
+                         preferred_element_type=jnp.float32,
+                         precision=_prec())
+        S = T[h:, h:] - jnp.matmul(L21, L21.T,
+                                   preferred_element_type=jnp.float32,
+                                   precision=_prec())
+        L22, I22 = rec(0.5 * (S + S.T))
+        I21 = -jnp.matmul(
+            I22, jnp.matmul(L21, I11, preferred_element_type=jnp.float32,
+                            precision=_prec()),
+            preferred_element_type=jnp.float32, precision=_prec())
+        Z = jnp.zeros((h, n - h), jnp.float32)
+        L = jnp.concatenate(
+            [jnp.concatenate([L11, Z], axis=1),
+             jnp.concatenate([L21, L22], axis=1)], axis=0)
+        Inv = jnp.concatenate(
+            [jnp.concatenate([I11, Z], axis=1),
+             jnp.concatenate([I21, I22], axis=1)], axis=0)
+        return L, Inv
+
+    L, Inv = rec(Af)
+    return L.astype(A.dtype), Inv.astype(A.dtype)
+
+
 def potrf_tile_blocked(A, base: int = 0):
     """Blocked right-looking in-tile Cholesky: factor a ``base``-sized
     diagonal block with the XLA cholesky, invert it (cheap at base size),

@@ -88,3 +88,15 @@ def test_trsm_hook_knob_switches_kernel():
             assert err < 1e-4, (hook, err)
         finally:
             mca_param.unset("potrf.trsm_hook")
+
+
+def test_chol_inv_tile_fused():
+    """Fused (L, L^-1) recursion matches chol + explicit inverse."""
+    from parsec_tpu.ops.tile_kernels import chol_inv_tile
+    n = 192
+    A = _spd(n)
+    L, I = chol_inv_tile(A, base=64)
+    L_ref = np.linalg.cholesky(A.astype(np.float64))
+    assert np.allclose(np.asarray(L), L_ref, atol=1e-3)
+    assert np.allclose(np.asarray(L) @ np.asarray(I), np.eye(n),
+                       atol=1e-2)
