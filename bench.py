@@ -22,13 +22,16 @@ final line): the FINAL printed line is a compact (< 2 KB) JSON summary
 The full detail blob is written to ``BENCH_DETAIL.json`` next to this
 file and also printed as an EARLIER line for log completeness.
 
-Measurement hygiene (axon-tunnel backend): in-process dispatch degrades
-~10x after large programs run, so every secondary config (GEMM, flash
-transformer, GEQRF, GETRF) is measured in its OWN fresh subprocess
-(``bench.py --section NAME``), serialized — never two TPU processes at
-once. The flagship runs first, in-process, on a fresh chip. Link
-roundtrip latency is sampled immediately before each timed run and
-subtracted; forcing is done with device-side scalar reductions.
+Measurement hygiene (axon-tunnel backend): the first float() device-get
+in a process flips subsequent per-task dispatch into a synchronous mode
+(measured ~20x on dispatch-bound rows; round 3 misattributed this to
+"large programs"), and in-process state degrades several in-jit rows
+too, so every secondary config (GEMM, flash transformer, GEQRF, GETRF)
+is measured in its OWN fresh subprocess (``bench.py --section NAME``),
+serialized — never two TPU processes at once. The flagship runs first,
+in-process, on a fresh chip. Link roundtrip latency is sampled
+immediately before each timed run and subtracted; forcing is done with
+device-side scalar reductions.
 """
 
 import json
@@ -245,9 +248,10 @@ def _measure_latency(device_row: bool = False):
 
 # ---------------------------------------------------------------------------
 # Sections: each runs in a FRESH subprocess (bench.py --section NAME) so the
-# number reflects a clean process — in-process dispatch degrades ~10x after
-# big programs on the remote backend (measured round 3: flash 31 TF/s stale
-# in-process vs 72-80 fresh; GEMM 75 vs ~123).
+# number reflects a clean process (round 3 measured flash and GEMM 2-2.5x
+# low late in the flagship's process; round 4 found the dispatch-bound
+# mechanism: the process's first float() device-get flips later per-task
+# dispatch into a synchronous mode).
 # ---------------------------------------------------------------------------
 
 def _section_gemm():
@@ -312,8 +316,8 @@ def _section_gemm():
     out["panel_fused_n"] = np_
     out["compile_s"] = round(compile_s, 2)
     out["note"] = ("measured in a fresh subprocess, panel row first "
-                   "(in-process dispatch degrades ~10x after large "
-                   "programs on this remote backend)")
+                   "(late-in-process measurement read this row ~2x low "
+                   "in round 3)")
 
     return {"dtd_gemm": out}
 
