@@ -209,3 +209,53 @@ def test_ptg_to_dtd_replay_potrf(ctx, rng):
 
     np.testing.assert_allclose(A_ptg.to_array(), A_dtd.to_array(),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_counters_async_completion_skips_rusage_deltas():
+    """Tasks completed from another thread (batching manager, ASYNC)
+    must not mix per-thread rusage across threads: counted as
+    async_tasks, wall time only."""
+    from parsec_tpu.profiling import Counters
+
+    mca_param.set("device.tpu.max_devices", 1)
+    mca_param.set("device.tpu.batch_dispatch", 1)
+    ctx = mod = None
+    try:
+        ctx = parsec.init(nb_cores=2)
+        mod = Counters().install(ctx)
+        ctx.start()
+        NT = 8
+        store = LocalCollection(
+            "S", {("x", i): np.full((8, 8), float(i), np.float32)
+                  for i in range(NT)} | {("y", i): None
+                                         for i in range(NT)})
+        tp = ptg.Taskpool("wide", N=NT, S=store)
+        tp.task_class(
+            "W", params=("i",),
+            space=lambda g: ((i,) for i in range(g.N)),
+            flows=[ptg.FlowSpec(
+                "X", ptg.RW,
+                ins=[ptg.In(data=lambda g, i: (g.S, ("x", i)))],
+                outs=[ptg.Out(data=lambda g, i: (g.S, ("y", i)))])])
+
+        @tp.task_class_by_name("W").body
+        def w_body(task, X):
+            import jax.numpy as jnp
+            return jnp.asarray(X) * 3.0
+
+        ctx.add_taskpool(tp)
+        assert ctx.wait(timeout=120)
+        rep = mod.report()["W"]
+        assert rep["tasks"] == NT
+        # every manager-completed task is flagged async (END fires on
+        # the manager thread) and contributes wall time but no
+        # cross-thread rusage delta
+        assert rep["async_tasks"] >= 1, rep
+        assert rep["wall_s"] > 0.0
+    finally:
+        if mod is not None:
+            mod.uninstall()
+        if ctx is not None:
+            parsec.fini(ctx)
+        mca_param.unset("device.tpu.max_devices")
+        mca_param.unset("device.tpu.batch_dispatch")

@@ -347,3 +347,51 @@ def test_reshape_write_then_later_read_refused():
                 outs=[ptg.Out(data=lambda g, i: (g.A, (0, 0)))])])
     with pytest.raises(NotImplementedError, match="reshape"):
         plan_taskpool(tp)
+
+
+def test_planner_rejects_conflicting_edge_specs():
+    """Round-4 guard (compiled path): a consumer flow whose incoming
+    edges carry DIFFERENT reshape specs must be rejected at plan time —
+    the compiled executors apply one spec per gathered flow, so silently
+    keeping one edge's spec would convert the other edge's value too.
+    Mixed reshaped/unreshaped fan-ins are equally rejected."""
+    from parsec_tpu.compiled.wavefront import plan_taskpool
+
+    for second_spec in (ReshapeSpec(fn=lambda v: v * 2, name="x2"), None):
+        A = TiledMatrix.from_array(
+            np.zeros((2, 1), np.float32), 1, 1, name="A")
+        tp = ptg.Taskpool("conflict", A=A)
+        # one producer class, two guarded Outs with different specs both
+        # targeting the SAME consumer instance+flow — the structural
+        # edge set carries two specs for (C(0,), "V")
+        P = tp.task_class(
+            "P", params=("i",), space=lambda g: ((0,), (1,)),
+            flows=[ptg.FlowSpec(
+                "X", ptg.RW,
+                tile=lambda g, i: (g.A, (i, 0)),
+                ins=[ptg.In(data=lambda g, i: (g.A, (i, 0)))],
+                outs=[ptg.Out(dst=("C", lambda g, i: (0,), "V"),
+                              guard=lambda g, i: i == 0,
+                              reshape=ReshapeSpec(fn=lambda v: v + 1,
+                                                  name="inc")),
+                      ptg.Out(dst=("C", lambda g, i: (0,), "V"),
+                              guard=lambda g, i: i == 1,
+                              reshape=second_spec)])])
+        C = tp.task_class(
+            "C", params=("j",), space=lambda g: ((0,),),
+            flows=[ptg.FlowSpec(
+                "V", ptg.RW,
+                tile=lambda g, j: (g.A, (0, 0)),
+                ins=[ptg.In(src=("P", lambda g, j: (0,), "X"))],
+                outs=[ptg.Out(data=lambda g, j: (g.A, (0, 0)))])])
+
+        @P.body
+        def pbody(task, X):
+            return X
+
+        @C.body
+        def cbody(task, V):
+            return V
+
+        with pytest.raises(ValueError, match="conflicting reshape"):
+            plan_taskpool(tp)
