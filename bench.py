@@ -752,6 +752,43 @@ def _run_section(name):
     return {k: {"error": last_err} for k in _SECTION_KEYS[name]}
 
 
+def _latency_regression_guard(latency: dict, threshold: float = 0.15):
+    """Round-5 drift guard (the eager p50 walked 537 → 687 µs across
+    rounds 2-4 with nothing pinning it): compare this run's latency p50s
+    against the newest ``BENCH_r*.json`` in the repo root and record a
+    ``latency_regression`` warning field when any worsens by more than
+    ``threshold``. Purely observational — the bench never fails on it."""
+    import glob
+    import re
+    try:
+        prior_files = sorted(
+            glob.glob(os.path.join(_HERE, "BENCH_r*.json")),
+            key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p))
+                              .group(1)))
+        if not prior_files:
+            return
+        with open(prior_files[-1]) as f:
+            txt = f.read()
+        # the driver file wraps our final JSON line inside its own
+        # record; the detail keys are unique enough to regex out
+        regressions = []
+        for key in ("eager_1k_p50_us", "rdv_1M_p50_us"):
+            cur = latency.get(key)
+            m = re.search(rf'\\?"{key}\\?":\s*([0-9.]+)', txt)
+            if cur is None or m is None:
+                continue
+            prev = float(m.group(1))
+            if prev > 0 and (cur - prev) / prev > threshold:
+                regressions.append(
+                    f"{key}: {prev:.1f} -> {cur:.1f} us "
+                    f"(+{(cur - prev) / prev * 100:.0f}%)")
+        if regressions:
+            latency["latency_regression"] = "; ".join(regressions) + \
+                f" vs {os.path.basename(prior_files[-1])}"
+    except Exception as exc:  # noqa: BLE001 — guard must never sink bench
+        latency["latency_regression_guard_error"] = str(exc)[:120]
+
+
 def _compact_summary(result):
     """The driver-facing final line: metric/value/unit/vs_baseline plus
     the key scalars, guaranteed < 2 KB (the driver tails ~4 KB of
@@ -791,6 +828,9 @@ def _compact_summary(result):
             "full_detail": "BENCH_DETAIL.json",
         },
     }
+    reg = d.get("latency", {}).get("latency_regression")
+    if reg:              # only when firing — the final line is size-capped
+        compact["detail"]["latency_regression"] = reg
     line = json.dumps(compact)
     if len(line) > 2000:          # belt-and-braces: shed detail, keep
         compact["detail"] = {"full_detail": "BENCH_DETAIL.json"}
@@ -957,6 +997,7 @@ def main():
     # process accumulates heavy TPU work — measured rdv_1M 3.9 ms here
     # vs ~180 ms after the extras
     latency = _measure_latency()
+    _latency_regression_guard(latency)
 
     # -- precision-knob variant: the SAME flagship taskpool/executor at
     # matmul_precision=highest (6-pass f32 MXU emulation) + exact

@@ -24,7 +24,9 @@ class AMTag(enum.IntEnum):
     DTD_CONTROL = 5
     BARRIER = 6
     TILE_FETCH = 7        # one-sided collection-tile GET (RMA analog)
-    FIRST_USER_TAG = 8
+    BYE = 8               # orderly-shutdown notice (MPI_Finalize analog):
+    #                       a peer closing WITHOUT it is a failure
+    FIRST_USER_TAG = 9
 
 MAX_REGISTERED_TAGS = 32     # PARSEC_MAX_REGISTERED_TAGS (parsec_comm_engine.h:24)
 
@@ -214,6 +216,13 @@ class CommEngine:
                 slots.append(("local", dc.data_of(key), key, owner))
                 continue
             fut = Future()
+            fut.owner = owner     # failure detection fails futures by peer
+            if not self.peer_alive(owner):
+                # a dead owner's frame would be dropped and the future
+                # never fulfilled — fail NOW instead of timing out
+                fut.set(("error", f"peer rank {owner} is dead"))
+                slots.append(("fut", (fut, None), key, owner))
+                continue
             with self._fetch_lock:
                 req = self._fetch_next
                 self._fetch_next += 1
@@ -222,6 +231,15 @@ class CommEngine:
             self.send_am(AMTag.TILE_FETCH, owner,
                          {"name": dc.name, "scope": scope,
                           "key": tuple(key), "req": req})
+            if not self.peer_alive(owner):
+                # peer died between the pre-check and the send: the
+                # engine's death sweep may have run before this future
+                # was registered — fail it here (pop guards against
+                # double-set by the sweep)
+                with self._fetch_lock:
+                    popped = self._fetch_futures.pop(req, None)
+                if popped is not None:
+                    popped.set(("error", f"peer rank {owner} is dead"))
             slots.append(("fut", (fut, req), key, owner))
         out = []
         try:
@@ -244,6 +262,11 @@ class CommEngine:
                 for req in reqs:
                     self._fetch_futures.pop(req, None)
         return out
+
+    def peer_alive(self, rank: int) -> bool:
+        """False once ``rank`` is known dead (failure detection).
+        Engines without failure detection report every peer alive."""
+        return True
 
     # -- progress ---------------------------------------------------------
     def progress(self) -> int:

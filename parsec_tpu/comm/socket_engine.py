@@ -107,6 +107,15 @@ class SocketCommEngine(CommEngine):
         self._mem_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # failure detection: the reference gets job-kill semantics from
+        # MPI's default error handler + parsec_abort (runtime.h:33-37);
+        # here a dead peer is detected at the socket (zero-byte recv /
+        # send error), recorded, and every dependent wait is failed
+        # instead of left to time out
+        self._dead_peers: set = set()
+        self._bye_peers: set = set()       # peers that announced shutdown
+        self._peer_failure: Optional[BaseException] = None
+        self._barrier_waiting = False
         self._listener: Optional[socket.socket] = None
         self._sel = selectors.DefaultSelector()
         self._context = None
@@ -122,6 +131,7 @@ class SocketCommEngine(CommEngine):
         self.tag_register(AMTag.BARRIER, self._on_barrier)
         self.tag_register(AMTag.TERMDET_FOURCOUNTER, self._on_termdet)
         self.tag_register(AMTag.TERMDET_USER_TRIGGER, self._on_trigger)
+        self.tag_register(AMTag.BYE, self._on_bye)
         # frame-level wire counters only; payload-level activation
         # counters live in the base ``stats`` dict (record_msg)
         self._stats = {"frames_sent": 0, "frames_recv": 0, "bytes_sent": 0,
@@ -226,6 +236,14 @@ class SocketCommEngine(CommEngine):
 
     def disable(self) -> None:
         super().disable()
+        if self._thread is not None and not self._stop.is_set():
+            # orderly goodbye (MPI_Finalize analog): peers seeing our
+            # FIN after this frame treat the close as shutdown, not
+            # failure. Queued before _stop so the comm thread's exit
+            # drain flushes it.
+            for peer in self._socks:
+                if peer != self.rank and peer not in self._dead_peers:
+                    self._post_cmd(("am", AMTag.BYE, peer, {}))
         self._stop.set()
         try:
             self._wake_w.send(b"x")   # kick the selector out of its block
@@ -292,7 +310,7 @@ class SocketCommEngine(CommEngine):
                 break
 
     def _drain_commands(self) -> int:
-        aggregate = bool(mca_param.get("comm.aggregate", True))
+        aggregate = bool(mca_param.cached_get("comm.aggregate", True))
         per_peer: Dict[int, List[Dict]] = {}
         other: List[Tuple] = []
         n = 0
@@ -311,6 +329,8 @@ class SocketCommEngine(CommEngine):
                 per_peer.setdefault(dst, []).append(msg)
             elif kind == "self":       # ("self", tag, msg)
                 self._dispatch(cmd[1], self.rank, cmd[2])
+            elif kind == "peer_dead":  # ("peer_dead", peer, why) — posted
+                self._mark_peer_dead(cmd[1], cmd[2])  # by worker threads
             else:                      # ("am", tag, dst, msg)
                 other.append(cmd)
         for dst, msgs in per_peer.items():
@@ -356,7 +376,13 @@ class SocketCommEngine(CommEngine):
         """Queue one frame on the peer's outbound buffer (comm thread).
         Non-blocking sends prevent the head-of-line deadlock of two
         ranks pushing large frames at each other with full TCP
-        buffers."""
+        buffers. The lock acquire is bounded: _direct_send never holds
+        the per-peer lock across a wait (it hands unsent remainders to
+        txbuf instead of select()-ing under the lock)."""
+        if dst in self._dead_peers:
+            debug_verbose(3, "comm", "rank %d: dropping frame for dead "
+                          "peer %d", self.rank, dst)
+            return
         frame = self._encode_frame(tag, msg)
         with self._send_locks[dst]:
             self._txbuf[dst] += frame
@@ -365,15 +391,24 @@ class SocketCommEngine(CommEngine):
     def _direct_send(self, dst: int, tag: int, msg: Any) -> None:
         """comm.thread_multiple send path: write the frame to the peer
         socket from the CALLING thread. The per-peer lock keeps frames
-        whole; any bytes already queued for the comm thread go first
-        (stream order). Blocking here is safe — the comm thread keeps
-        draining receives, so the peer's TCP buffer empties."""
+        whole and is NEVER held across a wait: when the kernel buffer
+        fills mid-frame the unsent remainder goes onto txbuf for the
+        comm thread (framing stays intact — txbuf bytes always precede
+        new frames). Waiting under the lock would stall the comm
+        thread's _send_frame/_flush_sends; with two ranks symmetrically
+        direct-sending large frames, both receive loops would stop
+        draining and the ranks deadlock."""
+        if dst in self._dead_peers:
+            return                # drop before paying the encode
         frame = self._encode_frame(tag, msg)
         nbytes = len(frame)
         lock = self._send_locks[dst]
-        s = self._socks[dst]
+        s = self._socks.get(dst)
         queued = False
+        failed: Optional[OSError] = None
         with lock:
+            if dst in self._dead_peers or s is None:
+                return            # drop, like the funnelled path
             pending = self._txbuf[dst]
             if pending:
                 pending += frame      # keep ordering behind queued bytes
@@ -385,17 +420,22 @@ class SocketCommEngine(CommEngine):
                         n = s.send(view)
                         view = view[n:]
                     except BlockingIOError:
-                        import select as _select
-                        _select.select([], [s], [], 0.05)
+                        pending += view
+                        queued = True
+                        break
                     except OSError as exc:
-                        # peer gone: degrade like the funnelled path
-                        # (workers must survive a crashed rank; termdet
-                        # surfaces the failure)
-                        warning("comm", "rank %d: direct send to %d "
-                                "failed: %s", self.rank, dst, exc)
+                        # mid-frame send failure: the byte stream to
+                        # this peer is desynchronized beyond repair —
+                        # tear the peer down (on the comm thread) so
+                        # later sends drop cleanly instead of framing
+                        # garbage after a partial frame
+                        failed = exc
                         break
         self._count_sent(nbytes)
-        if queued:                    # kick the comm thread to flush
+        if failed is not None:
+            self._post_cmd(("peer_dead", dst,
+                            f"direct send failed: {failed}"))
+        elif queued:                  # kick the comm thread to flush
             try:
                 self._wake_w.send(b"x")
             except (BlockingIOError, OSError):
@@ -407,8 +447,9 @@ class SocketCommEngine(CommEngine):
         mid-direct-send; skipping the peer this iteration is cheaper
         than stalling the receive loop."""
         n = 0
+        dead: List[Tuple[int, OSError]] = []
         for dst, buf in self._txbuf.items():
-            if not buf:
+            if not buf or dst in self._dead_peers:
                 continue
             lock = self._send_locks[dst]
             if not lock.acquire(blocking=False):
@@ -416,13 +457,21 @@ class SocketCommEngine(CommEngine):
             try:
                 try:
                     sent = self._socks[dst].send(buf)
-                except (BlockingIOError, OSError):
+                except BlockingIOError:
+                    continue
+                except OSError as exc:
+                    # broken pipe / reset: retrying forever would pin
+                    # these bytes and hide the failure — mark the peer
+                    # (outside the send lock: _mark_peer_dead takes it)
+                    dead.append((dst, exc))
                     continue
                 if sent:
                     del buf[:sent]
                     n += sent
             finally:
                 lock.release()
+        for dst, exc in dead:
+            self._mark_peer_dead(dst, f"send failed: {exc}")
         return n
 
     def _progress_recv(self, block_s: float) -> int:
@@ -441,15 +490,11 @@ class SocketCommEngine(CommEngine):
                 chunk = s.recv(1 << 20)
             except BlockingIOError:
                 continue
-            except OSError:
+            except OSError as exc:
+                self._peer_closed(peer, s, f"recv failed: {exc}")
                 continue
             if not chunk:
-                # peer closed: stop watching the fd or the selector
-                # reports it readable forever (busy-spin)
-                try:
-                    self._sel.unregister(s)
-                except (KeyError, ValueError):
-                    pass
+                self._peer_closed(peer, s, "connection closed by peer")
                 continue
             buf = self._rxbuf[peer]
             buf += chunk
@@ -485,6 +530,103 @@ class SocketCommEngine(CommEngine):
                 n += 1
         return n
 
+    def _peer_closed(self, peer: int, s: socket.socket, why: str) -> None:
+        """A peer's socket went away (comm thread). During orderly
+        shutdown (_stop set: disable() is closing the mesh) just stop
+        watching the fd; otherwise this is a failure — detect it."""
+        try:
+            self._sel.unregister(s)
+        except (KeyError, ValueError):
+            pass
+        if self._stop.is_set() or peer in self._bye_peers:
+            return      # orderly: we're stopping, or the peer said BYE
+        self._mark_peer_dead(peer, why)
+
+    def _on_bye(self, src: int, msg: Dict) -> None:
+        # TCP delivers the BYE bytes before the FIN, so by the time the
+        # zero-byte recv arrives the peer is already recorded here
+        self._bye_peers.add(src)
+
+    def _mark_peer_dead(self, peer: int, why: str) -> None:
+        """Failure detection (comm thread only). The reference's MPI
+        engine aborts the job on peer failure (default MPI error
+        handler + parsec_abort, runtime.h:33-37); a silent unregister
+        here would turn every dependent wait into a timeout. Record
+        the death, fail every in-flight rendezvous/fetch/barrier that
+        involves the peer, and abort active taskpools with a
+        diagnostic naming it."""
+        if peer in self._dead_peers or peer == self.rank:
+            return
+        self._dead_peers.add(peer)
+        s = self._socks.get(peer)
+        if s is not None:
+            try:
+                self._sel.unregister(s)
+            except (KeyError, ValueError):
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        lock = self._send_locks.get(peer)
+        if lock is not None:
+            with lock:
+                self._txbuf[peer].clear()
+        if peer in self._bye_peers:
+            # the peer announced orderly shutdown: a send failing
+            # against its closing socket (EPIPE on a late termdet ack)
+            # is teardown, not death — drop the peer's state quietly,
+            # no failure propagation
+            debug_verbose(2, "comm", "rank %d: post-BYE send teardown "
+                          "for peer %d (%s)", self.rank, peer, why)
+            return
+        exc = ConnectionError(
+            f"rank {self.rank}: peer rank {peer} died ({why})")
+        # fail rendezvous GETs awaiting a PUT from the dead peer (both
+        # entry shapes carry the peer at index 2)
+        doomed: List[Tuple] = []
+        with self._mem_lock:
+            for h, st in list(self._pending_gets.items()):
+                if st[2] == peer:
+                    doomed.append((h, self._pending_gets.pop(h)))
+        for h, st in doomed:
+            if st[0] == "get":
+                # public one-sided API: record the error where the
+                # value would land and wake the completion callback
+                # ("activation" entries are released via taskpool
+                # abort below)
+                with self._mem_lock:
+                    self._mem[h] = exc
+                st[1]()
+        # fail in-flight one-sided tile fetches targeting the peer
+        with self._fetch_lock:
+            for req, fut in list(self._fetch_futures.items()):
+                if getattr(fut, "owner", None) == peer:
+                    del self._fetch_futures[req]
+                    fut.set(("error", str(exc)))
+        # release a barrier this rank is blocked in (the dead peer can
+        # never enter it) — sync() re-raises _peer_failure
+        self._peer_failure = exc
+        if self._barrier_waiting:
+            self._barrier_release.set()
+        # abort active taskpools so ctx.wait raises instead of hanging
+        ctx = self._context
+        pools = []
+        if ctx is not None:
+            with ctx._lock:
+                pools = list(ctx._active_taskpools)
+        affected = bool(pools or doomed)
+        if affected or self._barrier_waiting:
+            warning("comm", "%s — aborting %d taskpool(s), failing %d "
+                    "pending get(s)", exc, len(pools), len(doomed))
+        else:
+            # nothing in flight (e.g. teardown race before _stop is
+            # set locally): record quietly
+            debug_verbose(2, "comm", "rank %d: peer %d gone (%s), "
+                          "nothing in flight", self.rank, peer, why)
+        for tp in pools:
+            tp.abort(exc)
+
     def _dispatch(self, tag: int, src: int, msg: Any) -> None:
         cb = self._am_callbacks.get(tag)
         if cb is None:
@@ -511,7 +653,7 @@ class SocketCommEngine(CommEngine):
         # exists to prevent. Handler-originated sends stay funnelled.
         return self._thread is not None and \
             threading.get_ident() != getattr(self, "_comm_tid", None) and \
-            bool(int(mca_param.get("comm.thread_multiple", 0)))
+            bool(int(mca_param.cached_get("comm.thread_multiple", 0)))
 
     def send_am(self, tag: int, dst_rank: int, msg: Any) -> None:
         if dst_rank == self.rank:
@@ -588,7 +730,8 @@ class SocketCommEngine(CommEngine):
         # be processed before this function returns (self-rank inline path)
         if on_done is not None:
             with self._mem_lock:
-                self._pending_gets[local_handle] = ("get", on_done)
+                self._pending_gets[local_handle] = \
+                    ("get", on_done, remote_rank)
         self.send_am(AMTag.GET_DATA, remote_rank,
                      {"remote_handle": remote_handle,
                       "reply_handle": local_handle})
@@ -628,7 +771,7 @@ class SocketCommEngine(CommEngine):
             # consumer side of a device-resident dataflow edge)
             msg["dev"] = True
         nbytes = self.payload_bytes(value)
-        eager_limit = int(mca_param.get("comm.eager_limit", 256 * 1024))
+        eager_limit = int(mca_param.cached_get("comm.eager_limit", 256 * 1024))
         if value is not None and nbytes > eager_limit:
             msg["value_handle"] = self.mem_register(value)
             msg["nbytes"] = nbytes
@@ -716,7 +859,7 @@ class SocketCommEngine(CommEngine):
         payload was device_put); ``1`` forces, ``0`` disables."""
         import sys
         import numpy as np
-        mode = str(mca_param.get("comm.stage_recv", "auto"))
+        mode = str(mca_param.cached_get("comm.stage_recv", "auto"))
         if mode in ("0", "off", "false"):
             return value
         if mode == "auto" and not tagged:
@@ -803,10 +946,19 @@ class SocketCommEngine(CommEngine):
             return
         tp._on_dtd_control(src, msg)
 
-    def taskpool_registered(self, tp) -> None:
+    def taskpool_registered(self, tp):
+        if self._peer_failure is not None:
+            # the mesh is already broken: a taskpool with remote deps
+            # would wait forever on the dead peer — fail it up front.
+            # False tells Context.add_taskpool to stop (no startup
+            # tasks, no on_enqueue) so nothing launches into the dead
+            # mesh and termination doesn't fire a second time
+            tp.abort(ConnectionError(str(self._peer_failure)))
+            return False
         parked = self._parked.pop(tp.name, [])
         for (src, msg) in parked:
             self._deliver_activation(tp, src, msg)
+        return True
 
     # ---------------------------------------------------- termdet services
     def register_termdet(self, name: str, monitor) -> None:
@@ -888,9 +1040,23 @@ class SocketCommEngine(CommEngine):
         if self.nb_ranks <= 1:
             return
         self._barrier_release.clear()
-        self.send_am(AMTag.BARRIER, 0, {"op": "enter"})
-        if not self._barrier_release.wait(timeout=60.0):
-            raise TimeoutError(f"rank {self.rank}: barrier timed out")
+        # order matters: _barrier_waiting must be visible BEFORE the
+        # failure check — a death landing between the check and the
+        # flag would otherwise never release this wait
+        self._barrier_waiting = True
+        try:
+            if self._peer_failure is not None:
+                # a dead peer can never enter the barrier — fail fast
+                raise ConnectionError(str(self._peer_failure))
+            self.send_am(AMTag.BARRIER, 0, {"op": "enter"})
+            released = self._barrier_release.wait(timeout=60.0)
+            if self._peer_failure is not None:   # checked first: a peer
+                raise ConnectionError(           # death IS the timeout's
+                    str(self._peer_failure))     # usual cause
+            if not released:
+                raise TimeoutError(f"rank {self.rank}: barrier timed out")
+        finally:
+            self._barrier_waiting = False
 
     def _on_barrier(self, src: int, msg: Dict) -> None:
         # comm-thread only (all handlers are)
@@ -902,6 +1068,9 @@ class SocketCommEngine(CommEngine):
                     self.send_am(AMTag.BARRIER, r, {"op": "release"})
         else:
             self._barrier_release.set()
+
+    def peer_alive(self, rank: int) -> bool:
+        return rank not in self._dead_peers
 
     def wire_stats(self) -> Dict[str, int]:
         """Frame-level wire counters (header+payload bytes on the socket);

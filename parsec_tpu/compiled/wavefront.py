@@ -141,18 +141,30 @@ def plan_taskpool(tp: PTGTaskpool) -> WavefrontPlan:
             # detect at plan time and direct such DAGs to the host
             # runtime (which applies specs per edge)
             prev = edge_specs.get((j, ref.flow_name), _NO_SPEC)
-            new_name = (ref.reshape_spec.name
-                        if ref.reshape_spec is not None else None)
-            if prev is not _NO_SPEC and \
-                    (prev.name if prev is not None else None) != new_name:
-                ctc, cp = tasks[j]
-                raise ValueError(
-                    f"task {ctc.name}{cp} flow {ref.flow_name!r} "
-                    f"receives conflicting reshape specs "
-                    f"({(prev.name if prev is not None else None)!r} vs "
-                    f"{new_name!r}) on different incoming edges; the "
-                    "compiled executors apply one spec per gathered "
-                    "flow — run this taskpool on the host runtime")
+            # identity = (name, fn): name alone would let two same-named
+            # specs with DIFFERENT fns through, silently applying one
+            # edge's fn to both gathered operands — the exact
+            # misconversion this guard exists to reject
+            new_id = ((ref.reshape_spec.name, ref.reshape_spec.fn)
+                      if ref.reshape_spec is not None else None)
+            if prev is not _NO_SPEC:
+                prev_id = ((prev.name, prev.fn)
+                           if prev is not None else None)
+                if prev_id != new_id:
+                    ctc, cp = tasks[j]
+                    pn = prev.name if prev is not None else None
+                    nn = (ref.reshape_spec.name
+                          if ref.reshape_spec is not None else None)
+                    what = (f"same name {pn!r} but different fn objects "
+                            "(share ONE ReshapeSpec instance across "
+                            "edges when the conversion is the same)"
+                            if pn == nn else f"{pn!r} vs {nn!r}")
+                    raise ValueError(
+                        f"task {ctc.name}{cp} flow {ref.flow_name!r} "
+                        f"receives conflicting reshape specs ({what}) "
+                        "on different incoming edges; the compiled "
+                        "executors apply one spec per gathered flow — "
+                        "run this taskpool on the host runtime")
             edge_specs[(j, ref.flow_name)] = ref.reshape_spec
             indeg[j] += 1
 

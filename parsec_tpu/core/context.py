@@ -176,7 +176,11 @@ class Context:
             self._active_taskpools.append(tp)
             self._taskpools_by_name[tp.name] = tp
         if self.comm is not None and hasattr(self.comm, "taskpool_registered"):
-            self.comm.taskpool_registered(tp)   # drain parked activations
+            # drain parked activations; False = registration refused
+            # (broken mesh) — the engine already aborted the pool, so
+            # don't launch startup work into a dead mesh
+            if self.comm.taskpool_registered(tp) is False:
+                return
         if tp.on_enqueue is not None:
             tp.on_enqueue(tp)
         self.pins.taskpool_init(tp)

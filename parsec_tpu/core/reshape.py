@@ -40,6 +40,10 @@ class ReshapeSpec:
     ``name``: identity for caching — two specs with the same name are the
     same conversion. Specs built only from dtype/transpose get a canonical
     name automatically; specs with ``fn`` get a unique one unless named.
+    The compiled executors cannot verify behavioral equality of ``fn``
+    values, so there the identity is (name, fn-object): same-named specs
+    landing on one gathered flow must share the SAME spec instance (or at
+    least the same ``fn`` object) or planning rejects the taskpool.
     """
 
     def __init__(self, dtype: Any = None, transpose: bool = False,
@@ -48,6 +52,11 @@ class ReshapeSpec:
         self.dtype = dtype
         self.transpose = transpose
         self.fn = fn
+        # compose() memo: same (self, then) pair -> SAME composed spec
+        # object, so (name, fn) identity holds across the per-edge
+        # compose calls iterate_successors makes (a fresh lambda per
+        # call would defeat conversion sharing and wave batching)
+        self._compose_cache: dict = {}
         if name is None:
             if fn is None:
                 name = f"cast:{dtype}:T{int(transpose)}"
@@ -56,8 +65,14 @@ class ReshapeSpec:
         self.name = name
 
     @property
-    def key(self) -> str:
-        return self.name
+    def key(self):
+        # (name, fn-object): name alone is the documented conversion
+        # identity, but caches keyed by it (DataCopyFuture's shared
+        # conversions, compiled-plan signatures) cannot verify
+        # behavioral equality of two same-named fn specs — including
+        # the fn object makes such a pair MISS (each edge converts
+        # correctly) instead of silently sharing one edge's conversion
+        return (self.name, self.fn)
 
     def apply(self, value: Any) -> Any:
         if value is None:
@@ -78,11 +93,21 @@ class ReshapeSpec:
 
     def compose(self, then: Optional["ReshapeSpec"]) -> "ReshapeSpec":
         """Sequential composition: ``self`` then ``then`` (producer-side
-        reshape followed by consumer-side reshape)."""
+        reshape followed by consumer-side reshape). Memoized per
+        ``then`` instance: every edge composing the same pair shares
+        ONE spec object (one ``fn``, one cache key, one wave-group
+        signature). The id() key is safe — the composed spec's closure
+        holds ``then`` strongly, so its id cannot be recycled while
+        the entry lives."""
         if then is None:
             return self
-        return ReshapeSpec(fn=lambda v, a=self, b=then: b.apply(a.apply(v)),
+        cached = self._compose_cache.get(id(then))
+        if cached is not None:
+            return cached
+        spec = ReshapeSpec(fn=lambda v, a=self, b=then: b.apply(a.apply(v)),
                            name=f"{self.name}>>{then.name}")
+        self._compose_cache[id(then)] = spec
+        return spec
 
     def __call__(self, value: Any) -> Any:
         return self.apply(value)

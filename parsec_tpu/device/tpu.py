@@ -116,10 +116,17 @@ class TPUDevice(Device):
             # hooks participate when they provide batch_sig/batch_body
             # (DTD pure woven bodies).
             self._ensure_manager()
+            enqueued = False
             with self._mgr_cv:
-                self._pending.append((task, chore))
-                self._mgr_cv.notify()
-            return HookReturn.ASYNC
+                # after shutdown() initiated a stop, the manager may
+                # exit without ever seeing this task — fall through to
+                # a synchronous run instead of hanging it in _pending
+                if not self._mgr_stop:
+                    self._pending.append((task, chore))
+                    self._mgr_cv.notify()
+                    enqueued = True
+            if enqueued:
+                return HookReturn.ASYNC
         if not chore.batchable:
             return self._run_hook(task, chore)
         return self._run_sync(task, chore)
@@ -179,22 +186,27 @@ class TPUDevice(Device):
         if t.is_alive():
             # stuck mid-batch (e.g. a minutes-long remote compile):
             # keep the thread reference so a later execute() cannot
-            # spawn a SECOND manager racing this one on _pending, and
-            # leave _pending for the live manager to drain
+            # spawn a SECOND manager racing this one on _pending; the
+            # manager's own stopping branch drains-and-aborts _pending
+            # whenever it finally exits
             warning("device", "%s manager did not stop within 5 s; "
                     "leaving it flagged to stop", self.name)
             return
         self._mgr_thread = None
+        # safety net for ABNORMAL manager exit (an exception in the
+        # grouping loop kills the thread without reaching its stopping-
+        # branch drain): anything still queued has no completer — abort
+        # so ASYNC waiters release instead of hanging
         with self._mgr_cv:
             leftover = list(self._pending)
             self._pending.clear()
         if leftover:
-            warning("device", "%s manager shutdown with %d queued "
-                    "task(s); aborting their taskpools", self.name,
-                    len(leftover))
+            warning("device", "%s manager left %d queued task(s) "
+                    "(abnormal exit); aborting their taskpools",
+                    self.name, len(leftover))
             err = RuntimeError(
-                f"{self.name}: batching manager shut down with the "
-                "task still queued")
+                f"{self.name}: batching manager exited with the task "
+                "still queued")
             for (task, _chore) in leftover:
                 self.release_load()
                 task.taskpool.abort(err)
@@ -444,10 +456,27 @@ class TPUDevice(Device):
             with self._mgr_cv:
                 while not self._pending and not self._mgr_stop:
                     self._mgr_cv.wait(timeout=0.5)
-                if self._mgr_stop:
-                    return
+                stopping = self._mgr_stop
                 drained = list(self._pending)
                 self._pending.clear()
+            if stopping:
+                # a manager that missed shutdown()'s join window exits
+                # HERE after its in-flight batch: abort whatever queued
+                # meanwhile (execute() stops enqueueing once _mgr_stop
+                # is set, but tasks may have landed before that) —
+                # otherwise they sit in _pending as ASYNC forever with
+                # no completer
+                if drained:
+                    warning("device", "%s manager exiting with %d "
+                            "queued task(s); aborting their taskpools",
+                            self.name, len(drained))
+                    err = RuntimeError(
+                        f"{self.name}: batching manager stopped with "
+                        "the task still queued")
+                    for (task, _chore) in drained:
+                        self.release_load()
+                        task.taskpool.abort(err)
+                return
             # group by (taskpool, class, chore, input signature);
             # values/sig computed ONCE here and carried through
             groups: Dict[Tuple, List] = {}

@@ -64,6 +64,7 @@ class ParamRegistry:
         self._files_loaded = False
         self._lock = threading.Lock()
         self._generation = 0
+        self._cache: Dict[str, tuple] = {}   # name -> (generation, value)
 
     # -- file layer -------------------------------------------------------
     def _load_files(self) -> None:
@@ -140,6 +141,19 @@ class ParamRegistry:
         the only mid-process change channel)."""
         return self._generation
 
+    def cached_get(self, name: str, default: Any = None) -> Any:
+        """``get`` memoized by :meth:`generation` — for per-message hot
+        paths (a full ``get`` resolves env vars per call, ~3 µs; this is
+        a dict hit + one int compare). Unlocked by design: a racing
+        ``set`` at worst causes one redundant re-resolve."""
+        gen = self._generation
+        hit = self._cache.get(name)
+        if hit is not None and hit[0] == gen:
+            return hit[1]
+        val = self.get(name, default)
+        self._cache[name] = (gen, val)
+        return val
+
     def dump(self) -> List[Dict[str, Any]]:
         """All registered params with current values (parsec --help analog)."""
         self._load_files()
@@ -159,6 +173,7 @@ set = _registry.set
 unset = _registry.unset
 dump = _registry.dump
 generation = _registry.generation
+cached_get = _registry.cached_get
 
 
 def parse_cli(argv: List[str]) -> List[str]:

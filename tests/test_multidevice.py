@@ -4,6 +4,7 @@ module instances, device_cuda_module.c:326). Runs on the virtual
 8-device CPU mesh from conftest."""
 
 import numpy as np
+import pytest
 
 import parsec_tpu as parsec
 from parsec_tpu import dtd
@@ -227,23 +228,26 @@ def _mgr_dist_child(rank, nb_ranks, base_port, q):
         q.put((rank, "error", f"{exc}\n{traceback.format_exc()}"))
 
 
-def test_batch_dispatch_manager_2rank_socket():
-    """Both ranks run the per-device batching manager while DTD GEMM
+@pytest.mark.parametrize("nranks", [2, 4])
+def test_batch_dispatch_manager_socket(nranks):
+    """Every rank runs the per-device batching manager while DTD GEMM
     values cross the socket wire: results correct on every rank's local
-    tiles AND each rank registered at least one multi-task batch."""
+    tiles AND each rank registered at least one multi-task batch.
+    4 ranks = the reference's mid-scale MPI test size (SURVEY §4)."""
     import multiprocessing as mp
     from parsec_tpu.comm.pingpong import _free_port_base
 
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
-    base_port = _free_port_base(2)
-    procs = [ctx.Process(target=_mgr_dist_child, args=(r, 2, base_port, q))
-             for r in range(2)]
+    base_port = _free_port_base(nranks)
+    procs = [ctx.Process(target=_mgr_dist_child,
+                         args=(r, nranks, base_port, q))
+             for r in range(nranks)]
     for p in procs:
         p.start()
     results = {}
     try:
-        for _ in range(2):
+        for _ in range(nranks):
             rank, status, payload = q.get(timeout=180)
             if status != "ok":
                 raise AssertionError(f"rank {rank} failed:\n{payload}")

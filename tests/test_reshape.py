@@ -395,3 +395,46 @@ def test_planner_rejects_conflicting_edge_specs():
 
         with pytest.raises(ValueError, match="conflicting reshape"):
             plan_taskpool(tp)
+
+
+def test_planner_rejects_same_name_different_fn_specs():
+    """Round-5 hardening: spec identity is (name, fn), not name alone —
+    two same-NAMED specs with different fns are still a conflict (one
+    edge's fn would silently convert the other edge's operand)."""
+    from parsec_tpu.compiled.wavefront import plan_taskpool
+
+    A = TiledMatrix.from_array(np.zeros((2, 1), np.float32), 1, 1,
+                               name="A")
+    tp = ptg.Taskpool("namedup", A=A)
+    P = tp.task_class(
+        "P", params=("i",), space=lambda g: ((0,), (1,)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            tile=lambda g, i: (g.A, (i, 0)),
+            ins=[ptg.In(data=lambda g, i: (g.A, (i, 0)))],
+            outs=[ptg.Out(dst=("C", lambda g, i: (0,), "V"),
+                          guard=lambda g, i: i == 0,
+                          reshape=ReshapeSpec(fn=lambda v: v + 1,
+                                              name="same")),
+                  ptg.Out(dst=("C", lambda g, i: (0,), "V"),
+                          guard=lambda g, i: i == 1,
+                          reshape=ReshapeSpec(fn=lambda v: v * 2,
+                                              name="same"))])])
+    C = tp.task_class(
+        "C", params=("j",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "V", ptg.RW,
+            tile=lambda g, j: (g.A, (0, 0)),
+            ins=[ptg.In(src=("P", lambda g, j: (0,), "X"))],
+            outs=[ptg.Out(data=lambda g, j: (g.A, (0, 0)))])])
+
+    @P.body
+    def pbody(task, X):
+        return X
+
+    @C.body
+    def cbody(task, V):
+        return V
+
+    with pytest.raises(ValueError, match="conflicting reshape"):
+        plan_taskpool(tp)

@@ -611,3 +611,156 @@ def scenario_getrf_left_2ranks(ctx, engine, rank, nb_ranks, n=192, nb=32):
 def test_getrf_left_2ranks():
     res = _run_ranks("scenario_getrf_left_2ranks", 2)
     assert len(res) == 2
+
+
+# ---- 4/8-rank scale (reference MPI_TEST_CMD_LIST nprocs up to 8,
+# /root/reference/tests/CMakeLists.txt:925-952; SURVEY §4) ---------------
+
+def test_potrf_left_4ranks():
+    """The flagship left-looking taskpool at 4 real processes: gathered
+    UPDATE operands fetch across a 4-rank mesh (tree fan-outs and the
+    full-mesh wireup get depth they never see at 2-3 ranks)."""
+    _run_ranks("scenario_potrf_left", 4, n=256, nb=32)
+
+
+def scenario_chain_fourcounter(ctx, engine, rank, nb_ranks, n_steps=64):
+    """Cross-rank chain under the four-counter termdet wave: every rank
+    oscillates busy/idle per hop, so waves launch continuously and the
+    rank-0 coordinator is raced by all peers' requests and replies —
+    the interleavings an 8-rank mesh produces and 2 ranks never do."""
+    from parsec_tpu.utils import mca_param
+    mca_param.set("termdet", "fourcounter")
+    try:
+        return scenario_chain(ctx, engine, rank, nb_ranks,
+                              n_steps=n_steps)
+    finally:
+        mca_param.unset("termdet")
+
+
+def test_chain_fourcounter_8ranks():
+    _run_ranks("scenario_chain_fourcounter", 8, n_steps=64,
+               timeout=180.0)
+
+
+def scenario_bcast_binomial(ctx, engine, rank, nb_ranks, nb=16):
+    """Binomial-tree broadcast over an nb_ranks-rank mesh: one tile per
+    rank, so the tree's inner hops are REAL remote activations — at 8
+    ranks the tree has depth 3 (the first configuration where a
+    non-root node forwards to multiple children)."""
+    from parsec_tpu.data.matrix import TiledMatrix, TwoDimBlockCyclic
+    from parsec_tpu.data.matrix_ops import build_broadcast
+
+    nt = nb_ranks                    # one block-row per rank
+    host = np.zeros((nt * nb, nb), np.float32)
+    host[:nb] = np.arange(nb * nb, dtype=np.float32).reshape(nb, nb)
+    dist = TwoDimBlockCyclic(P=nb_ranks, Q=1)
+    A = TiledMatrix.from_array(host.copy(), nb, nb, dist=dist,
+                               myrank=rank, name="A")
+    tp = build_broadcast(A, root=(0, 0))
+    ctx.add_taskpool(tp)
+    ctx.start()
+    assert ctx.wait(timeout=90), f"rank {rank}: bcast did not terminate"
+    root_tile = host[:nb]
+    for (i, j) in A.local_keys():
+        np.testing.assert_array_equal(np.asarray(A.data_of((i, j))),
+                                      root_tile)
+    return len(list(A.local_keys()))
+
+
+def test_bcast_binomial_8ranks():
+    res = _run_ranks("scenario_bcast_binomial", 8, timeout=180.0)
+    assert len(res) == 8
+
+
+# ---- failure detection (peer death must abort, not hang) ----------------
+
+def _death_child(rank, nb_ranks, base_port, q):
+    """Child for the peer-death test: a cross-rank chain with slow
+    bodies; rank 1 reports its pid then keeps running (the parent
+    SIGKILLs it mid-chain); survivors must RAISE promptly — the
+    reference gets this from MPI's default error handler +
+    parsec_abort (runtime.h:33-37), not from timeouts."""
+    import os
+    import time
+    import traceback
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from parsec_tpu.comm.socket_engine import SocketCommEngine
+        from parsec_tpu.core import context as ctx_mod
+        from parsec_tpu.dsl import ptg
+
+        engine = SocketCommEngine(rank, nb_ranks, base_port=base_port)
+        ctx = ctx_mod.init(nb_cores=2, comm=engine)
+        n_steps = 200
+        A = _DistVec(n_steps, nb_ranks, rank)
+        tp = ptg.Taskpool("deathchain", N=n_steps, A=A)
+        tp.task_class(
+            "STEP", params=("k",),
+            space=lambda g: ((k,) for k in range(g.N)),
+            affinity=lambda g, k: (g.A, (k,)),
+            flows=[ptg.FlowSpec(
+                "T", ptg.RW,
+                ins=[ptg.In(data=lambda g, k: (g.A, (0,)),
+                            guard=lambda g, k: k == 0),
+                     ptg.In(src=("STEP", lambda g, k: (k - 1,), "T"),
+                            guard=lambda g, k: k > 0)],
+                outs=[ptg.Out(dst=("STEP", lambda g, k: (k + 1,), "T"),
+                              guard=lambda g, k: k < g.N - 1),
+                      ptg.Out(data=lambda g, k: (g.A, (k,)))])])
+
+        # batchable=False: a compiled body would trace the sleep away
+        # and finish the chain in milliseconds — the kill must land
+        # mid-flight
+        @tp.task_class_by_name("STEP").body(batchable=False)
+        def step_body(task, T):
+            time.sleep(0.02)     # keep the chain in flight for seconds
+            return T + 1
+
+        ctx.add_taskpool(tp)
+        ctx.start()
+        if rank == 1:
+            q.put((rank, "ready", os.getpid()))
+            time.sleep(300)      # parent SIGKILLs this process
+            return
+        t0 = time.monotonic()
+        try:
+            ctx.wait(timeout=90)
+            q.put((rank, "no-error", None))
+        except RuntimeError as exc:
+            elapsed = time.monotonic() - t0
+            ctx.fini()           # teardown after failure must not hang
+            q.put((rank, "raised", (elapsed, str(exc))))
+    except BaseException as exc:  # noqa: BLE001 — report to parent
+        q.put((rank, "error", f"{exc}\n{traceback.format_exc()}"))
+
+
+def test_peer_death_aborts_survivor():
+    """SIGKILL one rank mid-run: the survivor's ctx.wait must raise a
+    diagnostic naming the dead peer well before any timeout."""
+    import signal
+    import time
+    ctx = mp.get_context("spawn")
+    base_port = _free_port_base(2)
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_death_child, args=(r, 2, base_port, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    try:
+        rank, status, pid = q.get(timeout=60)
+        assert (rank, status) == (1, "ready"), (rank, status)
+        time.sleep(1.0)                      # chain is mid-flight
+        os.kill(pid, signal.SIGKILL)
+        rank, status, payload = q.get(timeout=60)
+        assert rank == 0
+        assert status == "raised", (status, payload)
+        elapsed, message = payload
+        # detection is socket-close-driven: prompt, not timeout-driven
+        assert elapsed < 30.0, f"took {elapsed:.1f}s — timeout, not detection"
+        assert "peer rank 1" in message, message
+    finally:
+        for p in procs:
+            p.join(timeout=10.0)
+            if p.is_alive():
+                p.terminate()
