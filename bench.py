@@ -567,8 +567,12 @@ def _section_getrf():
 
     on_tpu = jax.default_backend() == "tpu"
     probe = _make_lat_probe()
-    nl, nbl = (24576, 1024) if on_tpu else (256, 64)
+    # n=32768 (round 5): 24576's 0.19 s timed region sat in the tunnel-
+    # jitter zone the round-4 GEMM analysis mapped (±20%/run); 0.42 s is
+    # stable run-to-run
+    nl, nbl = (32768, 1024) if on_tpu else (256, 64)
     nl = int(os.environ.get("PARSEC_BENCH_LU_N", nl))
+    nbl = int(os.environ.get("PARSEC_BENCH_LU_NB", nbl))
     # benchmark fast path (library default = exact solves)
     mca_param.set("potrf.trsm_hook", "gemm")
     Al = TiledMatrix(nl, nl, nbl, nbl, name="A")
@@ -1162,9 +1166,129 @@ def main():
     print(_compact_summary(result))
 
 
+def render_parity():
+    """``--parity``: regenerate PARITY.md's captured-numbers table from
+    ``BENCH_DETAIL.json`` so claimed == captured **by construction** —
+    rounds 3 and 4 both shipped hand-maintained numbers that had
+    drifted from the round's artifact (r4: GETRF \"59.1-63.2 captured\"
+    vs 52.3 actual). The table is spliced between the PARITY.md marker
+    comments; run after a full ``python bench.py``."""
+    detail_path = os.path.join(_HERE, "BENCH_DETAIL.json")
+    with open(detail_path) as f:
+        r = json.load(f)
+    d = r["detail"]
+    x = d.get("extra_configs", {})
+    lat = d.get("latency", {})
+    peak = d.get("peak_proxy_gemm_gflops") or 0.0
+
+    def pct(g):
+        return f"{g / peak * 100:.0f}%" if (g and peak) else "—"
+
+    def tf(g):
+        return f"{g / 1000:.1f} TF/s" if g else "—"
+
+    rows = []
+    rows.append((
+        f"tiled POTRF flagship (N={d.get('n')}, NB={d.get('tile')})",
+        f"{tf(r.get('value'))}, vs_baseline {r.get('vs_baseline')}",
+        pct(r.get("value")),
+        f"residual {d.get('rel_residual_check')}"))
+    pv = d.get("precision_variant") or {}
+    if pv.get("gflops"):
+        rows.append((
+            f"POTRF precision variant (N={pv.get('n')}, highest+solve)",
+            tf(pv.get("gflops")), pct(pv.get("gflops")),
+            f"residual {pv.get('rel_residual_check')}"))
+    gq = x.get("geqrf_fused", {})
+    if gq.get("gflops"):
+        note = f"residual {gq.get('rel_residual_check')}"
+        pvq = gq.get("precision_variant") or {}
+        if pvq.get("gflops"):
+            note += (f"; highest-precision {tf(pvq['gflops'])} at "
+                     f"residual {pvq.get('rel_residual_check')}")
+        rows.append((f"tiled GEQRF fused (N={gq.get('n')})",
+                     tf(gq["gflops"]), pct(gq["gflops"]), note))
+    gl = x.get("getrf_fused", {})
+    if gl.get("gflops"):
+        rows.append((f"tiled GETRF fused (N={gl.get('n')})",
+                     tf(gl["gflops"]), pct(gl["gflops"]),
+                     f"residual {gl.get('rel_residual_check')}"))
+    gm = x.get("dtd_gemm", {})
+    if gm.get("panel_fused_gflops"):
+        rows.append((f"fused GEMM (k-blocked, n={gm.get('n')})",
+                     tf(gm["panel_fused_gflops"]),
+                     pct(gm["panel_fused_gflops"]), ""))
+    tr = x.get("transformer", {})
+    if tr.get("flash_gflops"):
+        rows.append((
+            f"transformer step (S={tr.get('seq')}, flash, "
+            f"dh={tr.get('d_head')})",
+            tf(tr["flash_gflops"]), "—",
+            f"{tr.get('flash_speedup')}× the xla-attention path"))
+    hd = x.get("host_dtd", {})
+    if hd.get("host_runtime_gflops"):
+        rows.append((
+            "DTD GEMM host runtime (chip)",
+            f"{hd['host_runtime_gflops']:.0f} GF/s", "—",
+            f"host_vs_compiled {hd.get('host_vs_compiled', '—')}"))
+    oc = x.get("ooc_potrf", {})
+    if oc.get("gflops") is not None:
+        hm = oc.get("hbm_measured", {})
+        rows.append((
+            f"out-of-core POTRF (budget {oc.get('budget_mb')} MB / "
+            f"matrix {oc.get('matrix_mb')} MB)",
+            f"run {oc.get('run_s')} s", "—",
+            f"manager-measured: peak=={oc.get('budget_mb')} MB, "
+            f"{hm.get('spills', '?')} spills, residual "
+            f"{oc.get('rel_residual')}"))
+    if lat.get("eager_1k_p50_us"):
+        note = ""
+        if lat.get("latency_regression"):
+            note = f"REGRESSION: {lat['latency_regression']}"
+        rows.append((
+            "remote-dep latency (socket engine)",
+            f"eager 1 KB p50 {lat['eager_1k_p50_us']} µs; "
+            f"rdv 1 MB p50 {lat.get('rdv_1M_p50_us')} µs", "—", note))
+
+    import datetime
+    mtime = datetime.datetime.fromtimestamp(
+        os.path.getmtime(detail_path)).strftime("%Y-%m-%d %H:%M")
+    lines = [
+        f"Generated by `python bench.py --parity` from BENCH_DETAIL.json "
+        f"(captured {mtime}; peak proxy {peak / 1000:.1f} TF/s, "
+        f"vs_baseline target = 65% of proxy). Do not hand-edit "
+        f"between the markers.",
+        "",
+        "| Config | Captured | % of peak proxy | Notes |",
+        "|---|---|---|---|",
+    ]
+    for (cfg, cap, p, note) in rows:
+        lines.append(f"| {cfg} | {cap} | {p} | {note} |")
+    block = "\n".join(lines)
+
+    parity_path = os.path.join(_HERE, "PARITY.md")
+    START = "<!-- BENCH_TABLE_START (bench.py --parity) -->"
+    END = "<!-- BENCH_TABLE_END -->"
+    with open(parity_path) as f:
+        doc = f.read()
+    if START in doc and END in doc:
+        head, rest = doc.split(START, 1)
+        _, tail = rest.split(END, 1)
+        doc = head + START + "\n" + block + "\n" + END + tail
+        with open(parity_path, "w") as f:
+            f.write(doc)
+        print(f"PARITY.md table regenerated from {detail_path}")
+    else:
+        print(block)
+        print(f"\n(markers not found in {parity_path}; "
+              "table printed instead)")
+
+
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--section":
         name = sys.argv[2]
         print("SECTION_RESULT " + json.dumps(SECTIONS[name]()))
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--parity":
+        render_parity()
     else:
         main()

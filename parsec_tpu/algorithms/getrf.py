@@ -444,11 +444,17 @@ def _getrf_left_wave_fuser(wave, geoms):
             Lt = D[0:k * nb, k * mb:]             # (k*nb, mk)
             st["_lu_col"] = D[r0:r0 + nb, k * mb:] - mm(Ut, Lt)
             if k + 1 < NT:
-                # row panel (Aᵀ col strip = block-row k over rows > k)
-                Ut2 = us[0:k * nb, (k + 1) * mb:].T    # (T, k*nb)
-                Lt2 = D[0:k * nb, k * mb:(k + 1) * mb]   # (k*nb, nb)
-                st["_lu_row"] = D[(k + 1) * nb:,
-                                  k * mb:(k + 1) * mb] - mm(Ut2, Lt2)
+                # row panel in A-LAYOUT (nb, T): A[k,j>k] - L[k,:k]·U[:k,j>k].
+                # Round-4 computed it Aᵀ-oriented via us[...].T with BOTH
+                # dims large — XLA materializes that transpose, ~1 GB-
+                # class copies per step (~46 GB over the run, measured
+                # +55 ms). A-layout needs only (x, nb) transposes (the
+                # L row read and the residual base, ≤130 MB each) and
+                # reads the U store straight.
+                Lrow = D[0:k * nb, k * mb:(k + 1) * mb].T    # (nb, k*nb)
+                Ublk = us[0:k * nb, (k + 1) * mb:]           # (k*nb, T)
+                baseA = D[(k + 1) * nb:, k * mb:(k + 1) * mb].T  # (nb, T)
+                st["_lu_rowA"] = baseA - mm(Lrow, Ublk)
             return st
 
         return do_update
@@ -509,27 +515,31 @@ def _getrf_left_wave_fuser(wave, geoms):
             col = st.pop("_lu_col_rest", None)
             if col is None:       # k == 0: no update wave preceded
                 col = D[c, (k + 1) * mb:]
-            row = st.pop("_lu_row", None)
-            if row is None:
-                row = D[(k + 1) * nb:, k * mb:(k + 1) * mb]
+            rowA = st.pop("_lu_rowA", None)       # A-layout (nb, T)
+            if rowA is None:
+                rowA = D[(k + 1) * nb:, k * mb:(k + 1) * mb].T
             if inv_mode:
                 # MAGMA-style: invert the nb-sized factors once, every
                 # panel solve becomes one MXU matmul
                 Uinv = tri_inv_tile(U.T).T     # via lower-tri inversion
                 Linv = tri_inv_tile(L)
                 solved_col = mm(Uinv.T, col)       # (U^-T)·colᵀ
-                solved_row = mm(row, Linv.T)       # rowᵀ·(L^-T)
+                solved_rowA = mm(Linv, rowA)       # L^-1·A[k, j>k]
             else:
                 solved_col = jax.lax.linalg.triangular_solve(
                     U, col, left_side=True, lower=False,
                     transpose_a=True)
-                solved_row = jax.lax.linalg.triangular_solve(
-                    L, row, left_side=False, lower=True,
-                    transpose_a=True, unit_diagonal=True)
+                solved_rowA = jax.lax.linalg.triangular_solve(
+                    L, rowA, left_side=True, lower=True,
+                    unit_diagonal=True)
             # panel writes, ONE DUS chain per store: L/diag row panel
             # into the Aᵀ collection store, U row panel into the
             # A-layout U carry (two chains on one array would cost a
-            # full store copy per step — see the fuser docstring)
+            # full store copy per step — see the fuser docstring).
+            # solved_rowA is ALREADY A-layout — no transpose at write.
+            # concat-then-one-DUS beats two adjacent DUS's here
+            # (measured 56.9 vs 54.7 TF/s at N=32768: the second DUS
+            # breaks XLA's in-place chain)
             D = D.at[c, k * mb:].set(
                 jnp.concatenate([LU.T, solved_col.astype(D.dtype)],
                                 axis=1))
@@ -537,7 +547,7 @@ def _getrf_left_wave_fuser(wave, geoms):
             if us is None:
                 us = jnp.zeros_like(D)
             st["_us"] = us.at[k * nb:(k + 1) * nb, (k + 1) * mb:].set(
-                solved_row.T.astype(D.dtype))
+                solved_rowA.astype(D.dtype))
             st[geom.name] = D
             return st
 

@@ -538,9 +538,37 @@ class SocketCommEngine(CommEngine):
             self._sel.unregister(s)
         except (KeyError, ValueError):
             pass
-        if self._stop.is_set() or peer in self._bye_peers:
-            return      # orderly: we're stopping, or the peer said BYE
+        if self._stop.is_set():
+            return      # orderly: we're stopping ourselves
+        # BYE'd peers route through _mark_peer_dead too: its orderly
+        # branch skips job-kill but still fails anything in flight
+        # toward the departed peer (a silent drop would convert those
+        # waits into timeouts)
         self._mark_peer_dead(peer, why)
+
+    def _sweep_peer_inflight(self, peer: int, exc: BaseException) -> List:
+        """Fail everything in flight that involves ``peer``: rendezvous
+        GETs awaiting its PUT (both entry shapes carry the peer at
+        index 2; "get"-kind callers see the error in the handle slot
+        and their callback fires) and one-sided tile fetches targeting
+        it. Returns the doomed _pending_gets entries so the caller can
+        abort the taskpools of "activation"-kind ones."""
+        doomed: List[Tuple] = []
+        with self._mem_lock:
+            for h, st in list(self._pending_gets.items()):
+                if st[2] == peer:
+                    doomed.append((h, self._pending_gets.pop(h)))
+        for h, st in doomed:
+            if st[0] == "get":
+                with self._mem_lock:
+                    self._mem[h] = exc
+                st[1]()
+        with self._fetch_lock:
+            for req, fut in list(self._fetch_futures.items()):
+                if getattr(fut, "owner", None) == peer:
+                    del self._fetch_futures[req]
+                    fut.set(("error", str(exc)))
+        return doomed
 
     def _on_bye(self, src: int, msg: Dict) -> None:
         # TCP delivers the BYE bytes before the FIN, so by the time the
@@ -575,35 +603,34 @@ class SocketCommEngine(CommEngine):
         if peer in self._bye_peers:
             # the peer announced orderly shutdown: a send failing
             # against its closing socket (EPIPE on a late termdet ack)
-            # is teardown, not death — drop the peer's state quietly,
-            # no failure propagation
-            debug_verbose(2, "comm", "rank %d: post-BYE send teardown "
-                          "for peer %d (%s)", self.rank, peer, why)
+            # is teardown, not death — no job-kill. But anything still
+            # IN FLIGHT toward that peer can never complete and must
+            # fail promptly (not time out): sweep it with an orderly-
+            # shutdown diagnostic, abort only the taskpools those
+            # entries belong to, and fail a barrier this rank is
+            # blocked in (the departed peer won't enter it).
+            exc = ConnectionError(
+                f"rank {self.rank}: peer rank {peer} shut down with "
+                f"requests in flight ({why})")
+            doomed = self._sweep_peer_inflight(peer, exc)
+            if doomed:
+                warning("comm", "%s — failing %d pending request(s)",
+                        exc, len(doomed))
+                for tp in {st[1] for (_h, st) in doomed
+                           if st[0] == "activation"}:
+                    tp.abort(exc)
+            else:
+                debug_verbose(2, "comm", "rank %d: post-BYE teardown "
+                              "for peer %d (%s)", self.rank, peer, why)
+            # barriers are NOT failed here: whether a departed peer
+            # strands one is not locally decidable (an already-entered
+            # peer doesn't — rank 0 still releases). A peer that BYEs
+            # without entering a barrier others wait in is a collective-
+            # ordering bug; the 60 s barrier timeout names that case.
             return
         exc = ConnectionError(
             f"rank {self.rank}: peer rank {peer} died ({why})")
-        # fail rendezvous GETs awaiting a PUT from the dead peer (both
-        # entry shapes carry the peer at index 2)
-        doomed: List[Tuple] = []
-        with self._mem_lock:
-            for h, st in list(self._pending_gets.items()):
-                if st[2] == peer:
-                    doomed.append((h, self._pending_gets.pop(h)))
-        for h, st in doomed:
-            if st[0] == "get":
-                # public one-sided API: record the error where the
-                # value would land and wake the completion callback
-                # ("activation" entries are released via taskpool
-                # abort below)
-                with self._mem_lock:
-                    self._mem[h] = exc
-                st[1]()
-        # fail in-flight one-sided tile fetches targeting the peer
-        with self._fetch_lock:
-            for req, fut in list(self._fetch_futures.items()):
-                if getattr(fut, "owner", None) == peer:
-                    del self._fetch_futures[req]
-                    fut.set(("error", str(exc)))
+        doomed = self._sweep_peer_inflight(peer, exc)
         # release a barrier this rank is blocked in (the dead peer can
         # never enter it) — sync() re-raises _peer_failure
         self._peer_failure = exc

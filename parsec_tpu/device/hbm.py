@@ -81,7 +81,11 @@ class HBMManager:
         self._clock = 0
         self._stage_dev = None       # placement guess for reserve-first
         self.stats = {"stage_in": 0, "spills": 0, "bytes_staged": 0,
-                      "bytes_spilled": 0, "peak_bytes": 0}
+                      "bytes_spilled": 0, "peak_bytes": 0,
+                      # eviction-policy split: victims chosen by the
+                      # plan's next-use schedule (Belady) vs the LRU
+                      # fallback (no schedule info on the victim)
+                      "evict_belady": 0, "evict_lru": 0}
 
     # ---------------------------------------------------------- internal
     def _zone_for(self, dev) -> ZoneAllocator:
@@ -135,6 +139,8 @@ class HBMManager:
             e["offset"] = None
             e["device"] = None
             self.stats["spills"] += 1
+            self.stats["evict_belady" if e.get("next_use") is not None
+                       else "evict_lru"] += 1
             self.stats["bytes_spilled"] += host.nbytes
             debug_verbose(3, "hbm", "spilled %r (%d bytes)", best_key,
                           host.nbytes)

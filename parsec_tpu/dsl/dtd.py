@@ -153,6 +153,16 @@ class _TileBank:
             if t is None:
                 t = _Tile(dc, hkey[1])
                 self._tiles[hkey] = t
+            elif t.collection is not dc:
+                # two live collections sharing one dc_id would silently
+                # alias each other's writer tracking (values vanish);
+                # dc_id is the wire identity, so it must be unique
+                raise ValueError(
+                    f"distinct collections share dc_id={dc.dc_id}; "
+                    f"tile {hkey[1]} would alias "
+                    f"{getattr(t.collection, 'name', t.collection)!r} and "
+                    f"{getattr(dc, 'name', dc)!r} — give each collection "
+                    "a unique dc_id")
             return t
 
     def all(self) -> List[_Tile]:
@@ -192,9 +202,25 @@ class Taskpool(CoreTaskpool):
         self._flush_lock = threading.Lock()
         self._flush_acks = 0
         self._flush_cv = threading.Condition(self._flush_lock)
+        # count of remote activations that arrived BEFORE the local
+        # replay discovered their task (parked against _GOAL_UNSET) —
+        # observability for the remote_dep_mpi.c:1935-1961 analog
+        # (incremented under the seq lock; GIL-atomic reads)
+        self.parked_activations = 0
         # hold the taskpool open while the user is still inserting
         # (reference: DTD keeps a pending action until taskpool_wait)
-        self.on_enqueue = lambda tp: tp.addto_runtime_actions(1)
+        # _enqueue_counted: the +1 only happens when registration
+        # completes (a broken-mesh refusal in taskpool_registered stops
+        # add_taskpool BEFORE on_enqueue) — wait() must not decrement a
+        # count that was never incremented (runtime_actions would go
+        # negative and mask the peer-death diagnostic)
+        self._enqueue_counted = False
+
+        def _on_enqueue(tp):
+            tp.addto_runtime_actions(1)
+            tp._enqueue_counted = True
+
+        self.on_enqueue = _on_enqueue
 
     # -- rank helpers ------------------------------------------------------
     @property
@@ -648,6 +674,11 @@ class Taskpool(CoreTaskpool):
             # goal read + count must be one critical section against
             # insert_task's goal publication + finalize (see there)
             goal = self._goals.get(seq, _GOAL_UNSET)
+            if goal == _GOAL_UNSET:
+                # activation raced ahead of local discovery — the
+                # parked-undiscovered-task path (stress tests assert
+                # this actually fires at 4 ranks)
+                self.parked_activations += 1
             task = self._tasks_by_seq.get(seq)
             ent = self.pending.update(("dtd", seq),
                                       ref.flow_name, ref.value, ref.dep_index,
@@ -668,7 +699,7 @@ class Taskpool(CoreTaskpool):
             first = not self._closed
             self._closed = True
             self._inflight_cv.notify_all()
-        if first:
+        if first and self._enqueue_counted:
             self.addto_runtime_actions(-1)
         self.wait_completed()
 

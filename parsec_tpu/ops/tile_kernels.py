@@ -225,7 +225,11 @@ def scale_tile(A, alpha):
 def _lu_base(T):
     """Masked rank-1 eliminations as ONE fori_loop — a handful of traced
     ops regardless of the block size (an unrolled loop would put ~n ops
-    per tile into the fused whole-DAG program)."""
+    per tile into the fused whole-DAG program). A rank-2 variant
+    (second column's post-elimination state derived algebraically) was
+    tried in round 5 and measured SLOWER in the full fused LU (53.9 vs
+    56.9 TF/s at N=32768): the longer dependent-op body beat the saved
+    loop iterations."""
     n = T.shape[0]
     idx = jnp.arange(n)
 

@@ -672,6 +672,92 @@ def test_bcast_binomial_8ranks():
     assert len(res) == 8
 
 
+# ---- distributed DTD stress: parked activations at 4 ranks --------------
+# Reference bar: remote_dep_mpi.c:1935-1961 (activations parked until the
+# local replay discovers their task) + insert_function.h:131-142 (sliding
+# window). SURVEY §7 calls this interaction "easy to get subtly wrong";
+# randomized per-rank insertion delays force remote values to race ahead
+# of local discovery, a tiny window forces mid-insertion drain, and
+# pseudo-random placement churns affinity across all 4 ranks.
+
+def scenario_dtd_stress(ctx, engine, rank, nb_ranks, n_tasks=240):
+    import time as _t
+    from parsec_tpu.dsl import dtd
+    from parsec_tpu.utils import mca_param
+
+    class _FullVec(_DistVec):
+        # DTD replay reads placement tiles on EVERY rank — hold all
+        # keys; dc_id must be UNIQUE per collection (the tile registry
+        # keys by it; _DistVec's shared default would alias P and A)
+        def __init__(self, n, nb_ranks, my_rank, init=0.0, dc_id=61):
+            super().__init__(n, nb_ranks, my_rank, init)
+            self.v = {i: np.float32(init) for i in range(n)}
+            self.dc_id = dc_id
+
+    class _HashVec(_FullVec):
+        # placement churn: pseudo-random but replay-identical owner per
+        # index (a pure function of the key, same on every rank)
+        def rank_of(self, key):
+            k = self._k(key)
+            return (k * 2654435761 % 97) % self.nb_ranks
+
+    mca_param.set("dtd.window_size", 8)       # force mid-insertion drain
+    mca_param.set("dtd.threshold_size", 4)
+    try:
+        P = _HashVec(n_tasks, nb_ranks, rank, dc_id=61)   # placement
+        A = _FullVec(1, nb_ranks, rank, init=1.0, dc_id=62)  # datum
+        tp = dtd.Taskpool("stress")
+        ctx.add_taskpool(tp)
+        ctx.start()
+
+        def step(p, x, k=0):
+            # contractive map (factors 0.5..1.25, product < 1 per
+            # period): values stay O(1) over hundreds of steps, so the
+            # bitwise float32 comparison is meaningful
+            return np.float32(x * np.float32(0.5 + (k % 7) * 0.125)
+                              + np.float32(k % 3))
+
+        rng = np.random.default_rng(1000 + rank)   # DIFFERENT per rank
+        for k in range(n_tasks):
+            # the replay itself is identical on every rank; only the
+            # TIMING differs — this is what races remote activations
+            # against local discovery (the parked path)
+            if rng.random() < 0.2:
+                _t.sleep(float(rng.uniform(0, 0.004)))
+            tp.insert_task(
+                lambda p, x, k=k: step(p, x, k),
+                dtd.TileArg(P, (k,), dtd.INPUT, affinity=True),
+                dtd.TileArg(A, (0,), dtd.INOUT))
+        tp.wait()
+        tp.flush(A)
+        parked = tp.parked_activations
+    finally:
+        mca_param.unset("dtd.window_size")
+        mca_param.unset("dtd.threshold_size")
+    return (float(A.v[0]) if A.rank_of((0,)) == rank else None, parked)
+
+
+def test_dtd_stress_parked_4ranks():
+    """240-task INOUT chain with churned placement over 4 real
+    processes, randomized insertion timing, window=8: results must be
+    bitwise-identical to the single-rank execution AND the parked-
+    activation path must actually have fired somewhere."""
+    n_tasks = 240
+    res = _run_ranks("scenario_dtd_stress", 4, n_tasks=n_tasks,
+                     timeout=180.0)
+    # single-rank reference (same float32 op order)
+    x = np.float32(1.0)
+    for k in range(n_tasks):
+        x = np.float32(x * np.float32(0.5 + (k % 7) * 0.125)
+                       + np.float32(k % 3))
+    vals = [v for (v, _p) in res.values() if v is not None]
+    assert len(vals) == 1, res
+    assert vals[0] == float(x), (vals[0], float(x))
+    total_parked = sum(p for (_v, p) in res.values())
+    assert total_parked > 0, \
+        f"parked-activation path never fired: {res}"
+
+
 # ---- failure detection (peer death must abort, not hang) ----------------
 
 def _death_child(rank, nb_ranks, base_port, q):
