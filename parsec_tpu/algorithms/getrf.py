@@ -402,7 +402,17 @@ def _getrf_left_wave_fuser(wave, geoms):
     two-store split is ~0 ms/step. The final GETRF wave merges the U
     store back with one transpose+select (us.T lands exactly on the
     Aᵀ-store's U-tile region), so the executor's output contract (one
-    packed-LU array per collection) is unchanged."""
+    packed-LU array per collection) is unchanged.
+
+    Round-5 structure findings (N=32768, NB=1024, captured):
+    row panels are produced in A-LAYOUT (see do_update) so no
+    two-large-dims transpose appears in the graph; measured floor with
+    the sequential in-tile kernels stubbed is ~65 TF/s (run 0.358 s),
+    of which ~147 ms is slice/DUS/merge structure — the matmuls run at
+    ~73% MXU efficiency on their share. Variants measured SLOWER and
+    reverted: rank-2 base elimination (tile_kernels._lu_base note),
+    splitting the concat into two DUS writes (54.7 vs 56.9-59.7),
+    lax.dot_general axis-0 contractions (46.0)."""
     (geom,) = geoms.values()
     import jax
     import jax.numpy as jnp

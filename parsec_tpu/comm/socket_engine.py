@@ -606,9 +606,8 @@ class SocketCommEngine(CommEngine):
             # is teardown, not death — no job-kill. But anything still
             # IN FLIGHT toward that peer can never complete and must
             # fail promptly (not time out): sweep it with an orderly-
-            # shutdown diagnostic, abort only the taskpools those
-            # entries belong to, and fail a barrier this rank is
-            # blocked in (the departed peer won't enter it).
+            # shutdown diagnostic and abort only the taskpools those
+            # entries belong to (barriers stay untouched — see below).
             exc = ConnectionError(
                 f"rank {self.rank}: peer rank {peer} shut down with "
                 f"requests in flight ({why})")
