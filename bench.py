@@ -115,12 +115,14 @@ def _chain_timed(step_fn, state0, K, probe, reps=3, agg="median"):
     return (min(s) if agg == "min" else sorted(s)[reps // 2]) / K
 
 
-def _fused_timed(gen_fn, red_fn, key, probe, reps=3):
+def _fused_timed(gen_fn, red_fn, key, probe, reps=5):
     """Median run time of a donated fused program with a fresh
     link-latency sample per rep (the flagship's measurement recipe,
-    shared by the geqrf/getrf fused sections). Returns
-    (median_s, last output) — the caller residual-checks and then
-    deletes the output."""
+    shared by the geqrf/getrf fused sections). reps=5 (round 5, was 3):
+    the ±5%/run tunnel variance made 3-sample medians swing the GETRF
+    capture 54.7-59.7 across otherwise-identical runs; 5 samples cost
+    ~1 s more and tighten the median. Returns (median_s, last output) —
+    the caller residual-checks and then deletes the output."""
     import jax
     samples, out = [], None
     for i in range(reps):

@@ -436,6 +436,14 @@ def test_geqrf_hh_2ranks():
     _run_ranks("scenario_geqrf_hh", 2)
 
 
+def test_geqrf_hh_3ranks():
+    """Blocked-Householder QR with a 3-rank block-cyclic distribution:
+    PANEL/REDUCE's gathered fetches cross two remote owners per
+    column instead of one."""
+    _run_ranks("scenario_geqrf_hh", 3, m=192, n=96, nb=32,
+               timeout=180.0)
+
+
 def test_multi_activate_dedup_2ranks():
     _run_ranks("scenario_multi_activate", 2)
 
@@ -551,6 +559,16 @@ def scenario_potrf_thread_multiple(ctx, engine, rank, nb_ranks):
 def test_chain_2ranks_thread_multiple():
     res = _run_ranks("scenario_chain_thread_multiple", 2)
     assert len(res) == 2
+
+
+def test_chain_4ranks_thread_multiple():
+    """Direct worker sends under per-peer locks with FOUR ranks: more
+    concurrent direct senders per peer socket than 2 ranks ever
+    produce (the head-of-line/lock-discipline paths get real
+    contention)."""
+    res = _run_ranks("scenario_chain_thread_multiple", 4, n_steps=16,
+                     timeout=180.0)
+    assert len(res) == 4
 
 
 def test_potrf_2ranks_thread_multiple():
