@@ -173,25 +173,67 @@ def _measure_peak_gemm(n=8192, dtype="float32", iters=64, latency_s=0.0):
 _PEAK_ITERS = 192
 
 
+def _trimmed_median(vals):
+    """Median after dropping both extremes when there are ≥5 samples
+    (with 3 samples the median already ignores both). Even sample
+    counts average the two middle values — picking the upper-middle
+    would bias every even-capture p50 high before the 15% regression
+    comparison."""
+    s = sorted(vals)
+    if len(s) >= 5:
+        s = s[1:-1]
+    n = len(s)
+    if n % 2:
+        return s[n // 2]
+    return (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
 def _measure_latency(device_row: bool = False):
-    """BASELINE's second metric: p50 activate→data latency over the
-    socket comm engine. ``device_row=False`` → the eager + rendezvous
-    host-payload rows (run EARLY, right after the flagship: tunnel
-    latency degrades as the process accumulates heavy TPU work);
-    ``device_row=True`` → the device-resident payload row (every hop
-    pays real D2H/H2D through the tunnel — run LAST, it hammers the
-    link for minutes). The device row is decomposed into link cost
-    (raw 64 KB D2H + H2D through the tunnel, measured directly) vs
-    runtime cost (hop p50 minus link) — the same honesty split the
-    host-runtime dispatch number got."""
+    """BASELINE's second metric: activate→data latency over the socket
+    comm engine, reported as TRIMMED MEDIANS of ≥3 INTERLEAVED captures
+    with a stated variance bound (``*_p50_spread_pct`` =
+    (max−min)/median over the capture p50s). Round 5's single captures
+    disagreed by 36% same-day — a p50 that can't be reproduced can't be
+    steered, and the +20% rdv regression shipped partly because one
+    capture was indistinguishable from tunnel weather. Capture rounds
+    interleave the configs A/B (eager, rdv, eager, rdv, ...), so
+    minute-scale drift lands on every row instead of biasing whichever
+    ran last. ``PARSEC_BENCH_LAT_CAPTURES`` overrides the count.
+
+    ``device_row=False`` → the eager + rendezvous host-payload rows
+    (run EARLY, right after the flagship: tunnel latency degrades as
+    the process accumulates heavy TPU work); ``device_row=True`` → the
+    device-resident payload row (every hop pays real D2H/H2D through
+    the tunnel — run LAST, it hammers the link for minutes). The device
+    row is decomposed into link cost (raw 64 KB D2H + H2D through the
+    tunnel, measured directly) vs runtime cost (hop p50 minus link) —
+    the same honesty split the host-runtime dispatch number got."""
     from parsec_tpu.comm.pingpong import measure_latency
+    captures = max(1, int(os.environ.get("PARSEC_BENCH_LAT_CAPTURES", 3)))
+    if device_row:
+        rows = [("device_64k", dict(payload_bytes=1 << 16, hops=16,
+                                    device_payload=True))]
+    else:
+        rows = [("eager_1k", dict(payload_bytes=1024, hops=200)),
+                ("rdv_1M", dict(payload_bytes=1 << 20, hops=60,
+                                eager_limit=64 * 1024))]
     out = {}
     try:
+        samples = {name: [] for name, _ in rows}
+        for _ in range(captures):
+            for name, kw in rows:
+                samples[name].append(measure_latency(**kw))
+        for name, rs in samples.items():
+            p50s = [r["p50_us"] for r in rs]
+            med = _trimmed_median(p50s)
+            out[f"{name}_p50_us"] = round(med, 1)
+            out[f"{name}_p90_us"] = round(
+                _trimmed_median([r["p90_us"] for r in rs]), 1)
+            if len(p50s) > 1 and med > 0:
+                out[f"{name}_p50_spread_pct"] = round(
+                    (max(p50s) - min(p50s)) / med * 100, 1)
+        out["latency_captures"] = captures
         if device_row:
-            r = measure_latency(payload_bytes=1 << 16, hops=16,
-                                device_payload=True)
-            out["device_64k_p50_us"] = round(r["p50_us"], 1)
-            out["device_64k_p90_us"] = round(r["p90_us"], 1)
             # link-cost decomposition: time the raw tunnel transfers the
             # hop body pays (D2H snapshot at send, H2D stage at receive).
             # Each D2H sample uses a FRESH device array (jax.Array caches
@@ -199,6 +241,7 @@ def _measure_latency(device_row: bool = False):
             # array would time a local memcpy); the H2D is forced with a
             # device-side scalar fetch (block_until_ready alone has been
             # unreliable on the remote backend).
+            p50_med = out["device_64k_p50_us"]
             try:
                 import jax
                 import jax.numpy as jnp
@@ -224,8 +267,8 @@ def _measure_latency(device_row: bool = False):
                 out["device_64k_h2d_us"] = round(h2d_us, 1)
                 out["device_64k_link_us"] = round(link_us, 1)
                 out["device_64k_runtime_us"] = round(
-                    max(r["p50_us"] - link_us, 0.0), 1)
-                if link_us >= r["p50_us"]:
+                    max(p50_med - link_us, 0.0), 1)
+                if link_us >= p50_med:
                     # each raw transfer above pays its own blocking
                     # roundtrip; the hop pipeline overlaps part of that,
                     # so the sum can exceed the hop p50 — the row then
@@ -235,14 +278,6 @@ def _measure_latency(device_row: bool = False):
                         "time is tunnel D2H/H2D, not runtime overhead)")
             except Exception as exc:  # noqa: BLE001
                 out["device_64k_split_error"] = str(exc)[:120]
-            return out
-        r = measure_latency(payload_bytes=1024, hops=200)
-        out["eager_1k_p50_us"] = round(r["p50_us"], 1)
-        out["eager_1k_p90_us"] = round(r["p90_us"], 1)
-        r = measure_latency(payload_bytes=1 << 20, hops=60,
-                            eager_limit=64 * 1024)
-        out["rdv_1M_p50_us"] = round(r["p50_us"], 1)
-        out["rdv_1M_p90_us"] = round(r["p90_us"], 1)
     except Exception as exc:  # noqa: BLE001 — never sink the main metric
         out["error"] = str(exc)[:200]
     return out
@@ -559,7 +594,12 @@ def _section_geqrf():
 
 
 def _section_getrf():
-    """dgetrf_nopiv panel-fused (LU completes the factorization trio)."""
+    """dgetrf_nopiv panel-fused (LU completes the factorization trio).
+    Headline under ``getrf.trsm_hook=gemm`` — the diagonal-inversion
+    variant (lu_inv_tile: factor + both panel inverses in one
+    matmul-rich recursion, panel TRSMs as MXU matmuls) — with the
+    exact-solve variant's gflops AND residual reported side by side at
+    a bounded n, mirroring the POTRF precision-variant contract."""
     import jax
     import jax.numpy as jnp
     from parsec_tpu.algorithms.getrf import build_getrf_left, getrf_flops
@@ -575,50 +615,74 @@ def _section_getrf():
     nl, nbl = (32768, 1024) if on_tpu else (256, 64)
     nl = int(os.environ.get("PARSEC_BENCH_LU_N", nl))
     nbl = int(os.environ.get("PARSEC_BENCH_LU_NB", nbl))
-    # benchmark fast path (library default = exact solves)
-    mca_param.set("potrf.trsm_hook", "gemm")
-    Al = TiledMatrix(nl, nl, nbl, nbl, name="A")
-    exl = PanelExecutor(plan_taskpool(build_getrf_left(Al)))
 
-    def gen_l(key):
-        R = jax.random.normal(key, (nl, nl), jnp.float32)
-        return {"A": R.at[jnp.arange(nl), jnp.arange(nl)].add(2.0 * nl)}
+    def fused_run(n, nb):
+        Al = TiledMatrix(n, n, nb, nb, name="A")
+        exl = PanelExecutor(plan_taskpool(build_getrf_left(Al)))
 
-    gen_lj = jax.jit(gen_l)
+        def gen_l(key):
+            R = jax.random.normal(key, (n, n), jnp.float32)
+            return {"A": R.at[jnp.arange(n), jnp.arange(n)].add(2.0 * n)}
 
-    def run_l(st):
-        o = exl.run_state(st)
-        return jnp.sum(o["A"]), o
+        gen_lj = jax.jit(gen_l)
 
-    red_l = jax.jit(run_l, donate_argnums=0)
-    t0 = time.perf_counter()
-    tot, ol = red_l(gen_lj(jax.random.PRNGKey(11)))
-    float(tot)
-    compile_l = time.perf_counter() - t0
-    del ol
-    dtl, ol = _fused_timed(gen_lj, red_l, jax.random.PRNGKey(11), probe)
+        def run_l(st):
+            o = exl.run_state(st)
+            return jnp.sum(o["A"]), o
 
-    def resid_l(o, key):
-        x = jax.random.normal(jax.random.fold_in(key, 5), (nl, 8),
-                              jnp.float32)
-        D0 = gen_l(key)["A"]
-        Ax = D0.T @ x
-        P = o["A"].T
-        from parsec_tpu.ops.tile_kernels import lu_split
-        L, U = lu_split(P)
-        LUx = L @ (U @ x)
-        return jnp.linalg.norm(LUx - Ax) / jnp.linalg.norm(Ax)
+        red_l = jax.jit(run_l, donate_argnums=0)
+        t0 = time.perf_counter()
+        tot, ol = red_l(gen_lj(jax.random.PRNGKey(11)))
+        float(tot)
+        compile_l = time.perf_counter() - t0
+        del ol
+        dtl, ol = _fused_timed(gen_lj, red_l, jax.random.PRNGKey(11),
+                               probe)
 
-    with jax.default_matmul_precision("highest"):
-        errl = float(jax.jit(resid_l)(ol, jax.random.PRNGKey(11)))
-    del ol
-    return {"getrf_fused": {
-        "n": nl, "tile": nbl, "taskpool": "getrf_left",
-        "executor": "panel_fused",
-        "gflops": round(getrf_flops(nl) / dtl / 1e9, 1),
-        "run_s": round(dtl, 4),
-        "compile_s": round(compile_l, 2),
-        "rel_residual_check": float(f"{errl:.3e}")}}
+        def resid_l(o, key):
+            x = jax.random.normal(jax.random.fold_in(key, 5), (n, 8),
+                                  jnp.float32)
+            D0 = gen_l(key)["A"]
+            Ax = D0.T @ x
+            P = o["A"].T
+            from parsec_tpu.ops.tile_kernels import lu_split
+            L, U = lu_split(P)
+            LUx = L @ (U @ x)
+            return jnp.linalg.norm(LUx - Ax) / jnp.linalg.norm(Ax)
+
+        with jax.default_matmul_precision("highest"):
+            errl = float(jax.jit(resid_l)(ol, jax.random.PRNGKey(11)))
+        del ol
+        return {"n": n, "tile": nb,
+                "gflops": round(getrf_flops(n) / dtl / 1e9, 1),
+                "run_s": round(dtl, 4),
+                "compile_s": round(compile_l, 2),
+                "rel_residual_check": float(f"{errl:.3e}")}
+
+    try:
+        # benchmark fast path (library default = exact solves via the
+        # "inherit" → potrf.trsm_hook chain)
+        mca_param.set("getrf.trsm_hook", "gemm")
+        r = fused_run(nl, nbl)
+        r.update({"taskpool": "getrf_left", "executor": "panel_fused",
+                  "trsm_hook": "gemm"})
+        # exact-solve variant side by side (reference numerics): the
+        # inversion headline's residual claim needs the solve-mode
+        # number next to it; bounded n keeps the extra compile in check
+        try:
+            nv = min(nl, int(os.environ.get("PARSEC_BENCH_LU_VARIANT_N",
+                                            16384)))
+            mca_param.set("getrf.trsm_hook", "solve")
+            rv = fused_run(nv, nbl)
+            r["solve_variant"] = {
+                "n": nv, "trsm_hook": "solve",
+                "gflops": rv["gflops"],
+                "rel_residual_check": rv["rel_residual_check"]}
+        except Exception as exc:  # noqa: BLE001 — keep the headline row
+            r["solve_variant"] = {"error": str(exc)[:200]}
+    finally:
+        mca_param.unset("getrf.trsm_hook")
+    return {"getrf_fused": r}
 
 
 def _section_ooc():
@@ -719,8 +783,9 @@ _SECTION_KEYS = {
 }
 
 # geqrf stacks three programs (per-tile stress + 94-wave fused + the
-# highest-precision variant) — give it compile headroom on a cold cache
-_SECTION_TIMEOUT = {"geqrf": 3600, "getrf": 2700}
+# highest-precision variant) — give it compile headroom on a cold
+# cache; getrf now stacks two (gemm headline + solve variant)
+_SECTION_TIMEOUT = {"geqrf": 3600, "getrf": 3600}
 
 
 def _run_section(name):
@@ -758,42 +823,140 @@ def _run_section(name):
     return {k: {"error": last_err} for k in _SECTION_KEYS[name]}
 
 
-def _latency_regression_guard(latency: dict, threshold: float = 0.15):
-    """Round-5 drift guard (the eager p50 walked 537 → 687 µs across
-    rounds 2-4 with nothing pinning it): compare this run's latency p50s
-    against the newest ``BENCH_r*.json`` in the repo root and record a
-    ``latency_regression`` warning field when any worsens by more than
-    ``threshold``. Purely observational — the bench never fails on it."""
+# ---------------------------------------------------------------------------
+# Regression guards vs the prior round's capture (round 6: the round-5
+# GETRF and flagship throughput slips SHIPPED because only latency rows
+# had a guard; this generalizes the mechanism to every GFLOPS row).
+# Both guards are purely observational — the bench never fails on them.
+# ---------------------------------------------------------------------------
+
+# compact-summary keys guarded: GFLOPS rows fire on a DROP, latency p50
+# rows on a RISE
+_GFLOPS_GUARD_KEYS = ("value", "gemm_panel_fused_gflops",
+                      "host_dtd_gflops", "geqrf_fused_gflops",
+                      "getrf_fused_gflops", "flash_gflops",
+                      "precision_gflops")
+_LATENCY_GUARD_KEYS = ("eager_1k_p50_us", "rdv_1M_p50_us",
+                       "device_64k_p50_us")
+
+
+def _flatten_summary(summary: dict) -> dict:
+    """Compact-summary dict → the flat key space both guard sides
+    compare (detail keys + the headline ``value``). ONE helper for the
+    current run and the prior capture — two copies of this flatten
+    could drift and silently desynchronize the compared key spaces."""
+    flat = dict(summary.get("detail") or {})
+    if isinstance(summary.get("value"), (int, float)):
+        flat["value"] = summary["value"]
+    return flat
+
+
+def _parse_capture_file(path):
+    """One ``BENCH_r*.json`` → ``(basename, flat compact-detail dict)``.
+    Parsed as JSON (ADVICE r5 #3: the old guard regexed the file and
+    took the FIRST occurrence of each key — the driver record contains
+    most keys twice, once in the captured-stdout tail's full-detail
+    fragment and once in the compact summary, occasionally with
+    different values). The driver wraps the bench's compact summary
+    under ``"parsed"``; a bare result dict is accepted too."""
+    with open(path) as f:
+        rec = json.load(f)
+    summary = rec.get("parsed") if isinstance(rec.get("parsed"), dict) \
+        else rec
+    if not isinstance(summary, dict):
+        return os.path.basename(path), {}
+    return os.path.basename(path), _flatten_summary(summary)
+
+
+def _load_prior_capture():
+    """Newest ``BENCH_r*.json`` next to this file, parsed; returns
+    ``(basename, flat dict)`` or ``(None, {})``."""
     import glob
     import re
+    prior_files = sorted(
+        glob.glob(os.path.join(_HERE, "BENCH_r*.json")),
+        key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p))
+                          .group(1)))
+    if not prior_files:
+        return None, {}
+    return _parse_capture_file(prior_files[-1])
+
+
+def _compare_captures(cur: dict, prior: dict, gflops_drop: float = 0.10,
+                      latency_rise: float = 0.15) -> dict:
+    """The generic guard core: compare flat compact-detail dicts and
+    return ``{"throughput_regression": ...}`` for every GFLOPS row more
+    than ``gflops_drop`` UNDER the prior capture and
+    ``{"latency_regression": ...}`` for every p50 more than
+    ``latency_rise`` OVER it. Rows missing on either side are skipped
+    (a failed section must not read as a regression)."""
+    out = {}
+    drops, rises = [], []
+    for key in _GFLOPS_GUARD_KEYS:
+        c, p = cur.get(key), prior.get(key)
+        if not isinstance(c, (int, float)) or \
+                not isinstance(p, (int, float)) or p <= 0:
+            continue
+        if (p - c) / p > gflops_drop:
+            drops.append(f"{key}: {p:.1f} -> {c:.1f} gflops "
+                         f"(-{(p - c) / p * 100:.0f}%)")
+    for key in _LATENCY_GUARD_KEYS:
+        c, p = cur.get(key), prior.get(key)
+        if not isinstance(c, (int, float)) or \
+                not isinstance(p, (int, float)) or p <= 0:
+            continue
+        if (c - p) / p > latency_rise:
+            rises.append(f"{key}: {p:.1f} -> {c:.1f} us "
+                         f"(+{(c - p) / p * 100:.0f}%)")
+    if drops:
+        out["throughput_regression"] = "; ".join(drops)
+    if rises:
+        out["latency_regression"] = "; ".join(rises)
+    return out
+
+
+def _latency_regression_guard(latency: dict):
+    """Latency-row guard pass (runs EARLY, right after the host-payload
+    rows are measured, and again once the device row exists). The
+    GFLOPS rows get the same comparison at the end of main() via
+    :func:`_throughput_regression_guard`."""
     try:
-        prior_files = sorted(
-            glob.glob(os.path.join(_HERE, "BENCH_r*.json")),
-            key=lambda p: int(re.search(r"r(\d+)", os.path.basename(p))
-                              .group(1)))
-        if not prior_files:
+        base, prior = _load_prior_capture()
+        if not prior:
             return
-        with open(prior_files[-1]) as f:
-            txt = f.read()
-        # the driver file wraps our final JSON line inside its own
-        # record; the detail keys are unique enough to regex out
-        regressions = []
-        for key in ("eager_1k_p50_us", "rdv_1M_p50_us",
-                    "device_64k_p50_us"):
-            cur = latency.get(key)
-            m = re.search(rf'\\?"{key}\\?":\s*([0-9.]+)', txt)
-            if cur is None or m is None:
-                continue
-            prev = float(m.group(1))
-            if prev > 0 and (cur - prev) / prev > threshold:
-                regressions.append(
-                    f"{key}: {prev:.1f} -> {cur:.1f} us "
-                    f"(+{(cur - prev) / prev * 100:.0f}%)")
-        if regressions:
-            latency["latency_regression"] = "; ".join(regressions) + \
-                f" vs {os.path.basename(prior_files[-1])}"
+        cmp = _compare_captures(latency, prior)
+        if "latency_regression" in cmp:
+            latency["latency_regression"] = \
+                cmp["latency_regression"] + f" vs {base}"
     except Exception as exc:  # noqa: BLE001 — guard must never sink bench
         latency["latency_regression_guard_error"] = str(exc)[:120]
+
+
+def _flat_gflops(result: dict) -> dict:
+    """Flatten a full result dict to the compact-summary key space the
+    guard compares — derived FROM :func:`_compact_summary` itself, so
+    the guard can never drift from what the summary (and hence the
+    NEXT round's parsed prior capture) actually carries. A
+    hand-mirrored pick list here would silently un-guard any row whose
+    summary key is later added or renamed."""
+    return _flatten_summary(json.loads(_compact_summary(result)))
+
+
+def _throughput_regression_guard(result: dict):
+    """Record ``detail.throughput_regression`` for any GFLOPS row >10%
+    under the prior round's capture (it also lands in the compact
+    summary) — the guard that would have flagged POTRF 109.8 → 104.8
+    and flash 90.4 → 86.4 instead of letting them drift."""
+    try:
+        base, prior = _load_prior_capture()
+        if not prior:
+            return
+        cmp = _compare_captures(_flat_gflops(result), prior)
+        if "throughput_regression" in cmp:
+            result["detail"]["throughput_regression"] = \
+                cmp["throughput_regression"] + f" vs {base}"
+    except Exception as exc:  # noqa: BLE001 — guard must never sink bench
+        result["detail"]["throughput_guard_error"] = str(exc)[:120]
 
 
 def _compact_summary(result):
@@ -830,14 +993,27 @@ def _compact_summary(result):
             "flash_gflops": pick("transformer", "flash_gflops"),
             "eager_1k_p50_us": d.get("latency", {}).get("eager_1k_p50_us"),
             "rdv_1M_p50_us": d.get("latency", {}).get("rdv_1M_p50_us"),
+            # the hop p50 itself, not only the runtime share: the
+            # regression guard parses the NEXT round's prior from this
+            # summary, so a key absent here is a key it cannot guard
+            "device_64k_p50_us": d.get("latency", {}).get(
+                "device_64k_p50_us"),
             "device_64k_runtime_us": d.get("latency", {}).get(
                 "device_64k_runtime_us"),
             "full_detail": "BENCH_DETAIL.json",
         },
     }
+    for k in ("eager_1k_p50_spread_pct", "rdv_1M_p50_spread_pct",
+              "device_64k_p50_spread_pct", "latency_captures"):
+        v = d.get("latency", {}).get(k)
+        if v is not None:      # the capture-variance bound, judge-facing
+            compact["detail"][k] = v
     reg = d.get("latency", {}).get("latency_regression")
     if reg:              # only when firing — the final line is size-capped
         compact["detail"]["latency_regression"] = reg
+    treg = d.get("throughput_regression")
+    if treg:
+        compact["detail"]["throughput_regression"] = treg
     line = json.dumps(compact)
     if len(line) > 2000:          # belt-and-braces: shed detail, keep
         compact["detail"] = {"full_detail": "BENCH_DETAIL.json"}
@@ -1164,6 +1340,10 @@ def main():
         },
     }
 
+    # generic throughput guard: every GFLOPS row vs the prior round's
+    # parsed capture (latency rows were guarded above)
+    _throughput_regression_guard(result)
+
     # full blob: to disk + an EARLY line; compact summary is the FINAL
     # line (driver parses the tail — round 3 lost its headline when the
     # full blob outgrew the 4 KB capture window)
@@ -1220,9 +1400,15 @@ def render_parity():
                      tf(gq["gflops"]), pct(gq["gflops"]), note))
     gl = x.get("getrf_fused", {})
     if gl.get("gflops"):
-        rows.append((f"tiled GETRF fused (N={gl.get('n')})",
-                     tf(gl["gflops"]), pct(gl["gflops"]),
-                     f"residual {gl.get('rel_residual_check')}"))
+        note = f"residual {gl.get('rel_residual_check')}"
+        sv = gl.get("solve_variant") or {}
+        if sv.get("gflops"):
+            note += (f"; exact-solve {tf(sv['gflops'])} at residual "
+                     f"{sv.get('rel_residual_check')} (N={sv.get('n')})")
+        hook = gl.get("trsm_hook")
+        cfg = f"tiled GETRF fused (N={gl.get('n')}" + \
+            (f", trsm_hook={hook})" if hook else ")")
+        rows.append((cfg, tf(gl["gflops"]), pct(gl["gflops"]), note))
     gm = x.get("dtd_gemm", {})
     if gm.get("panel_fused_gflops"):
         rows.append((
@@ -1253,21 +1439,40 @@ def render_parity():
             f"{hm.get('spills', '?')} spills, residual "
             f"{oc.get('rel_residual')}"))
     if lat.get("eager_1k_p50_us"):
-        note = ""
+        # the capture-variance bound rides with the number: a p50
+        # without its spread can't be compared across rounds
+        caps = lat.get("latency_captures")
+        spreads = []
+        for nm in ("eager_1k", "rdv_1M"):
+            sp = lat.get(f"{nm}_p50_spread_pct")
+            if sp is not None:
+                spreads.append(f"{nm} ±{sp}%")
+        note = (f"trimmed median of {caps} interleaved captures"
+                if caps else "")
+        if spreads:
+            note += f"; spread {', '.join(spreads)}"
         if lat.get("latency_regression"):
-            note = f"REGRESSION: {lat['latency_regression']}"
+            note = f"REGRESSION: {lat['latency_regression']}; " + note
         rows.append((
             "remote-dep latency (socket engine)",
             f"eager 1 KB p50 {lat['eager_1k_p50_us']} µs; "
             f"rdv 1 MB p50 {lat.get('rdv_1M_p50_us')} µs", "—", note))
+    if d.get("throughput_regression"):
+        rows.append(("throughput regression guard (>10% vs prior "
+                     "round)", "FIRED", "—",
+                     d["throughput_regression"]))
     if lat.get("device_64k_p50_us"):
-        rows.append((
-            "device-payload 64 KB hop (D2H + wire + H2D)",
-            f"p50 {lat['device_64k_p50_us'] / 1000:.1f} ms", "—",
+        note = (
             f"link-decomposed: raw D2H {lat.get('device_64k_d2h_us', 0) / 1000:.1f}"
             f" + H2D {lat.get('device_64k_h2d_us', 0) / 1000:.1f} ms "
             f"cover the hop; runtime share "
-            f"{lat.get('device_64k_runtime_us', 0) / 1000:.1f} ms"))
+            f"{lat.get('device_64k_runtime_us', 0) / 1000:.1f} ms")
+        dsp = lat.get("device_64k_p50_spread_pct")
+        if dsp is not None:
+            note += f"; spread ±{dsp}%"
+        rows.append((
+            "device-payload 64 KB hop (D2H + wire + H2D)",
+            f"p50 {lat['device_64k_p50_us'] / 1000:.1f} ms", "—", note))
 
     import datetime
     mtime = datetime.datetime.fromtimestamp(

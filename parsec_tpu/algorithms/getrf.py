@@ -30,6 +30,31 @@ from ..ops.tile_kernels import (gemm_tile, getrf_nopiv_tile,
                                 trsm_lower_unit, trsm_upper_right)
 from ..utils import mca_param
 
+# Compiled-path panel-TRSM kernel for the fused LU — the POTRF
+# trsm_hook ported to BOTH LU solve stages (the structural delta vs the
+# Cholesky fuser: LU pays TWO triangular panel solves per step where
+# POTRF pays one). "gemm" factors the diagonal tile and derives L⁻¹/U⁻¹
+# in ONE matmul-rich Schur recursion (ops.lu_inv_tile), so the column
+# panel (·U⁻¹, via U⁻ᵀ on the transposed store) and row panel (L⁻¹·)
+# each run as one MXU matmul; it squares the factors' condition-number
+# contribution, same trade as POTRF's knob. "inherit" (default) follows
+# potrf.trsm_hook so existing callers that set the POTRF knob keep
+# getting the coupled behavior shipped through round 5.
+mca_param.register("getrf.trsm_hook", "inherit",
+                   help="compiled-path panel-TRSM kernel for the fused "
+                        "LU: solve (exact wide triangular solves, "
+                        "reference numerics) | gemm (diagonal-inversion "
+                        "MXU matmuls via lu_inv_tile; squares the "
+                        "factors' condition-number contribution) | "
+                        "inherit (follow potrf.trsm_hook)")
+
+
+def _trsm_inv_mode() -> bool:
+    hook = str(mca_param.get("getrf.trsm_hook", "inherit"))
+    if hook == "inherit":
+        hook = str(mca_param.get("potrf.trsm_hook", "solve"))
+    return hook == "gemm"
+
 
 def _check(A: TiledMatrix) -> int:
     if A.mt != A.nt:
@@ -412,12 +437,22 @@ def _getrf_left_wave_fuser(wave, geoms):
     ~73% MXU efficiency on their share. Variants measured SLOWER and
     reverted: rank-2 base elimination (tile_kernels._lu_base note),
     splitting the concat into two DUS writes (54.7 vs 56.9-59.7),
-    lax.dot_general axis-0 contractions (46.0)."""
+    lax.dot_general axis-0 contractions (46.0).
+
+    Round-6 rework (getrf.trsm_hook=gemm): the sequential per-step tail
+    was the in-tile LU (two triangular solves per recursion level) PLUS
+    two standalone nb-sized tri_inv_tile recursions in the TRSM wave.
+    ``lu_inv_tile`` folds all three into one Schur recursion whose
+    panel solves are matmuls against the child inverses — triangular
+    solves survive only at the ≤64 base case — and the GETRF wave
+    stashes L⁻¹/U⁻¹ in the carry so the TRSM wave is two pure MXU
+    matmuls (exactly POTRF's stash-the-inverse shape)."""
     (geom,) = geoms.values()
     import jax
     import jax.numpy as jnp
-    from ..ops.tile_kernels import (getrf_nopiv_tile, lu_split,
-                                    matmul_precision, tri_inv_tile)
+    from ..ops.tile_kernels import (getrf_nopiv_tile, lu_inv_tile,
+                                    lu_split, matmul_precision,
+                                    tri_inv_tile)
 
     prec = matmul_precision()
 
@@ -428,7 +463,7 @@ def _getrf_left_wave_fuser(wave, geoms):
     names = sorted(g.tc.name for g in wave)
     mb, nb = geom.mb, geom.nb
     MT, NT = geom.mt, geom.nt
-    inv_mode = mca_param.get("potrf.trsm_hook", "solve") == "gemm"
+    inv_mode = _trsm_inv_mode()
 
     if names in (["UPDC"], ["UPDC", "UPDR"]):
         updc = next(g for g in wave if g.tc.name == "UPDC")
@@ -481,7 +516,16 @@ def _getrf_left_wave_fuser(wave, geoms):
             colk = st.pop("_lu_col", None)
             diag = colk[:, :nb].T if colk is not None \
                 else D[c, k * mb:(k + 1) * mb].T
-            LU = getrf_nopiv_tile(diag)
+            if inv_mode and not last:
+                # factor + both inverses in ONE matmul-rich recursion;
+                # the TRSM wave consumes the stashed inverses as plain
+                # matmuls (POTRF's _potrf_inv carry, for both stages).
+                # The last step has no TRSM wave — plain factor.
+                LU, Linv, Uinv = lu_inv_tile(diag)
+                st["_lu_Linv"] = Linv
+                st["_lu_Uinv"] = Uinv
+            else:
+                LU = getrf_nopiv_tile(diag)
             st["_lu_T"] = LU
             if last:
                 D = D.at[c, k * mb:].set(LU.T)
@@ -521,7 +565,6 @@ def _getrf_left_wave_fuser(wave, geoms):
             LU = st.pop("_lu_T", None)
             if LU is None:
                 LU = D[c, k * mb:(k + 1) * mb].T
-            L, U = lu_split(LU)
             col = st.pop("_lu_col_rest", None)
             if col is None:       # k == 0: no update wave preceded
                 col = D[c, (k + 1) * mb:]
@@ -529,13 +572,21 @@ def _getrf_left_wave_fuser(wave, geoms):
             if rowA is None:
                 rowA = D[(k + 1) * nb:, k * mb:(k + 1) * mb].T
             if inv_mode:
-                # MAGMA-style: invert the nb-sized factors once, every
-                # panel solve becomes one MXU matmul
-                Uinv = tri_inv_tile(U.T).T     # via lower-tri inversion
-                Linv = tri_inv_tile(L)
+                # MAGMA-style: both panel solves are MXU matmuls
+                # against the inverses the GETRF wave stashed (derived
+                # inside the factorization recursion — no standalone
+                # tri_inv_tile passes)
+                Linv = st.pop("_lu_Linv", None)
+                Uinv = st.pop("_lu_Uinv", None)
+                if Linv is None or Uinv is None:
+                    # robustness: recompute from the packed factor
+                    L, U = lu_split(LU)
+                    Linv = tri_inv_tile(L) if Linv is None else Linv
+                    Uinv = tri_inv_tile(U.T).T if Uinv is None else Uinv
                 solved_col = mm(Uinv.T, col)       # (U^-T)·colᵀ
                 solved_rowA = mm(Linv, rowA)       # L^-1·A[k, j>k]
             else:
+                L, U = lu_split(LU)
                 solved_col = jax.lax.linalg.triangular_solve(
                     U, col, left_side=True, lower=False,
                     transpose_a=True)

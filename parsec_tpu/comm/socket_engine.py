@@ -148,8 +148,14 @@ class SocketCommEngine(CommEngine):
             self._wireup()
 
     def _post_cmd(self, cmd: Tuple) -> None:
-        """Enqueue a command for the comm thread and kick its selector."""
+        """Enqueue a command for the comm thread and kick its selector —
+        unless the CALLER is the comm thread: it drains the queue at the
+        top of its next iteration before selecting again, so the kick
+        would be a wasted syscall plus a token to drain per handler-
+        originated send (two per rendezvous leg on the round-5 path)."""
         self._cmd_q.put(cmd)
+        if threading.get_ident() == getattr(self, "_comm_tid", None):
+            return
         try:
             self._wake_w.send(b"x")
         except (BlockingIOError, OSError):
@@ -344,28 +350,64 @@ class SocketCommEngine(CommEngine):
             self._send_frame(dst, tag, msg)
         return n
 
-    def _encode_frame(self, tag: int, msg: Any) -> bytearray:
-        """Serialize one frame. Wire format (raw-bytes framing for array
-        payloads — the reference's datatype pack path,
-        parsec_comm_engine.h:113-183): ``!Q total_len``, ``!I
-        pickle_len``, the protocol-5 pickle, then each out-of-band
-        buffer as ``!Q len`` + raw bytes. Contiguous numpy array
-        payloads travel as raw memory (one memcpy into the tx buffer)
-        instead of being re-serialized through the pickle stream."""
+    def _encode_parts(self, tag: int, msg: Any) -> Tuple[List[Any], int]:
+        """Serialize one frame as scatter-gather parts. Wire format
+        (unchanged from the round-5 single-buffer encoder): ``!Q
+        total_len``, ``!I pickle_len``, the protocol-5 pickle, then each
+        out-of-band buffer as ``!Q len`` + raw bytes (the reference's
+        datatype pack path, parsec_comm_engine.h:113-183). Control bytes
+        land in one small bytearray; each contiguous array payload stays
+        a ZERO-COPY memoryview over the producer's buffer — the send
+        paths hand the list to ``sendmsg``, so a rendezvous-sized PUT
+        pays no Python-side payload copy on the happy path (the round-5
+        encoder copied the payload into the frame AND the frame into
+        txbuf: two full copies per large frame). Returns
+        ``(parts, frame_nbytes)``."""
         bufs: List[pickle.PickleBuffer] = []
         payload = pickle.dumps((int(tag), self.rank, msg),
                                protocol=5, buffer_callback=bufs.append)
         raws = [b.raw() for b in bufs]
         total = _U32.size + len(payload) + sum(
             _HDR.size + r.nbytes for r in raws)
-        out = bytearray()
-        out += _HDR.pack(total)
-        out += _U32.pack(len(payload))
-        out += payload
+        head = bytearray()
+        head += _HDR.pack(total)
+        head += _U32.pack(len(payload))
+        head += payload
+        parts: List[Any] = [head]
         for r in raws:
-            out += _HDR.pack(r.nbytes)
-            out += r
-        return out
+            parts.append(_HDR.pack(r.nbytes))
+            parts.append(r)
+        return parts, _HDR.size + total
+
+    def _write_parts_locked(self, dst: int, s: socket.socket,
+                            parts: List[Any]) -> Optional[OSError]:
+        """Write frame parts to the peer socket as far as the kernel
+        accepts (send lock held, socket non-blocking); any unsent
+        remainder is queued on txbuf for ``_flush_sends`` — txbuf bytes
+        always precede new frames, so framing stays intact. Returns the
+        OSError of a failed send (the caller handles peer teardown
+        OUTSIDE the lock — _mark_peer_dead takes it), else None."""
+        views = [memoryview(p) for p in parts]
+        i = 0
+        while i < len(views):
+            try:
+                sent = s.sendmsg(views[i:i + 64])    # IOV_MAX headroom
+            except BlockingIOError:
+                break
+            except OSError as exc:
+                return exc
+            if not sent:
+                break
+            while i < len(views) and sent >= views[i].nbytes:
+                sent -= views[i].nbytes
+                i += 1
+            if sent:
+                views[i] = views[i][sent:]
+        if i < len(views):
+            buf = self._txbuf[dst]
+            for v in views[i:]:
+                buf += v
+        return None
 
     def _count_sent(self, frame_bytes: int) -> None:
         with self._stats_lock:
@@ -373,20 +415,32 @@ class SocketCommEngine(CommEngine):
             self._stats["bytes_sent"] += frame_bytes
 
     def _send_frame(self, dst: int, tag: int, msg: Any) -> None:
-        """Queue one frame on the peer's outbound buffer (comm thread).
-        Non-blocking sends prevent the head-of-line deadlock of two
-        ranks pushing large frames at each other with full TCP
-        buffers. The lock acquire is bounded: _direct_send never holds
-        the per-peer lock across a wait (it hands unsent remainders to
-        txbuf instead of select()-ing under the lock)."""
+        """Send one frame from the COMM THREAD: write straight to the
+        socket when nothing is queued (the common case — saves a full
+        frame copy into txbuf plus one flush iteration of latency;
+        round 5 queued unconditionally, which cost the rendezvous PUT
+        path an extra 1 MB copy AND a loop turnaround per leg), else
+        append behind the queued bytes. Non-blocking sends prevent the
+        head-of-line deadlock of two ranks pushing large frames at each
+        other with full TCP buffers; no wait ever happens under the
+        per-peer lock (unsent remainders go to txbuf)."""
         if dst in self._dead_peers:
             debug_verbose(3, "comm", "rank %d: dropping frame for dead "
                           "peer %d", self.rank, dst)
             return
-        frame = self._encode_frame(tag, msg)
+        parts, nbytes = self._encode_parts(tag, msg)
+        s = self._socks.get(dst)
+        failed: Optional[OSError] = None
         with self._send_locks[dst]:
-            self._txbuf[dst] += frame
-        self._count_sent(len(frame))
+            buf = self._txbuf[dst]
+            if buf or s is None:
+                for p in parts:
+                    buf += p
+            else:
+                failed = self._write_parts_locked(dst, s, parts)
+        self._count_sent(nbytes)
+        if failed is not None:
+            self._mark_peer_dead(dst, f"send failed: {failed}")
 
     def _direct_send(self, dst: int, tag: int, msg: Any) -> None:
         """comm.thread_multiple send path: write the frame to the peer
@@ -400,8 +454,7 @@ class SocketCommEngine(CommEngine):
         draining and the ranks deadlock."""
         if dst in self._dead_peers:
             return                # drop before paying the encode
-        frame = self._encode_frame(tag, msg)
-        nbytes = len(frame)
+        parts, nbytes = self._encode_parts(tag, msg)
         lock = self._send_locks[dst]
         s = self._socks.get(dst)
         queued = False
@@ -411,26 +464,17 @@ class SocketCommEngine(CommEngine):
                 return            # drop, like the funnelled path
             pending = self._txbuf[dst]
             if pending:
-                pending += frame      # keep ordering behind queued bytes
+                for p in parts:   # keep ordering behind queued bytes
+                    pending += p
                 queued = True
             else:
-                view = memoryview(frame)
-                while view.nbytes:
-                    try:
-                        n = s.send(view)
-                        view = view[n:]
-                    except BlockingIOError:
-                        pending += view
-                        queued = True
-                        break
-                    except OSError as exc:
-                        # mid-frame send failure: the byte stream to
-                        # this peer is desynchronized beyond repair —
-                        # tear the peer down (on the comm thread) so
-                        # later sends drop cleanly instead of framing
-                        # garbage after a partial frame
-                        failed = exc
-                        break
+                # scatter-gather write; on a mid-frame send failure the
+                # byte stream to this peer is desynchronized beyond
+                # repair — tear the peer down (on the comm thread) so
+                # later sends drop cleanly instead of framing garbage
+                # after a partial frame
+                failed = self._write_parts_locked(dst, s, parts)
+                queued = bool(self._txbuf[dst])
         self._count_sent(nbytes)
         if failed is not None:
             self._post_cmd(("peer_dead", dst,
@@ -502,9 +546,12 @@ class SocketCommEngine(CommEngine):
                 (ln,) = _HDR.unpack_from(buf, 0)
                 if len(buf) < _HDR.size + ln:
                     break
-                # bytearray: arrays reconstructed over the out-of-band
-                # views must be writable (bodies may update in place)
-                frame = bytearray(buf[_HDR.size:_HDR.size + ln])
+                # slicing a bytearray yields a (writable) bytearray —
+                # arrays reconstructed over the out-of-band views below
+                # may be updated in place by bodies. (Round 5 wrapped
+                # the slice in an extra bytearray(), paying a second
+                # full-frame copy per received frame.)
+                frame = buf[_HDR.size:_HDR.size + ln]
                 del buf[:_HDR.size + ln]
                 (plen,) = _U32.unpack_from(frame, 0)
                 off = _U32.size
@@ -693,6 +740,19 @@ class SocketCommEngine(CommEngine):
             return
         if self._thread_multiple():
             self._direct_send(dst_rank, tag, msg)
+            return
+        if tag in (AMTag.GET_DATA, AMTag.PUT_DATA) and \
+                threading.get_ident() == getattr(self, "_comm_tid", None):
+            # rendezvous fast path: GET requests and PUT replies
+            # originate on the comm thread (the activation/GET
+            # handlers), which owns the sockets — sending inline skips
+            # a command-queue round trip per rendezvous leg (two legs
+            # per large payload; part of the round-5 +20% rdv_1M p50
+            # regression). Restricted to the rendezvous request/reply
+            # tags: they are handle-addressed, so overtaking frames
+            # still queued for this peer cannot break any ordering
+            # contract (per-peer ACTIVATE ordering stays queue-driven).
+            self._send_frame(dst_rank, tag, msg)
             return
         self._post_cmd(("am", tag, dst_rank, msg))
 

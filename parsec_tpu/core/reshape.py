@@ -21,6 +21,7 @@ conversion of an HBM-resident tile runs on-device with no host bounce.
 from __future__ import annotations
 
 import itertools
+import weakref
 from typing import Any, Callable, Optional, Tuple
 
 _spec_ids = itertools.count(1)
@@ -37,13 +38,17 @@ class ReshapeSpec:
     stacks ``(batch, mb, nb)``, ``fn`` must be batch-safe — operate on
     the last two axes only (dtype/transpose are batch-safe by
     construction). The host runtime applies specs per value.
-    ``name``: identity for caching — two specs with the same name are the
-    same conversion. Specs built only from dtype/transpose get a canonical
-    name automatically; specs with ``fn`` get a unique one unless named.
-    The compiled executors cannot verify behavioral equality of ``fn``
-    values, so there the identity is (name, fn-object): same-named specs
-    landing on one gathered flow must share the SAME spec instance (or at
-    least the same ``fn`` object) or planning rejects the taskpool.
+    ``name``: the human-readable half of the spec's identity. The FULL
+    conversion identity is ``(name, fn-object)`` (see :attr:`key`):
+    caches and the planners cannot verify behavioral equality of two
+    same-named ``fn`` specs, so two separately-built instances with the
+    same name are NOT the same conversion unless they share the same
+    ``fn`` object. Specs built only from dtype/transpose get a
+    canonical name automatically (and ``fn is None``, so name alone
+    does identify them); specs with ``fn`` get a unique name unless
+    named. Same-named fn specs landing on one gathered flow must share
+    the SAME spec instance (or at least the same ``fn`` object) or
+    planning rejects the taskpool.
     """
 
     def __init__(self, dtype: Any = None, transpose: bool = False,
@@ -55,8 +60,14 @@ class ReshapeSpec:
         # compose() memo: same (self, then) pair -> SAME composed spec
         # object, so (name, fn) identity holds across the per-edge
         # compose calls iterate_successors makes (a fresh lambda per
-        # call would defeat conversion sharing and wave batching)
-        self._compose_cache: dict = {}
+        # call would defeat conversion sharing and wave batching).
+        # Weak values bound the cache (ADVICE r5 #2): an entry lives
+        # exactly as long as something (a plan, an in-flight dep) holds
+        # the composed spec, so a long-lived producer spec composed
+        # against many transient consumer specs no longer accumulates
+        # entries — and pins — forever.
+        self._compose_cache: "weakref.WeakValueDictionary[int, ReshapeSpec]" \
+            = weakref.WeakValueDictionary()
         if name is None:
             if fn is None:
                 name = f"cast:{dtype}:T{int(transpose)}"
@@ -94,11 +105,14 @@ class ReshapeSpec:
     def compose(self, then: Optional["ReshapeSpec"]) -> "ReshapeSpec":
         """Sequential composition: ``self`` then ``then`` (producer-side
         reshape followed by consumer-side reshape). Memoized per
-        ``then`` instance: every edge composing the same pair shares
-        ONE spec object (one ``fn``, one cache key, one wave-group
-        signature). The id() key is safe — the composed spec's closure
-        holds ``then`` strongly, so its id cannot be recycled while
-        the entry lives."""
+        ``then`` instance (weakly — see ``_compose_cache``): every edge
+        composing the same pair while any consumer still holds the
+        composed spec shares ONE spec object (one ``fn``, one cache
+        key, one wave-group signature). The id() key is safe both ways:
+        while an entry lives, the composed spec's closure holds
+        ``then`` strongly, so its id cannot be recycled; and the entry
+        dies WITH the composed spec, so a recycled id can never alias a
+        stale entry."""
         if then is None:
             return self
         cached = self._compose_cache.get(id(then))

@@ -271,6 +271,63 @@ def getrf_nopiv_tile(A, base: int = 64):
     return rec(Af).astype(A.dtype)
 
 
+def lu_inv_tile(A, base: int = 64):
+    """``(packed LU, L⁻¹, U⁻¹)`` of a tile in ONE Schur recursion — the
+    LU analog of :func:`chol_inv_tile` (the MAGMA diagonal-inversion
+    trick applied to BOTH solve stages). With the child inverses in
+    hand, the recursion's panel solves become matmuls
+    (U12 = L11⁻¹·A12, L21 = A21·U11⁻¹ — plain dots against the
+    already-computed inverses instead of triangular solves) and the
+    inverses assemble from blocks the recursion already has
+    (L⁻¹₂₁ = −L22⁻¹·L21·L11⁻¹, U⁻¹₁₂ = −U11⁻¹·U12·U22⁻¹), so every
+    flop above the base case is a matmul. Consumed by the GETRF panel
+    fuser under ``getrf.trsm_hook=gemm``: the step's two panel TRSMs
+    run as MXU matmuls against the returned inverses, and the two
+    standalone nb-sized ``tri_inv_tile`` recursions (each with its own
+    internal triangular solves) disappear — their results fall out of
+    the factorization recursion."""
+    Af = jnp.asarray(A, jnp.float32)
+
+    def mm(a, b):
+        return jnp.matmul(a, b, preferred_element_type=jnp.float32,
+                          precision=_prec())
+
+    def rec(T):
+        n = T.shape[0]
+        if n <= base or n % 2:
+            LU = _lu_base(T)
+            eye = jnp.eye(n, dtype=jnp.float32)
+            L = jnp.tril(LU, -1) + eye
+            Li = jax.lax.linalg.triangular_solve(
+                L, eye, left_side=True, lower=True, unit_diagonal=True)
+            Ui = jax.lax.linalg.triangular_solve(
+                jnp.triu(LU), eye, left_side=True, lower=False)
+            return LU, Li, Ui
+        h = n // 2
+        LU11, Li11, Ui11 = rec(T[:h, :h])
+        U12 = mm(Li11, T[:h, h:])
+        L21 = mm(T[h:, :h], Ui11)
+        S = T[h:, h:] - mm(L21, U12)
+        LU22, Li22, Ui22 = rec(S)
+        Li21 = -mm(Li22, mm(L21, Li11))
+        Ui12 = -mm(Ui11, mm(U12, Ui22))
+        Ztop = jnp.zeros((h, n - h), jnp.float32)
+        Zbot = jnp.zeros((n - h, h), jnp.float32)
+        LU = jnp.concatenate(
+            [jnp.concatenate([LU11, U12], axis=1),
+             jnp.concatenate([L21, LU22], axis=1)], axis=0)
+        Li = jnp.concatenate(
+            [jnp.concatenate([Li11, Ztop], axis=1),
+             jnp.concatenate([Li21, Li22], axis=1)], axis=0)
+        Ui = jnp.concatenate(
+            [jnp.concatenate([Ui11, Ui12], axis=1),
+             jnp.concatenate([Zbot, Ui22], axis=1)], axis=0)
+        return LU, Li, Ui
+
+    LU, Li, Ui = rec(Af)
+    return LU.astype(A.dtype), Li.astype(A.dtype), Ui.astype(A.dtype)
+
+
 def lu_split(LU):
     """Unpack (L unit-lower, U upper) from a packed LU tile."""
     L = jnp.tril(LU, -1) + jnp.eye(LU.shape[0], dtype=LU.dtype)

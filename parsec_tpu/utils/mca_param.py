@@ -145,7 +145,15 @@ class ParamRegistry:
         """``get`` memoized by :meth:`generation` — for per-message hot
         paths (a full ``get`` resolves env vars per call, ~3 µs; this is
         a dict hit + one int compare). Unlocked by design: a racing
-        ``set`` at worst causes one redundant re-resolve."""
+        ``set`` at worst causes one redundant re-resolve.
+
+        Env-var caveat (intended): the generation counter only bumps on
+        ``set()``/``unset()``, so an IN-PROCESS ``os.environ`` change
+        (e.g. mutating ``PARSEC_MCA_comm_eager_limit`` after startup)
+        that a plain :meth:`get` would honor is NOT seen here until the
+        next ``set()``/``unset()`` of ANY param. Change parameters at
+        runtime through ``set()`` — that is what the runtime and every
+        test do; env vars are a process-startup channel."""
         gen = self._generation
         hit = self._cache.get(name)
         if hit is not None and hit[0] == gen:

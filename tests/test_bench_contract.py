@@ -101,3 +101,103 @@ def test_compact_summary_survives_error_rows():
 def test_section_keys_cover_registry():
     bench = _load_bench()
     assert set(bench._SECTION_KEYS) == set(bench.SECTIONS)
+
+
+# ---- generic regression guard (round 6: every GFLOPS row guarded, ----
+# ---- prior capture parsed as JSON instead of first-regex-hit) --------
+
+def test_compare_captures_flags_gflops_drop():
+    bench = _load_bench()
+    prior = {"value": 100000.0, "getrf_fused_gflops": 60000.0,
+             "flash_gflops": 90000.0}
+    cur = {"value": 95000.0,              # -5%: inside the band
+           "getrf_fused_gflops": 50000.0,  # -17%: fires
+           "flash_gflops": 91000.0}        # improvement: quiet
+    out = bench._compare_captures(cur, prior)
+    assert "latency_regression" not in out
+    reg = out["throughput_regression"]
+    assert "getrf_fused_gflops" in reg and "-17%" in reg, reg
+    assert "value" not in reg and "flash" not in reg, reg
+
+
+def test_compare_captures_flags_latency_rise_only_on_worsening():
+    bench = _load_bench()
+    prior = {"rdv_1M_p50_us": 3687.0, "eager_1k_p50_us": 512.0}
+    out = bench._compare_captures(
+        {"rdv_1M_p50_us": 4441.0, "eager_1k_p50_us": 500.0}, prior)
+    assert "rdv_1M_p50_us" in out["latency_regression"]
+    assert "eager" not in out["latency_regression"]
+    # an improvement or a within-band change stays quiet
+    assert bench._compare_captures(
+        {"rdv_1M_p50_us": 3200.0, "eager_1k_p50_us": 520.0}, prior) == {}
+
+
+def test_compare_captures_skips_missing_and_error_rows():
+    """A failed section (error row / missing key / null) must not read
+    as a regression in either direction."""
+    bench = _load_bench()
+    prior = {"value": 100000.0, "getrf_fused_gflops": None,
+             "rdv_1M_p50_us": 3600.0}
+    assert bench._compare_captures(
+        {"value": None, "getrf_fused_gflops": 10.0}, prior) == {}
+
+
+def test_parse_capture_file_prefers_parsed_json(tmp_path):
+    """ADVICE r5 #3 regression shape: the driver record's stdout tail
+    contains the SAME key with a different (stale) value than the
+    parsed compact summary — the loader must take the parsed one, not
+    the first textual hit."""
+    bench = _load_bench()
+    rec = {
+        "n": 9, "rc": 0,
+        "tail": '..."rdv_1M_p50_us": 9999.0, "getrf_fused_gflops": '
+                '11111.0 ... stale full-blob fragment',
+        "parsed": {"metric": "m", "value": 104769.4,
+                   "detail": {"rdv_1M_p50_us": 4440.9,
+                              "getrf_fused_gflops": 55460.1}},
+    }
+    p = tmp_path / "BENCH_r98.json"
+    p.write_text(json.dumps(rec))
+    base, flat = bench._parse_capture_file(str(p))
+    assert base == "BENCH_r98.json"
+    assert flat["rdv_1M_p50_us"] == 4440.9
+    assert flat["getrf_fused_gflops"] == 55460.1
+    assert flat["value"] == 104769.4
+
+
+def test_throughput_guard_end_to_end(tmp_path, monkeypatch):
+    bench = _load_bench()
+    rec = {"parsed": {"value": 110000.0,
+                      "detail": {"getrf_fused_gflops": 60000.0}}}
+    (tmp_path / "BENCH_r07.json").write_text(json.dumps(rec))
+    monkeypatch.setattr(bench, "_HERE", str(tmp_path))
+    result = _fat_result()
+    result["value"] = 90000.0                       # -18% vs prior
+    result["detail"]["extra_configs"]["getrf_fused"]["gflops"] = 63193.8
+    bench._throughput_regression_guard(result)
+    reg = result["detail"]["throughput_regression"]
+    assert "value: 110000.0 -> 90000.0" in reg and \
+        "vs BENCH_r07.json" in reg, reg
+    # ...and the compact summary carries it to the driver tail
+    line = bench._compact_summary(result)
+    assert "throughput_regression" in json.loads(line)["detail"]
+
+
+def test_throughput_guard_quiet_without_prior(tmp_path, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_HERE", str(tmp_path))
+    result = _fat_result()
+    bench._throughput_regression_guard(result)
+    assert "throughput_regression" not in result["detail"]
+    assert "throughput_guard_error" not in result["detail"]
+
+
+def test_trimmed_median():
+    bench = _load_bench()
+    assert bench._trimmed_median([3.0, 1.0, 2.0]) == 2.0
+    # ≥5 samples: extremes dropped before the median
+    assert bench._trimmed_median([100.0, 1.0, 2.0, 3.0, 4.0]) == 3.0
+    # even counts: true median (mean of the two middles), no
+    # upper-middle bias
+    assert bench._trimmed_median([500.0, 510.0, 520.0, 900.0]) == 515.0
+    assert bench._trimmed_median([1.0, 2.0]) == 1.5

@@ -595,6 +595,99 @@ def test_rendezvous_2ranks_thread_multiple():
     assert len(res) == 2
 
 
+def scenario_rendezvous_roundtrip(ctx, engine, rank, nb_ranks,
+                                  nbytes=1 << 20):
+    """A >1 MB payload crosses the rendezvous GET/PUT path in BOTH
+    directions (rank 0 → 1 → 0) with content verified BITWISE — the
+    end-to-end guard for the vectored (sendmsg) large-frame send path:
+    a desynchronized byte stream, clipped iovec, or mis-ordered
+    queued-bytes remainder corrupts exactly this shape."""
+    from parsec_tpu.dsl import ptg
+    from parsec_tpu.utils import mca_param
+    mca_param.set("comm.eager_limit", 64 * 1024)
+
+    n = nbytes // 4 + 32          # strictly above 1 MiB on the wire
+    A = _DistVec(3, nb_ranks, rank)
+
+    class _Src(_DistVec):
+        def data_of(self, key):
+            return np.arange(n, dtype=np.float32)
+
+    B = _Src(3, nb_ranks, rank)   # placement: indices 0,2 → rank 0; 1 → rank 1
+    tp = ptg.Taskpool("rdvrt", A=A, B=B)
+    tp.task_class(
+        "S0", params=("k",),
+        space=lambda g: ((0,),),
+        affinity=lambda g, k: (g.B, (0,)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, k: (g.B, (0,)))],
+            outs=[ptg.Out(dst=("S1", lambda g, k: (0,), "X"))])])
+    tp.task_class(
+        "S1", params=("k",),
+        space=lambda g: ((0,),),
+        affinity=lambda g, k: (g.B, (1,)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(src=("S0", lambda g, k: (0,), "X"))],
+            outs=[ptg.Out(dst=("S2", lambda g, k: (0,), "X"))])])
+    tp.task_class(
+        "S2", params=("k",),
+        space=lambda g: ((0,),),
+        affinity=lambda g, k: (g.B, (2,)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(src=("S1", lambda g, k: (0,), "X"))],
+            outs=[ptg.Out(data=lambda g, k: (g.A, (2,)))])])
+
+    # powers of two keep every f32 op exact → bitwise-assertable result
+    @tp.task_class_by_name("S0").body
+    def s0_body(task, X):
+        return X * 0.5
+
+    @tp.task_class_by_name("S1").body
+    def s1_body(task, X):
+        return X * -4.0
+
+    @tp.task_class_by_name("S2").body
+    def s2_body(task, X):
+        return X
+
+    ctx.add_taskpool(tp)
+    ctx.start()
+    assert ctx.wait(timeout=120), f"rank {rank}: roundtrip stalled"
+    if A.rank_of((2,)) == rank:
+        expect = np.arange(n, dtype=np.float32) * -2.0
+        np.testing.assert_array_equal(np.asarray(A.v[2]), expect)
+    st = engine.wire_stats()
+    # each rank received one >1 MB value → one rendezvous GET each
+    assert st["gets"] >= 1, st
+    return st["gets"]
+
+
+def scenario_rendezvous_roundtrip_thread_multiple(ctx, engine, rank,
+                                                  nb_ranks):
+    """Same ≥1 MB both-directions rendezvous, with worker threads
+    direct-sending (the vectored send path under per-peer lock
+    contention instead of comm-thread funnelling)."""
+    from parsec_tpu.utils import mca_param
+    mca_param.set("comm.thread_multiple", 1)
+    try:
+        return scenario_rendezvous_roundtrip(ctx, engine, rank, nb_ranks)
+    finally:
+        mca_param.unset("comm.thread_multiple")
+
+
+def test_rendezvous_1m_roundtrip_2ranks():
+    res = _run_ranks("scenario_rendezvous_roundtrip", 2)
+    assert sum(res.values()) >= 2, res     # one GET per direction
+
+
+def test_rendezvous_1m_roundtrip_thread_multiple():
+    res = _run_ranks("scenario_rendezvous_roundtrip_thread_multiple", 2)
+    assert sum(res.values()) >= 2, res
+
+
 def scenario_getrf_left_2ranks(ctx, engine, rank, nb_ranks, n=192, nb=32):
     """The left-looking LU taskpool multi-rank: UPDC/UPDR's gathered L/U
     operands resolve remote tiles through the one-sided fetch service
