@@ -35,6 +35,25 @@ _MASKED = -1e30      # finite "minus infinity": keeps exp() NaN-free when
                      # an entire row is masked (fully-future KV blocks)
 
 
+def _shard_map():
+    """Version-portable ``shard_map``: top-level ``jax.shard_map``
+    (JAX ≥ 0.6) with the ``check_vma`` kwarg, or the older
+    ``jax.experimental.shard_map.shard_map`` whose equivalent kwarg is
+    ``check_rep``. Returns a callable with the NEW signature; the
+    ``check_vma`` kwarg is translated for old JAX."""
+    import jax
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm
+    from jax.experimental.shard_map import shard_map as sm_old
+
+    def compat(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return sm_old(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma)
+
+    return compat
+
+
 def _online_softmax_step(q_blk, k_cur, v_cur, acc, m, l, scale,
                          qpos=None, kpos=None):
     """One online-softmax fold. ``qpos``/``kpos``: global sequence
@@ -98,7 +117,7 @@ def ring_attention(q, k, v, mesh, axis: str = "seq",
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import PartitionSpec as P
-    shard_map = jax.shard_map
+    shard_map = _shard_map()
     if impl not in ("xla", "flash"):
         raise ValueError(f"ring_attention impl must be xla|flash: {impl!r}")
 
@@ -243,7 +262,7 @@ def ulysses_attention(q, k, v, mesh, axis: str = "seq"):
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import PartitionSpec as P
-    shard_map = jax.shard_map
+    shard_map = _shard_map()
 
     n = mesh.shape[axis]
     H = q.shape[1]

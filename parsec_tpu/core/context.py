@@ -32,7 +32,7 @@ from .future import DataCopyFuture
 from .reshape import resolve_reshape
 from .task import Chore, DeviceType, HookReturn, Task, TaskStatus
 from .taskpool import DataRef, SuccessorRef, Taskpool
-from ..utils import mca_param
+from ..utils import debug_history, mca_param
 from ..utils.debug import debug_verbose, warning
 from .. import termdet as termdet_mod
 
@@ -43,6 +43,18 @@ mca_param.register("runtime.stage_reads", "auto",
                         "registered) | 1 | 0")
 mca_param.register("runtime.backoff_min_us", 50, help="starvation backoff floor")
 mca_param.register("runtime.backoff_max_us", 2000, help="starvation backoff ceiling")
+mca_param.register("runtime.release_batch", 1,
+                   help="batch a completed task's dependency releases "
+                        "into one striped-lock pass (0 = per-dep locks)")
+mca_param.register("runtime.bypass_chain", 1,
+                   help="keep a completing task's best ready successor "
+                        "in the stream's bypass slot (never queued); "
+                        "0 = all ready tasks go through the scheduler")
+mca_param.register("runtime.stage_timers", 0,
+                   help="accumulate per-stage runtime-overhead timers "
+                        "(select/dispatch/release on the streams, insert "
+                        "on DTD taskpools) — the taskrate bench's "
+                        "overhead breakdown; off by default (hot path)")
 mca_param.register("vpmap", "flat",
                    help="virtual-process map: flat | nb:SIZE | "
                         "list:0,0,1,... | file:PATH")
@@ -66,7 +78,10 @@ class ExecutionStream:
         self.next_task: Optional[Task] = None   # priority bypass slot
         self.thread: Optional[threading.Thread] = None
         self.stats = {"executed": 0, "selected": 0, "starved": 0,
-                      "stolen": 0}
+                      "stolen": 0,
+                      # per-stage overhead timers (runtime.stage_timers)
+                      "select_s": 0.0, "select_calls": 0,
+                      "dispatch_s": 0.0, "release_s": 0.0}
         self._vp_peers = None        # cached steal orders (sched/base.py)
         self._steal_order = None
         # extensible per-stream info slots (parsec_internal.h:688-702)
@@ -109,6 +124,20 @@ class Context:
         self.scheduler.install(self)
         for es in self.streams:
             self.scheduler.flow_init(es)
+
+        # release-path knobs, resolved once per context (the hot loops
+        # read attributes, not the MCA registry); lowercase so
+        # set(..., False) / "OFF" disable like "0" does
+        self._release_batch = str(mca_param.get(
+            "runtime.release_batch", 1)).lower() not in ("0", "off", "false")
+        self._bypass_chain = str(mca_param.get(
+            "runtime.bypass_chain", 1)).lower() not in ("0", "off", "false")
+        # per-stage overhead timers (select/dispatch/release into
+        # es.stats, insert on DTD taskpools); enabled by the MCA param
+        # or the profiling `overhead` PINS module
+        self.stage_timers = str(mca_param.get(
+            "runtime.stage_timers", 0)).lower() not in ("0", "off",
+                                                        "false", "")
 
         self.devices = device_mod.Registry(self)
         self.pins = pins_mod.PinsManager(self)
@@ -310,9 +339,16 @@ class Context:
         for t in tasks:
             t.status = TaskStatus.NONE
         self.pins.select_begin(es, tasks)
-        self.scheduler.schedule(es, sorted(tasks, key=lambda t: -t.priority),
-                                distance)
-        self._work_evt.set()
+        if len(tasks) > 1:
+            tasks = sorted(tasks, key=lambda t: -t.priority)
+        self.scheduler.schedule(es, tasks, distance)
+        # is_set() is a plain bool read; while workers are busy the event
+        # stays set, so the common completion path skips the heavier
+        # set() (lock + notify). A worker that cleared it re-selects
+        # BEFORE waiting (see _worker_main), so this can't lose a wakeup.
+        evt = self._work_evt
+        if not evt.is_set():
+            evt.set()
 
     def find_taskpool(self, name: str, active_only: bool = True):
         """Lookup by name; ``active_only=False`` includes terminated pools
@@ -358,7 +394,13 @@ class Context:
             task = es.next_task
             es.next_task = None
             if task is None:
-                task = self.scheduler.select(es)
+                if self.stage_timers:
+                    t0 = time.perf_counter()
+                    task = self.scheduler.select(es)
+                    es.stats["select_s"] += time.perf_counter() - t0
+                    es.stats["select_calls"] += 1
+                else:
+                    task = self.scheduler.select(es)
             if task is None:
                 es.stats["starved"] += 1
                 # event-driven wakeup: schedule() sets _work_evt, so a
@@ -394,6 +436,8 @@ class Context:
         # prepare_input (generated data_lookup analog): resolve inputs not
         # attached by the release path (collection reads of startup tasks)
         task.status = TaskStatus.PREPARE_INPUT
+        t0 = time.perf_counter() if (self.stage_timers and es is not None) \
+            else None
         lookup = getattr(tc, "data_lookup", None)
         if lookup is not None:
             self.pins.prepare_input_begin(es, task)
@@ -403,6 +447,10 @@ class Context:
         task.status = TaskStatus.HOOK
         self.pins.exec_begin(es, task)
         rc = self._execute(es, task)
+        if t0 is not None:
+            # dispatch = prepare_input + incarnation walk + hook call
+            # (for a null body this IS the per-task dispatch overhead)
+            es.stats["dispatch_s"] += time.perf_counter() - t0
         if rc == HookReturn.ASYNC:
             return                      # device layer completes it later
         if rc == HookReturn.AGAIN:
@@ -417,7 +465,6 @@ class Context:
         """__parsec_execute analog (scheduling.c:124-203): try incarnations
         in declaration order, skipping masked/vetoed ones."""
         tc = task.task_class
-        from ..utils import debug_history
         if debug_history.enabled():     # DEBUG_MARK_EXE analog
             debug_history.mark("EXE %s%r es=%s", tc.name,
                                tuple(task.locals),
@@ -480,12 +527,19 @@ class Context:
             self.grapher.task_executed(task)
 
         self.pins.release_deps_begin(es, task)
+        t_rel = time.perf_counter() if (self.stage_timers and
+                                        es is not None) else None
         ready: List[Task] = []
+        # local refs accumulate and release in ONE striped-lock batch
+        # (runtime.release_batch; parsec_release_dep_fct walks its
+        # ready-ring the same way) instead of a lock pair per dep
+        local_refs: List[SuccessorRef] = []
         # remote deps sharing one produced value to one rank ship the
         # payload ONCE (the reference's one-data-per-(dep, rank)
         # aggregation, remote_dep.c) — grouped here, packed by the
         # engine's remote_dep_activate_multi
-        remote_groups: Dict[Tuple[int, int], List] = {}
+        remote_groups: Optional[Dict[Tuple[int, int], List]] = \
+            {} if self.nb_ranks > 1 else None
         for ref in tc.iterate_successors(task):
             if isinstance(ref, DataRef):
                 # track (pinned) first, write second, unpin last — see
@@ -505,28 +559,43 @@ class Context:
                 # thread; remote consumers get the converted value)
                 ref.value = resolve_reshape(ref.value, ref.reshape_spec)
                 ref.reshape_spec = None
-            if self.nb_ranks > 1:
+            if remote_groups is not None:
                 target_rank = ref.task_class.affinity_rank(ref.locals) \
                     if hasattr(ref.task_class, "affinity_rank") else self.my_rank
                 if target_rank != self.my_rank:
                     remote_groups.setdefault(
                         (target_rank, id(ref.value)), []).append(ref)
                     continue
-            new_task = tp.activate_dep(ref)
-            if new_task is not None:
-                ready.append(new_task)
-        for (target_rank, _vid), refs in remote_groups.items():
-            self.comm.remote_dep_activate_multi(task, target_rank, refs)
+            if self._release_batch:
+                local_refs.append(ref)
+            else:
+                new_task = tp.activate_dep(ref)
+                if new_task is not None:
+                    ready.append(new_task)
+        if local_refs:
+            ready.extend(tp.activate_deps(local_refs))
+        if remote_groups:
+            for (target_rank, _vid), refs in remote_groups.items():
+                self.comm.remote_dep_activate_multi(task, target_rank, refs)
         if tc.on_complete is not None:
             tc.on_complete(task)
         if task.on_complete is not None:
             task.on_complete(task)
         if ready:
-            ready.sort(key=lambda t: -t.priority)
-            if es is not None and es.next_task is None:
-                es.next_task = ready.pop(0)   # bypass: run best successor now
+            if self._bypass_chain and es is not None and \
+                    es.next_task is None:
+                # bypass-slot chaining: the completing task's best
+                # successor never touches the queues — the worker loop
+                # runs it next (scheduling.c:346-398). max() takes the
+                # FIRST maximal task, matching the old stable
+                # sort+pop(0) tie-break exactly.
+                best = max(ready, key=lambda t: t.priority)
+                ready.remove(best)
+                es.next_task = best
             if ready:
                 self.schedule(es, ready)
+        if t_rel is not None:
+            es.stats["release_s"] += time.perf_counter() - t_rel
         self.pins.release_deps_end(es, task)
         self.pins.complete_exec_end(es, task)
         tp.addto_nb_tasks(-1)

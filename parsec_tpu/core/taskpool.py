@@ -174,6 +174,27 @@ class _PendingDeps:
         ent["priority"] = max(ent["priority"], priority)
         return ent
 
+    @staticmethod
+    def _count_locked(ent: Dict[str, Any], key, flow_name: str, value: Any,
+                      dep_index: int, goal: int, mode: str,
+                      priority: int) -> bool:
+        """Apply ONE satisfied dep to an entry; True when the goal is
+        reached. Caller holds the entry's stripe lock. The single copy of
+        the count/mask accounting shared by :meth:`update` and
+        :meth:`update_batch` — the two must never diverge."""
+        if value is not None:
+            ent["data"][flow_name] = value
+        ent["priority"] = max(ent["priority"], priority)
+        if mode == DEPS_MASK:
+            bit = 1 << dep_index
+            if ent["mask"] & bit:
+                raise RuntimeError(
+                    f"dependency bit {dep_index} satisfied twice for {key}")
+            ent["mask"] |= bit
+            return ent["mask"] == goal
+        ent["count"] += 1
+        return ent["count"] == goal
+
     def update(self, key, flow_name: str, value: Any, dep_index: int,
                goal: int, mode: str, priority: int) -> Optional[Dict[str, Any]]:
         """Record one satisfied dep; return the entry if the goal is reached
@@ -203,23 +224,52 @@ class _PendingDeps:
             if ent is None:
                 ent = {"count": 0, "mask": 0, "data": {}, "priority": priority}
                 self._entries[key] = ent
-            if value is not None:
-                ent["data"][flow_name] = value
-            ent["priority"] = max(ent["priority"], priority)
-            if mode == DEPS_MASK:
-                bit = 1 << dep_index
-                if ent["mask"] & bit:
-                    raise RuntimeError(
-                        f"dependency bit {dep_index} satisfied twice for {key}")
-                ent["mask"] |= bit
-                done = (ent["mask"] == goal)
-            else:
-                ent["count"] += 1
-                done = (ent["count"] == goal)
-            if done:
+            if self._count_locked(ent, key, flow_name, value, dep_index,
+                                  goal, mode, priority):
                 del self._entries[key]
                 return ent
             return None
+
+    def update_batch(self, items) -> List[Tuple[int, Dict[str, Any]]]:
+        """Batched :meth:`update`: ``items`` is a sequence of
+        ``(key, flow_name, value, dep_index, goal, mode, priority)``
+        tuples. Entries are grouped by lock stripe so each stripe lock is
+        taken ONCE per batch instead of once per dependency — the
+        release-deps hot loop's dominant lock traffic when a completed
+        task fans out to many successors. Returns ``(item_index, entry)``
+        for every dependency that completed its target's goal."""
+        if self._native is not None:
+            # the native table does its own per-key synchronization, so
+            # there is no stripe-lock traffic to coalesce — delegate per
+            # item to the scalar path
+            out = []
+            for i, (key, flow_name, value, dep_index, goal, mode,
+                    priority) in enumerate(items):
+                ent = self.update(key, flow_name, value, dep_index, goal,
+                                  mode, priority)
+                if ent is not None:
+                    out.append((i, ent))
+            return out
+        by_stripe: Dict[int, List[int]] = {}
+        for i, item in enumerate(items):
+            by_stripe.setdefault(hash(item[0]) % self._NSTRIPES,
+                                 []).append(i)
+        out = []
+        for stripe, idxs in by_stripe.items():
+            with self._locks[stripe]:
+                for i in idxs:
+                    (key, flow_name, value, dep_index, goal, mode,
+                     priority) = items[i]
+                    ent = self._entries.get(key)
+                    if ent is None:
+                        ent = {"count": 0, "mask": 0, "data": {},
+                               "priority": priority}
+                        self._entries[key] = ent
+                    if self._count_locked(ent, key, flow_name, value,
+                                          dep_index, goal, mode, priority):
+                        del self._entries[key]
+                        out.append((i, ent))
+        return out
 
     def finalize(self, key, goal: int, mode: str) -> Optional[Dict[str, Any]]:
         """For DSLs whose goal is only known after linking (DTD): check
@@ -337,22 +387,45 @@ class Taskpool:
         return ok
 
     # -- dependency activation (parsec.c:1694-1780 analog) ----------------
+    def _ready_task(self, ref: SuccessorRef, ent: Dict[str, Any]) -> Task:
+        """Construct the ready Task for a goal-completing entry — the one
+        copy shared by the scalar and batched activation paths."""
+        tc = ref.task_class
+        task = Task(self, tc, ref.locals,
+                    priority=max(ent["priority"], tc.priority_fn(ref.locals)))
+        task.data.update(ent["data"])
+        return task
+
     def activate_dep(self, ref: SuccessorRef) -> Optional[Task]:
         """Count one satisfied input dep of ``ref``'s target task; if that
         completes the target's goal, construct the ready Task and return it
         (caller schedules it)."""
         tc = ref.task_class
-        key = tc.make_key(ref.locals)
-        goal = tc.deps_goal(ref.locals)
-        ent = self.pending.update(key, ref.flow_name, ref.value,
-                                  ref.dep_index, goal, tc.deps_mode,
+        ent = self.pending.update(tc.make_key(ref.locals), ref.flow_name,
+                                  ref.value, ref.dep_index,
+                                  tc.deps_goal(ref.locals), tc.deps_mode,
                                   ref.priority)
         if ent is None:
             return None
-        task = Task(self, tc, ref.locals,
-                    priority=max(ent["priority"], tc.priority_fn(ref.locals)))
-        task.data.update(ent["data"])
-        return task
+        return self._ready_task(ref, ent)
+
+    def activate_deps(self, refs: Sequence[SuccessorRef]) -> List[Task]:
+        """Batched :meth:`activate_dep`: count all of a completed task's
+        satisfied deps in one striped-lock pass (``runtime.release_batch``)
+        and return every successor whose goal was reached. Semantics are
+        identical to calling ``activate_dep`` per ref; only the lock
+        traffic changes."""
+        if len(refs) == 1:
+            task = self.activate_dep(refs[0])
+            return [task] if task is not None else []
+        items = []
+        for ref in refs:
+            tc = ref.task_class
+            items.append((tc.make_key(ref.locals), ref.flow_name, ref.value,
+                          ref.dep_index, tc.deps_goal(ref.locals),
+                          tc.deps_mode, ref.priority))
+        return [self._ready_task(refs[i], ent)
+                for i, ent in self.pending.update_batch(items)]
 
     def __repr__(self) -> str:
         return f"<Taskpool {self.name} id={self.taskpool_id}>"

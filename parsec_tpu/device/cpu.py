@@ -2,6 +2,8 @@
 
 from __future__ import annotations
 
+import sys
+
 from .base import Device
 from ..core.task import Chore, DeviceType, HookReturn, Task
 
@@ -22,8 +24,7 @@ class CPUDevice(Device):
         the FIRST jax input's device so the body sees one consistent
         placement (device_put is a no-op for already-resident
         buffers)."""
-        import sys
-        if "jax" not in sys.modules:
+        if not task.data or "jax" not in sys.modules:
             return
         import jax
         target = None

@@ -107,8 +107,10 @@ class TPUDevice(Device):
         # Bodies that need task metadata (locals) opt out of the jit cache
         # by setting chore.batchable = False → called directly (they may
         # jit internally with locals as static args).
+        # cached_get: execute() is per-task — a full registry get here
+        # costs a lock + env resolve on the dispatch hot path
         if (chore.batchable or chore.batch_body is not None) and \
-                int(mca_param.get("device.tpu.batch_dispatch", 0)):
+                int(mca_param.cached_get("device.tpu.batch_dispatch", 0)):
             # manager path (progress_stream analog): enqueue and return
             # ASYNC — the manager thread batches same-class ready tasks
             # into one vmapped dispatch and completes them; this device

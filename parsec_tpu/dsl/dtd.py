@@ -148,22 +148,27 @@ class _TileBank:
 
     def tile_of(self, dc: DataCollection, key) -> _Tile:
         hkey = (dc.dc_id, tuple(key) if isinstance(key, (tuple, list)) else key)
-        with self._lock:
-            t = self._tiles.get(hkey)
-            if t is None:
-                t = _Tile(dc, hkey[1])
-                self._tiles[hkey] = t
-            elif t.collection is not dc:
-                # two live collections sharing one dc_id would silently
-                # alias each other's writer tracking (values vanish);
-                # dc_id is the wire identity, so it must be unique
-                raise ValueError(
-                    f"distinct collections share dc_id={dc.dc_id}; "
-                    f"tile {hkey[1]} would alias "
-                    f"{getattr(t.collection, 'name', t.collection)!r} and "
-                    f"{getattr(dc, 'name', dc)!r} — give each collection "
-                    "a unique dc_id")
-            return t
+        # insertion fast path: dict reads are GIL-atomic, so a hit costs
+        # no lock (every tile arg of every insert lands here); the lock
+        # only serializes first-touch materialization
+        t = self._tiles.get(hkey)
+        if t is None:
+            with self._lock:
+                t = self._tiles.get(hkey)
+                if t is None:
+                    t = _Tile(dc, hkey[1])
+                    self._tiles[hkey] = t
+        if t.collection is not dc:
+            # two live collections sharing one dc_id would silently
+            # alias each other's writer tracking (values vanish);
+            # dc_id is the wire identity, so it must be unique
+            raise ValueError(
+                f"distinct collections share dc_id={dc.dc_id}; "
+                f"tile {hkey[1]} would alias "
+                f"{getattr(t.collection, 'name', t.collection)!r} and "
+                f"{getattr(dc, 'name', dc)!r} — give each collection "
+                "a unique dc_id")
+        return t
 
     def all(self) -> List[_Tile]:
         with self._lock:
@@ -189,9 +194,16 @@ class Taskpool(CoreTaskpool):
         self._seq_locks = [threading.Lock() for _ in range(64)]
         self._inflight = 0
         self._inflight_cv = threading.Condition()
+        self._throttle_waiters = 0   # completers notify only when an
+        #                              inserter is actually parked
         self._window = int(mca_param.get("dtd.window_size", 4096))
         self._threshold = int(mca_param.get("dtd.threshold_size", 2048))
         self._closed = False
+        # per-stage overhead accounting (runtime.stage_timers /
+        # profiling `overhead` module): wall time spent in insert_task
+        # on the inserting thread(s)
+        self.insert_s = 0.0
+        self.insert_calls = 0
         # per-taskpool insertion sequence: the cross-rank task identity
         # (every rank replays the same sequence → same numbering)
         self._seq = 0
@@ -244,8 +256,13 @@ class Taskpool(CoreTaskpool):
                         device: DeviceType,
                         pure: bool = False) -> TaskClass:
         """Lazily create a task class per (fn, arg shape)
-        (insert_function.c:1015 analog)."""
+        (insert_function.c:1015 analog). Resolution is on the insertion
+        hot path, so a cache hit is a lock-free dict read (GIL-atomic);
+        the lock only serializes creation."""
         key = (fn, shape, device, pure)
+        tc = self._classes.get(key)
+        if tc is not None:
+            return tc
         with self._class_lock:
             tc = self._classes.get(key)
             if tc is not None:
@@ -419,6 +436,72 @@ class Taskpool(CoreTaskpool):
         time and cached by object identity, so they must be treated as
         IMMUTABLE once inserted — mutating an array payload in place
         between inserts would silently serve the stale compile."""
+        timed = self.context is not None and self.context.stage_timers
+        t0 = time.perf_counter() if timed else None
+        self._check_insertable()
+        tc = self._task_class_for(fn, self._shape_of(args), device,
+                                  pure=pure)
+        task = self._insert_one(tc, args, priority, None, None)
+        self._throttle()
+        if timed:
+            self.insert_s += time.perf_counter() - t0
+            self.insert_calls += 1
+        return task
+
+    def insert_tasks(self, fn: Callable, rows, *, priority: int = 0,
+                     device: DeviceType = DeviceType.ALL,
+                     pure: bool = False) -> List[Optional[Task]]:
+        """Batched :meth:`insert_task` — the insertion fast path. All
+        ``rows`` (sequences of Tile/Value/Scratch args) are inserted with
+        the same body, paying the per-insert lookup costs ONCE per batch
+        where possible: one task-class resolution per distinct arg shape,
+        a shared tile-handle cache, one ``schedule()`` call for every
+        task that becomes ready during the batch, and one
+        sliding-window check per batch tail (re-checked mid-batch so a
+        batch larger than the window still throttles; any accumulated
+        ready tasks are flushed to the scheduler BEFORE parking, or the
+        drain the window waits for could never happen).
+
+        Semantically identical to calling ``insert_task`` per row —
+        program order, tile tracking, and the cross-rank replay sequence
+        are unchanged. Returns one ``Task | None`` (shell) per row."""
+        timed = self.context is not None and self.context.stage_timers
+        t0 = time.perf_counter() if timed else None
+        self._check_insertable()
+        rows = list(rows)
+        out: List[Optional[Task]] = []
+        if not rows:
+            return out
+        shape0 = self._shape_of(rows[0])
+        tc0 = self._task_class_for(fn, shape0, device, pure=pure)
+        ready: List[Task] = []
+        tile_cache: Dict[Any, _Tile] = {}
+        for args in rows:
+            shape = self._shape_of(args)
+            tc = tc0 if shape == shape0 else \
+                self._task_class_for(fn, shape, device, pure=pure)
+            out.append(self._insert_one(tc, args, priority, ready,
+                                        tile_cache))
+            if len(ready) >= 512:
+                # chunked flush: keep the workers fed while a long batch
+                # is still inserting (one schedule() per chunk, not per
+                # task)
+                self.context.schedule(None, ready)
+                ready = []
+            if self._inflight >= self._window:   # lock-free pre-check
+                if ready:
+                    self.context.schedule(None, ready)
+                    ready = []
+                self._throttle()
+        if ready:
+            self.context.schedule(None, ready)
+        if timed:
+            self.insert_s += time.perf_counter() - t0
+            self.insert_calls += len(rows)
+        return out
+
+    # -- insertion internals ----------------------------------------------
+    def _check_insertable(self) -> None:
         if self.error is not None:
             raise RuntimeError(
                 f"taskpool {self.name} aborted: {self.error}") from self.error
@@ -431,19 +514,55 @@ class Taskpool(CoreTaskpool):
             # (insert_function.c checks the same and the sliding window
             # would deadlock otherwise)
             self.context.start()
-        seq = self._seq
-        self._seq += 1
-        shape = tuple(
+
+    @staticmethod
+    def _shape_of(args) -> Tuple:
+        return tuple(
             ("tile", a.access) if isinstance(a, TileArg)
             else ("value", None) if isinstance(a, ValueArg)
             else ("scratch", None)
             for a in args)
-        tc = self._task_class_for(fn, shape, device, pure=pure)
-        target_rank = self._placement(args) if self.nb_ranks > 1 else 0
+
+    def _tile_of_cached(self, dc, key, cache) -> _Tile:
+        if cache is None:
+            return self.tiles.tile_of(dc, key)
+        hkey = (dc.dc_id, tuple(key) if isinstance(key, (tuple, list))
+                else key)
+        t = cache.get(hkey)
+        if t is None:
+            t = cache[hkey] = self.tiles.tile_of(dc, key)
+        return t
+
+    def _throttle(self) -> None:
+        """Sliding-window inserter throttle. The pre-check is lock-free
+        (GIL-atomic int read) so an un-throttled insert never touches the
+        condition variable here."""
+        if self._inflight < self._window:
+            return
+        with self._inflight_cv:
+            if self._inflight < self._window:
+                return
+            self._throttle_waiters += 1
+            try:
+                while self._inflight > self._threshold and not self._closed:
+                    self._inflight_cv.wait(timeout=0.05)
+            finally:
+                self._throttle_waiters -= 1
+
+    def _insert_one(self, tc: TaskClass, args, priority: int,
+                    ready_out: Optional[List[Task]],
+                    tile_cache: Optional[Dict]) -> Optional[Task]:
+        """One insert under an already-resolved task class. With
+        ``ready_out`` set (batch mode), tasks that become ready are
+        appended there instead of being scheduled immediately."""
+        seq = self._seq
+        self._seq += 1
         my_rank = self.my_rank
-        if self.nb_ranks > 1 and target_rank != my_rank:
-            self._insert_shell(seq, target_rank, args, priority)
-            return None
+        if self.nb_ranks > 1:
+            target_rank = self._placement(args)
+            if target_rank != my_rank:
+                self._insert_shell(seq, target_rank, args, priority)
+                return None
 
         task = Task(self, tc, (seq,), priority=priority)
         task.dsl.update(argspec=[], out_tiles=[], succ=[], done=False,
@@ -468,7 +587,7 @@ class Taskpool(CoreTaskpool):
             if isinstance(a, ScratchArg):
                 task.dsl["argspec"].append(("scratch", (a.shape, a.dtype)))
                 continue
-            tile = self.tiles.tile_of(a.collection, a.key)
+            tile = self._tile_of_cached(a.collection, a.key, tile_cache)
             fname = f"f{flow_i}"
             flow_i += 1
             task.dsl["argspec"].append(("tile", None))
@@ -542,18 +661,18 @@ class Taskpool(CoreTaskpool):
             self._goals[seq] = goal
             ent = None if goal == 0 else self.pending.finalize(
                 tc.make_key(task.locals), goal, DEPS_COUNTER)
+        ready = None
         if goal == 0:
-            self.context.schedule(None, [task])
+            ready = task
         elif ent is not None:
             task.data.update(ent["data"])
             task.priority = max(task.priority, ent["priority"])
-            self.context.schedule(None, [task])
-
-        # sliding window: throttle the inserting thread
-        with self._inflight_cv:
-            if self._inflight >= self._window:
-                while self._inflight > self._threshold and not self._closed:
-                    self._inflight_cv.wait(timeout=0.05)
+            ready = task
+        if ready is not None:
+            if ready_out is not None:
+                ready_out.append(ready)     # batch: one schedule() at flush
+            else:
+                self.context.schedule(None, [ready])
         return task
 
     def _insert_shell(self, seq: int, target_rank: int, args,
@@ -658,7 +777,12 @@ class Taskpool(CoreTaskpool):
             self._tasks_by_seq.pop(seq, None)
         with self._inflight_cv:
             self._inflight -= 1
-            self._inflight_cv.notify_all()
+            # notify only when an inserter is actually parked in the
+            # window throttle (or the pool is draining) — notify_all per
+            # completion is pure overhead on the release hot path; the
+            # throttle's 50 ms poll bounds a lost race harmlessly
+            if self._throttle_waiters or self._closed:
+                self._inflight_cv.notify_all()
         return refs
 
     # -------------------------------------------------------------- drain
@@ -671,18 +795,24 @@ class Taskpool(CoreTaskpool):
         protocol (remote_dep_mpi.c:1935-1961)."""
         seq = ref.locals[0]
         with self._seq_locks[seq & 63]:
-            # goal read + count must be one critical section against
-            # insert_task's goal publication + finalize (see there)
-            goal = self._goals.get(seq, _GOAL_UNSET)
-            if goal == _GOAL_UNSET:
-                # activation raced ahead of local discovery — the
-                # parked-undiscovered-task path (stress tests assert
-                # this actually fires at 4 ranks)
-                self.parked_activations += 1
-            task = self._tasks_by_seq.get(seq)
-            ent = self.pending.update(("dtd", seq),
-                                      ref.flow_name, ref.value, ref.dep_index,
-                                      goal, DEPS_COUNTER, ref.priority)
+            return self._activate_one_locked(ref)
+
+    def _activate_one_locked(self, ref: SuccessorRef) -> Optional[Task]:
+        """One dep activation; the caller holds ``ref``'s seq-stripe
+        lock. The single copy shared by the scalar and batched paths:
+        goal read + count must be one critical section against
+        insert_task's goal publication + finalize (see there)."""
+        seq = ref.locals[0]
+        goal = self._goals.get(seq, _GOAL_UNSET)
+        if goal == _GOAL_UNSET:
+            # activation raced ahead of local discovery — the
+            # parked-undiscovered-task path (stress tests assert
+            # this actually fires at 4 ranks)
+            self.parked_activations += 1
+        task = self._tasks_by_seq.get(seq)
+        ent = self.pending.update(("dtd", seq),
+                                  ref.flow_name, ref.value, ref.dep_index,
+                                  goal, DEPS_COUNTER, ref.priority)
         if ent is None:
             return None
         if task is None:
@@ -690,6 +820,27 @@ class Taskpool(CoreTaskpool):
         task.data.update(ent["data"])
         task.priority = max(task.priority, ent["priority"])
         return task
+
+    def activate_deps(self, refs) -> List[Task]:
+        """Batched :meth:`activate_dep` (runtime.release_batch): group a
+        completed task's successor refs by seq-lock stripe so each stripe
+        is locked once per completion instead of once per dep. The
+        per-seq critical section is `_activate_one_locked`, shared with
+        the scalar path — only lock acquisitions are coalesced."""
+        if len(refs) == 1:
+            task = self.activate_dep(refs[0])
+            return [task] if task is not None else []
+        by_stripe: Dict[int, List] = {}
+        for ref in refs:
+            by_stripe.setdefault(ref.locals[0] & 63, []).append(ref)
+        out: List[Task] = []
+        for stripe, group in by_stripe.items():
+            with self._seq_locks[stripe]:
+                for ref in group:
+                    task = self._activate_one_locked(ref)
+                    if task is not None:
+                        out.append(task)
+        return out
 
     def wait(self, context=None) -> None:
         """parsec_dtd_taskpool_wait analog: drain all inserted tasks.

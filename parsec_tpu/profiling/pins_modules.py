@@ -26,7 +26,7 @@ from ..utils.debug import debug_verbose
 mca_param.register("pins", "",
                    help="comma-separated PINS modules to install at init "
                         "(task_profiler, print_steals, alperf, "
-                        "iterators_checker, counters)")
+                        "iterators_checker, counters, overhead)")
 
 
 class PinsModule:
@@ -285,12 +285,64 @@ class Counters(PinsModule):
             return {k: dict(v) for k, v in self.totals.items()}
 
 
+class OverheadProfiler(PinsModule):
+    """Per-stage runtime-overhead timers: insert (DTD insertion, on the
+    inserting thread), select (scheduler select), dispatch
+    (prepare_input + incarnation walk + hook call) and release
+    (release-deps: successor iteration, dependency countdown,
+    scheduling). The timers themselves live in the runtime hot loops
+    behind ``context.stage_timers`` (one attribute test when off —
+    ``runtime.stage_timers`` MCA param); this module flips the flag on
+    install and aggregates the collected stream/taskpool counters into
+    the per-task overhead budget the taskrate bench reports.
+
+    Reported seconds are THREAD seconds (summed across workers): with W
+    busy workers, per-task wall overhead is roughly the per-task thread
+    time / W."""
+
+    name = "overhead"
+
+    def install(self, context) -> "OverheadProfiler":
+        super().install(context)
+        self._prev_flag = context.stage_timers
+        context.stage_timers = True
+        return self
+
+    def uninstall(self) -> None:
+        super().uninstall()
+        self.context.stage_timers = self._prev_flag
+
+    def report(self) -> Dict[str, Any]:
+        agg = {"select_s": 0.0, "select_calls": 0, "dispatch_s": 0.0,
+               "release_s": 0.0, "executed": 0}
+        for es in self.context.streams:
+            for k in agg:
+                agg[k] += es.stats.get(k, 0)
+        agg["insert_s"] = 0.0
+        agg["insert_calls"] = 0
+        with self.context._lock:
+            pools = list(self.context._taskpools_by_name.values())
+        for tp in pools:
+            agg["insert_s"] += getattr(tp, "insert_s", 0.0)
+            agg["insert_calls"] += getattr(tp, "insert_calls", 0)
+        n = max(agg["executed"], 1)
+        agg["per_task_us"] = {
+            "insert": round(agg["insert_s"] / max(agg["insert_calls"], 1)
+                            * 1e6, 3),
+            "select": round(agg["select_s"] / n * 1e6, 3),
+            "dispatch": round(agg["dispatch_s"] / n * 1e6, 3),
+            "release": round(agg["release_s"] / n * 1e6, 3),
+        }
+        return agg
+
+
 _MODULES = {
     "task_profiler": TaskProfiler,
     "print_steals": PrintSteals,
     "alperf": Alperf,
     "iterators_checker": IteratorsChecker,
     "counters": Counters,
+    "overhead": OverheadProfiler,
 }
 
 

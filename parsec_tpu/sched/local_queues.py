@@ -44,10 +44,20 @@ class _LocalDeque:
             self.dq.extend(items)
 
     def pop_front(self) -> Optional[Task]:
+        # empty fast path without the lock (deque truthiness is
+        # GIL-atomic): steal scans walk every VP peer's deque, and
+        # paying a lock acquire per EMPTY victim dominated the starved
+        # select path. A push racing the check is caught by the next
+        # scan / the schedule() wakeup, exactly like a pop that lost
+        # the lock race.
+        if not self.dq:
+            return None
         with self.lock:
             return self.dq.popleft() if self.dq else None
 
     def pop_back(self) -> Optional[Task]:
+        if not self.dq:
+            return None
         with self.lock:
             return self.dq.pop() if self.dq else None
 
@@ -100,14 +110,14 @@ class _LocalQueueScheduler(Scheduler):
         return self._steal_and_system(es)
 
     def _steal_and_system(self, es) -> Optional[Task]:
-        """Steal from VP peers (topology-fixed order, cached on the
-        stream), then drain the system overflow queue."""
+        """Steal from VP peers (topology-fixed order, precomputed
+        WITHOUT self and cached on the stream — no per-scan identity
+        test), then drain the system overflow queue."""
         order = es._steal_order
         if order is None:
-            order = es._steal_order = self._steal_order(es)
+            order = es._steal_order = tuple(
+                p for p in self._steal_order(es) if p is not es)
         for peer in order:
-            if peer is es:
-                continue
             t = self._steal(peer.sched_obj)
             if t is not None:
                 es.stats["stolen"] += 1     # pins/print_steals counter
@@ -223,6 +233,8 @@ class _BandedQueues:
                 self.bands[self._band(t)].append(t)
 
     def pop_front(self) -> Optional[Task]:
+        if not any(self.bands):     # lock-free empty scan (see _LocalDeque)
+            return None
         with self.lock:
             for band in reversed(self.bands):     # high band first
                 if band:
@@ -232,6 +244,8 @@ class _BandedQueues:
     def pop_back(self) -> Optional[Task]:
         """Steal side: take from the LOWEST band's tail (leave the
         victim its high-priority work)."""
+        if not any(self.bands):
+            return None
         with self.lock:
             for band in self.bands:
                 if band:

@@ -38,6 +38,12 @@ def _fat_result():
         "getrf_fused": {"gflops": 63193.8, "note": "w" * 500},
         "ooc_potrf": {"gflops": 5.5, "hbm_measured": {"spills": 5},
                       "note": "v" * 500},
+        "taskrate": {"tasks_per_sec": 9876.5, "n_tasks": 20000,
+                     "overhead_us_per_task": 101.2,
+                     "stage_us_per_task": {"insert": 34.4, "select": 1.8,
+                                           "dispatch": 13.4,
+                                           "release": 8.2},
+                     "note": "u" * 300},
     }
     return {
         "metric": "tiled_potrf_gflops_per_chip",
@@ -74,6 +80,8 @@ def test_compact_summary_fits_tail_window():
     assert d["flash_gflops"] == 79600.1
     assert d["getrf_fused_gflops"] == 63193.8
     assert d["geqrf_fused_gflops"] == 104985.7
+    assert d["tasks_per_sec"] == 9876.5
+    assert d["taskrate_stage_us"]["insert"] == 34.4
 
 
 def test_compact_summary_parses_from_4k_tail():
@@ -118,6 +126,21 @@ def test_compare_captures_flags_gflops_drop():
     reg = out["throughput_regression"]
     assert "getrf_fused_gflops" in reg and "-17%" in reg, reg
     assert "value" not in reg and "flash" not in reg, reg
+
+
+def test_compare_captures_guards_tasks_per_sec():
+    """The taskrate row rides the same >10%-drop guard as the GFLOPS
+    rows (higher-is-better, identical direction)."""
+    bench = _load_bench()
+    prior = {"tasks_per_sec": 10000.0, "host_dtd_gflops": 2000.0}
+    out = bench._compare_captures(
+        {"tasks_per_sec": 8000.0, "host_dtd_gflops": 2100.0}, prior)
+    reg = out["throughput_regression"]
+    assert "tasks_per_sec" in reg and "-20%" in reg, reg
+    assert "host_dtd" not in reg
+    # within-band / improvements stay quiet
+    assert bench._compare_captures(
+        {"tasks_per_sec": 9500.0, "host_dtd_gflops": 2000.0}, prior) == {}
 
 
 def test_compare_captures_flags_latency_rise_only_on_worsening():

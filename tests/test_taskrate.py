@@ -1,0 +1,75 @@
+"""Null-task rate smoke (the taskrate bench's tier-1 guard): a gross
+per-task-overhead regression in the insert → schedule → select →
+dispatch → release path fails fast here, long before a chip capture.
+The floor is deliberately LENIENT (CI containers are slow and shared);
+the measured rate on this container is ~5-10k tasks/s."""
+
+import time
+
+import parsec_tpu as parsec
+from parsec_tpu.core.task import DeviceType
+from parsec_tpu import dtd
+from parsec_tpu.profiling.pins_modules import new_module
+from parsec_tpu.utils import mca_param
+
+# tasks/sec floor for N_TASKS null CPU tasks end-to-end. ~20-30x under
+# the rate this container measures — fires on order-of-magnitude
+# regressions (an accidental lock convoy, a sleep on the hot path),
+# not on CI weather.
+FLOOR_TASKS_PER_SEC = 300
+N_TASKS = 1500
+
+
+def _null_body():
+    return None
+
+
+def test_null_task_rate_floor():
+    ctx = parsec.init(nb_cores=4)
+    ctx.start()
+    tp = dtd.Taskpool("taskrate_smoke")
+    ctx.add_taskpool(tp)
+    t0 = time.perf_counter()
+    tasks = tp.insert_tasks(_null_body, [() for _ in range(N_TASKS)],
+                            device=DeviceType.CPU)
+    tp.wait()
+    dt = time.perf_counter() - t0
+    parsec.fini(ctx)
+    assert len(tasks) == N_TASKS and all(t is not None for t in tasks)
+    rate = N_TASKS / dt
+    assert rate > FLOOR_TASKS_PER_SEC, \
+        f"null-task rate {rate:.0f}/s under the {FLOOR_TASKS_PER_SEC}/s " \
+        f"floor — gross runtime-overhead regression"
+
+
+def test_overhead_module_reports_stage_breakdown():
+    """The `overhead` PINS module flips runtime.stage_timers and reports
+    nonzero per-stage timers covering every task."""
+    ctx = parsec.init(nb_cores=2)
+    mod = new_module("overhead").install(ctx)
+    assert ctx.stage_timers
+    ctx.start()
+    tp = dtd.Taskpool("taskrate_instr")
+    ctx.add_taskpool(tp)
+    tp.insert_tasks(_null_body, [() for _ in range(200)],
+                    device=DeviceType.CPU)
+    tp.wait()
+    rep = mod.report()
+    parsec.fini(ctx)
+    assert rep["executed"] == 200
+    assert rep["insert_calls"] == 200
+    per = rep["per_task_us"]
+    assert set(per) == {"insert", "select", "dispatch", "release"}
+    assert per["insert"] > 0 and per["dispatch"] > 0
+    assert rep["release_s"] > 0 and rep["select_s"] >= 0
+    mod.uninstall()
+    assert not ctx.stage_timers
+
+
+def test_stage_timers_off_by_default():
+    ctx = parsec.init(nb_cores=1)
+    try:
+        assert not ctx.stage_timers
+        assert str(mca_param.get("runtime.stage_timers", 0)) in ("0",)
+    finally:
+        parsec.fini(ctx)
