@@ -44,6 +44,7 @@ from . import compiled
 from . import comm
 from . import profiling
 from . import ops
+from . import analysis
 
 __all__ = [
     "__version__",
@@ -51,6 +52,6 @@ __all__ = [
     "Taskpool", "TaskClass", "Flow", "FlowAccess", "Task", "compose",
     "Future", "DataCopyFuture", "ReshapeSpec",
     "dsl", "dtd", "ptg", "data", "device", "sched", "termdet",
-    "compiled", "comm", "profiling", "ops", "mca_param",
+    "compiled", "comm", "profiling", "ops", "analysis", "mca_param",
     "debug_verbose", "set_verbosity",
 ]

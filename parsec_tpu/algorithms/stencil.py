@@ -14,8 +14,6 @@ or arrays — the body only needs ``+`` and ``*``.
 
 from __future__ import annotations
 
-from typing import Optional
-
 from ..dsl import ptg
 from ..data.collection import DataCollection
 

@@ -1,0 +1,45 @@
+"""parsec_tpu.analysis — dataflow hazard checker + runtime race sanitizer.
+
+Two cooperating halves audit that a taskpool's dependency declarations
+fully determine its execution order (the PaRSEC correctness claim):
+
+- **Static DAG lint** (:mod:`~parsec_tpu.analysis.lint` over
+  :mod:`~parsec_tpu.analysis.model`): symbolically enumerates a PTG/JDF
+  taskpool's flow specs and reports undeclared producers, WAW/WAR
+  hazards, access-mode violations, dangling outputs, dependency cycles
+  and owner-computes affinity mismatches.  Exposed as
+  ``taskpool.validate()``, the ``analysis.lint = off|warn|error`` MCA
+  knob (checked at taskpool registration), and the
+  ``python -m parsec_tpu.analysis`` CLI.
+- **Runtime race sanitizer** (:mod:`~parsec_tpu.analysis.dfsan`, the
+  ``dfsan`` PINS module): FastTrack-style vector clocks over every tile
+  access observed through the release paths, striped-lock order
+  tracking, and a per-tile version-sequence determinism digest guarding
+  the scheduler/release fast paths.
+
+Reference counterparts: jdf_sanity_checks (jdf.c), the grapher/DOT
+tooling (parsec_prof_grapher.c) and the iterators_checker PINS module.
+"""
+
+from __future__ import annotations
+
+from ..utils import mca_param
+
+mca_param.register(
+    "analysis.lint", "off", choices=("off", "warn", "error"),
+    help="static dataflow lint at taskpool registration: off | warn "
+         "(log findings) | error (refuse taskpools with error-severity "
+         "findings)")
+mca_param.register(
+    "analysis.lint_max_tasks", 20000,
+    help="instance-enumeration cap for the lint; larger task spaces "
+         "degrade to structural (per-class) checks only")
+
+from .lint import Finding, HazardError, LintReport, lint_taskpool, validate
+from .model import Model, build_model
+from .dfsan import DataflowSanitizer, RaceReport
+
+__all__ = [
+    "Finding", "HazardError", "LintReport", "lint_taskpool", "validate",
+    "Model", "build_model", "DataflowSanitizer", "RaceReport",
+]

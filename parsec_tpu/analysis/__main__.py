@@ -1,0 +1,122 @@
+"""``python -m parsec_tpu.analysis`` — lint the shipped algorithms.
+
+The CLI half of the hazard checker (the reference's ``--dot`` grapher +
+ptgpp sanity checks rolled into one command):
+
+- default: statically lint every shipped algorithm taskpool
+  (potrf, getrf, getrf_left, geqrf, gemm, stencil) over a small tile
+  grid and report findings; exit 1 if any error-severity finding fires
+  (the shipped algorithms are the lint's zero-false-positive contract);
+- ``--self-check``: additionally lint the seeded hazard fixtures
+  (analysis/fixtures.py: racy, cyclic, undeclared producer, access
+  violation, ...) and FAIL unless each is caught with an actionable
+  message naming the task class, flow and coordinates;
+- ``--dot PATH``: write the selected algorithm's instance DAG as DOT,
+  edges colored by FlowAccess, hazard edges marked (grapher.py).
+
+Purely static — no runtime context is started and no task bodies run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Dict, List
+
+
+def _build_algorithms(nt: int) -> Dict[str, object]:
+    """Small instances of the five shipped algorithm families (six
+    taskpools — both LU variants), sized for full enumeration."""
+    from ..algorithms import (build_gemm_ptg, build_geqrf, build_getrf,
+                              build_getrf_left, build_potrf,
+                              build_stencil_1d)
+    from ..data import LocalCollection, TiledMatrix
+    nb = 16
+    sq = TiledMatrix(nt * nb, nt * nb, nb, nb, name="A")
+    out = {
+        "potrf": build_potrf(sq),
+        "getrf": build_getrf(TiledMatrix(nt * nb, nt * nb, nb, nb,
+                                         name="A")),
+        "getrf_left": build_getrf_left(TiledMatrix(nt * nb, nt * nb, nb, nb,
+                                                   name="A")),
+        "geqrf": build_geqrf(TiledMatrix((nt + 1) * nb, nt * nb, nb, nb,
+                                         name="A")),
+        "gemm": build_gemm_ptg(TiledMatrix(nt * nb, nt * nb, nb, nb,
+                                           name="A"),
+                               TiledMatrix(nt * nb, nt * nb, nb, nb,
+                                           name="B"),
+                               TiledMatrix(nt * nb, nt * nb, nb, nb,
+                                           name="C")),
+        "stencil": build_stencil_1d(
+            LocalCollection("X", {(i,): 0.0 for i in range(nt)}),
+            n_tiles=nt, timesteps=max(nt - 1, 2)),
+    }
+    return out
+
+
+def main(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m parsec_tpu.analysis",
+        description="static dataflow hazard lint over PTG taskpools")
+    ap.add_argument("--algo", default="all",
+                    help="algorithm to lint: all | potrf | getrf | "
+                         "getrf_left | geqrf | gemm | stencil")
+    ap.add_argument("--nt", type=int, default=4,
+                    help="tile-grid size for the lint instances")
+    ap.add_argument("--dot", default="",
+                    help="write the (single) selected algorithm's DAG "
+                         "as DOT with hazard edges marked")
+    ap.add_argument("--self-check", action="store_true",
+                    help="also lint the seeded hazard fixtures and fail "
+                         "unless every one is caught")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print every finding, not just summaries")
+    args = ap.parse_args(argv)
+
+    pools = _build_algorithms(args.nt)
+    if args.algo != "all":
+        if args.algo not in pools:
+            ap.error(f"unknown algorithm {args.algo!r}; have "
+                     f"{', '.join(sorted(pools))}")
+        pools = {args.algo: pools[args.algo]}
+
+    rc = 0
+    last_report = None
+    for name, tp in sorted(pools.items()):
+        report = tp.validate(mode="none")    # lint only, never raise
+        last_report = report
+        status = "clean" if not report.findings else \
+            f"{len(report.errors)} errors, {len(report.warnings)} warnings"
+        print(f"[lint] {name}: {report.summary()} — {status}")
+        if args.verbose or report.findings:
+            for f in report.findings:
+                print(f"       {f}")
+        if report.errors:
+            rc = 1
+
+    if args.dot:
+        if len(pools) != 1:
+            print("[dot] --dot needs a single --algo selection",
+                  file=sys.stderr)
+            return 2
+        with open(args.dot, "w") as fh:
+            fh.write(last_report.to_dot())
+        print(f"[dot] wrote {args.dot}")
+
+    if args.self_check:
+        from .fixtures import self_check
+        failures, lines = self_check()
+        for line in lines:
+            print(f"[self-check] {line}")
+        if failures:
+            print(f"[self-check] FAILED: {failures} fixture(s) not caught")
+            rc = 1
+        else:
+            print("[self-check] all seeded hazards caught")
+
+    print("OK" if rc == 0 else "FAILED")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

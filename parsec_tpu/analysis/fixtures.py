@@ -1,0 +1,229 @@
+"""Lint fixtures: intentionally-broken PTG taskpools.
+
+Each builder returns a taskpool seeded with exactly one class of bug the
+lint must catch (plus a clean control).  The CLI's ``--self-check`` mode
+asserts every fixture is flagged with an actionable message naming the
+task class, flow and coordinates; ``examples/ex08_lint_hazards.py``
+walks the same fixtures interactively.  The racy fixture carries real
+bodies so the runtime race sanitizer (analysis/dfsan.py) can execute it
+and observe the same hazard dynamically.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..data.collection import LocalCollection
+from ..dsl import ptg
+
+#: fixture name -> (builder, rules the lint MUST report for it)
+FIXTURES = {}
+
+
+def _fixture(rules):
+    def deco(fn):
+        FIXTURES[fn.__name__.replace("build_", "")] = (fn, tuple(rules))
+        return fn
+    return deco
+
+
+def _store(n: int = 4) -> LocalCollection:
+    return LocalCollection("S", {(i,): float(i) for i in range(n)})
+
+
+@_fixture(rules=())
+def build_clean() -> ptg.Taskpool:
+    """Control: a well-formed 4-deep chain — zero findings expected."""
+    tp = ptg.Taskpool("clean", N=4, S=_store(1))
+    tp.task_class(
+        "T", params=("i",),
+        space=lambda g: ((i,) for i in range(g.N)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, i: (g.S, (0,)),
+                        guard=lambda g, i: i == 0),
+                 ptg.In(src=("T", lambda g, i: (i - 1,), "X"),
+                        guard=lambda g, i: i > 0)],
+            outs=[ptg.Out(dst=("T", lambda g, i: (i + 1,), "X"),
+                          guard=lambda g, i: i < g.N - 1),
+                  ptg.Out(data=lambda g, i: (g.S, (0,)),
+                          guard=lambda g, i: i == g.N - 1)])])
+    return tp
+
+
+@_fixture(rules=("waw-hazard", "war-hazard"))
+def build_racy() -> ptg.Taskpool:
+    """Two independent task classes both write tile S(0,) and a third
+    reads it, with no dependency edges at all: a WAW hazard between the
+    writers and read/write hazards against the reader.  Bodies are real
+    so the fixture also runs under the dfsan sanitizer, which must
+    observe the same races dynamically."""
+    tp = ptg.Taskpool("racy", S=_store(1))
+    W1 = tp.task_class(
+        "W1", params=("i",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, i: (g.S, (0,)))],
+            outs=[ptg.Out(data=lambda g, i: (g.S, (0,)))])])
+    W2 = tp.task_class(
+        "W2", params=("i",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, i: (g.S, (0,)))],
+            outs=[ptg.Out(data=lambda g, i: (g.S, (0,)))])])
+    R = tp.task_class(
+        "R", params=("i",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "X", ptg.READ,
+            ins=[ptg.In(data=lambda g, i: (g.S, (0,)))])])
+
+    @W1.body
+    def w1_body(task, x):
+        return x + 1.0
+
+    @W2.body
+    def w2_body(task, x):
+        return x + 10.0
+
+    @R.body
+    def r_body(task, x):
+        return None
+    return tp
+
+
+@_fixture(rules=("cycle",))
+def build_cyclic() -> ptg.Taskpool:
+    """P(0) feeds Q(0) feeds P(0): a dependency cycle — neither task can
+    ever reach its deps goal, so the taskpool would hang at runtime.
+    Both sides declare their producers, so ONLY the cycle rule fires."""
+    tp = ptg.Taskpool("cyclic", S=_store(1))
+    tp.task_class(
+        "P", params=("i",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(src=("Q", lambda g, i: (i,), "Y"))],
+            outs=[ptg.Out(dst=("Q", lambda g, i: (i,), "Y"))])])
+    tp.task_class(
+        "Q", params=("i",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "Y", ptg.RW,
+            ins=[ptg.In(src=("P", lambda g, i: (i,), "X"))],
+            outs=[ptg.Out(dst=("P", lambda g, i: (i,), "X"))])])
+    return tp
+
+
+@_fixture(rules=("undeclared-producer",))
+def build_undeclared_producer() -> ptg.Taskpool:
+    """C(0) declares ``<- X P(0)`` but P's flow X only writes back to the
+    collection — it never emits to C, so C's dep can never be satisfied
+    (a silent runtime hang without the lint)."""
+    tp = ptg.Taskpool("undeclared", S=_store(2))
+    tp.task_class(
+        "P", params=("i",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, i: (g.S, (0,)))],
+            outs=[ptg.Out(data=lambda g, i: (g.S, (0,)))])])
+    tp.task_class(
+        "C", params=("i",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "X", ptg.READ,
+            ins=[ptg.In(src=("P", lambda g, i: (i,), "X"))])])
+    return tp
+
+
+@_fixture(rules=("access-violation",))
+def build_access_violation() -> ptg.Taskpool:
+    """A READ flow with a terminal collection write-back and a CTL flow
+    carrying a data input — both contradict the declared FlowAccess
+    (only WRITE/RW flows are output flows, core/task.py)."""
+    tp = ptg.Taskpool("badaccess", S=_store(2))
+    tp.task_class(
+        "T", params=("i",), space=lambda g: ((0,),),
+        flows=[
+            ptg.FlowSpec(
+                "X", ptg.READ,
+                ins=[ptg.In(data=lambda g, i: (g.S, (0,)))],
+                outs=[ptg.Out(data=lambda g, i: (g.S, (0,)))]),
+            ptg.FlowSpec(
+                "K", ptg.CTL,
+                ins=[ptg.In(data=lambda g, i: (g.S, (1,)))]),
+        ])
+    return tp
+
+
+@_fixture(rules=("phantom-target",))
+def build_phantom_target() -> ptg.Taskpool:
+    """T(i) feeds T(i+1) without bounding the range: the last instance
+    aims at a task outside the class space."""
+    tp = ptg.Taskpool("phantom", N=3, S=_store(1))
+    tp.task_class(
+        "T", params=("i",),
+        space=lambda g: ((i,) for i in range(g.N)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, i: (g.S, (0,)),
+                        guard=lambda g, i: i == 0),
+                 ptg.In(src=("T", lambda g, i: (i - 1,), "X"),
+                        guard=lambda g, i: i > 0)],
+            outs=[ptg.Out(dst=("T", lambda g, i: (i + 1,), "X"))])])
+    return tp
+
+
+@_fixture(rules=("dangling-output",))
+def build_dangling_output() -> ptg.Taskpool:
+    """A WRITE flow whose produced value nothing consumes (not tiled on
+    a scratch collection) — silently dropped work."""
+    tp = ptg.Taskpool("dangling", S=_store(1))
+    tp.task_class(
+        "T", params=("i",), space=lambda g: ((0,),),
+        flows=[
+            ptg.FlowSpec(
+                "X", ptg.RW,
+                ins=[ptg.In(data=lambda g, i: (g.S, (0,)))],
+                outs=[ptg.Out(data=lambda g, i: (g.S, (0,)))]),
+            ptg.FlowSpec("Y", ptg.WRITE, outs=[]),
+        ])
+    return tp
+
+
+def self_check() -> Tuple[int, list]:
+    """Lint every fixture and verify the expected rules fire with
+    messages naming the task class, flow and coordinates; verify the
+    clean control yields zero findings.  Returns (failures, log_lines).
+    """
+    from .lint import lint_taskpool
+    failures = 0
+    lines = []
+    for name, (builder, rules) in sorted(FIXTURES.items()):
+        tp = builder()
+        report = lint_taskpool(tp)
+        got = {f.rule for f in report.findings}
+        if not rules:
+            if report.findings:
+                failures += 1
+                lines.append(f"FAIL {name}: expected clean, got {got}")
+            else:
+                lines.append(f"ok   {name}: clean")
+            continue
+        missing = set(rules) - got
+        if missing:
+            failures += 1
+            lines.append(f"FAIL {name}: rules {missing} not reported "
+                         f"(got {got or 'nothing'})")
+            continue
+        # actionable messages: every expected finding names the task
+        # class and flow, and instance-level findings carry coordinates
+        # (structural per-class findings like CTL-with-data apply to the
+        # whole class, so class.flow is the precise site)
+        vague = [f for f in report.findings
+                 if f.rule in rules and not (
+                     f.task and (f.flow or "(" in f.message))]
+        if vague:
+            failures += 1
+            lines.append(f"FAIL {name}: finding lacks task coordinates: "
+                         f"{vague[0]}")
+            continue
+        shown = next(f for f in report.findings if f.rule in rules)
+        lines.append(f"ok   {name}: {shown}")
+    return failures, lines

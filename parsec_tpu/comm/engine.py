@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import enum
 import threading
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Optional
 
 
 class AMTag(enum.IntEnum):

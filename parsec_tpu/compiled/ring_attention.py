@@ -26,7 +26,6 @@ overlaps the collectives.
 
 from __future__ import annotations
 
-import functools
 import math
 from typing import Optional
 

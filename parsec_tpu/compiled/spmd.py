@@ -17,7 +17,7 @@ collectives carry only true dataflow.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Optional
 
 import numpy as np
 

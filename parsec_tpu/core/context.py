@@ -24,13 +24,13 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import weakref
 
 from .future import DataCopyFuture
 from .reshape import resolve_reshape
-from .task import Chore, DeviceType, HookReturn, Task, TaskStatus
+from .task import HookReturn, Task, TaskStatus
 from .taskpool import DataRef, SuccessorRef, Taskpool
 from ..utils import debug_history, mca_param
 from ..utils.debug import debug_verbose, warning
@@ -154,6 +154,8 @@ class Context:
         self._work_evt = threading.Event()
         self.grapher = None          # profiling.grapher hook
         self.trace = None            # profiling trace hook
+        self.dfsan = None            # analysis.dfsan race sanitizer (PINS
+        #                              module sets it; None = zero overhead)
         # PINS modules selected by the `pins` MCA param; must come after
         # trace/grapher init (task_profiler installs a Trace on self.trace)
         from ..profiling import pins_modules as pins_modules_mod
@@ -185,6 +187,13 @@ class Context:
     # ------------------------------------------------------------------ API
     def add_taskpool(self, tp: Taskpool) -> None:
         """parsec_context_add_taskpool analog (scheduling.c:678-727)."""
+        # registration-time static lint (analysis.lint = off|warn|error):
+        # with `error`, a taskpool whose flow declarations carry hazards
+        # (undeclared producers, WAW, cycles, ...) is refused BEFORE any
+        # runtime state is touched (analysis/lint.py HazardError)
+        lint_mode = str(mca_param.get("analysis.lint", "off")).lower()
+        if lint_mode in ("warn", "error") and tp.task_classes:
+            tp.validate(mode=lint_mode)
         if tp.monitor is None:
             tp.monitor = termdet_mod.new_monitor(comm=self.comm)
         tp.monitor.monitor(tp._on_terminated)
@@ -360,6 +369,11 @@ class Context:
             return self._taskpools_by_name.get(name)
 
     def _taskpool_terminated(self, tp: Taskpool) -> None:
+        if self.dfsan is not None:
+            # termdet is a full synchronization point: everything the
+            # pool did happens-before whatever runs next (keeps the
+            # sanitizer race-free across sequentially-run taskpools)
+            self.dfsan.barrier()
         with self._cv:
             try:
                 self._active_taskpools.remove(tp)
@@ -540,6 +554,8 @@ class Context:
         # engine's remote_dep_activate_multi
         remote_groups: Optional[Dict[Tuple[int, int], List]] = \
             {} if self.nb_ranks > 1 else None
+        san = self.dfsan
+        grapher = self.grapher
         for ref in tc.iterate_successors(task):
             if isinstance(ref, DataRef):
                 # track (pinned) first, write second, unpin last — see
@@ -548,10 +564,21 @@ class Context:
                 if self.hbm is not None:
                     mkey = self._hbm_track(ref.collection, ref.key,
                                            ref.value)
+                if san is not None:
+                    # stamp the committed version BEFORE it lands so a
+                    # racing reader's check sees the writer's clock
+                    san.observe_write(task, ref.collection, ref.key)
                 ref.collection.write_tile(ref.key, ref.value)
                 if mkey is not None:
                     self.hbm.unpin(mkey)
                 continue
+            if san is not None:
+                # happens-before edge task -> successor, observed BEFORE
+                # the dep is counted (the successor may run immediately)
+                san.observe_edge(task, ref)
+            if grapher is not None:
+                grapher.dep_edge(task, ref.task_class, ref.locals,
+                                 ref.flow_name)
             if ref.reshape_spec is not None or \
                     isinstance(ref.value, DataCopyFuture):
                 # reshape promise: one shared conversion per layout

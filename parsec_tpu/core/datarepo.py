@@ -31,14 +31,26 @@ class DataRepoEntry:
         self.retained = 1           # producer's retain; released on set_usage
 
     def get(self, flow_index: int) -> Any:
+        obs = DataRepo.observer
+        if obs is not None:
+            obs("get", self.repo, self.key, flow_index)
         return self.data[flow_index]
 
     def set(self, flow_index: int, value: Any) -> None:
+        obs = DataRepo.observer
+        if obs is not None:
+            obs("set", self.repo, self.key, flow_index)
         self.data[flow_index] = value
 
 
 class DataRepo:
     """Hash table of :class:`DataRepoEntry` (datarepo.c analog)."""
+
+    #: process-wide access observer ``fn(op, repo, key, flow_index)`` —
+    #: installed by the dfsan race sanitizer (analysis/dfsan.py) so repo
+    #: entry fills/takes on the release path are stamped too; None keeps
+    #: the accessors at one attribute read of overhead
+    observer = None
 
     def __init__(self, nb_flows: int = 1):
         self.nb_flows = nb_flows

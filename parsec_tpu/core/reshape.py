@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import itertools
 import weakref
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Optional
 
 _spec_ids = itertools.count(1)
 

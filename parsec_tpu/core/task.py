@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import enum
 import itertools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 
@@ -118,7 +118,7 @@ class Task:
 
     __slots__ = ("taskpool", "task_class", "locals", "data", "output",
                  "priority", "chore_mask", "status", "uid", "repo_entry",
-                 "on_complete", "prof", "dsl")
+                 "on_complete", "prof", "dsl", "vc")
 
     def __init__(self, taskpool, task_class, locals: Tuple[int, ...],
                  priority: int = 0):
@@ -137,6 +137,9 @@ class Task:
         self.on_complete: Optional[Callable[["Task"], None]] = None
         self.prof: Dict[str, float] = {}
         self.dsl: Dict[str, Any] = {}   # DSL-private state (DTD links, ...)
+        # vector clock stamped by the dfsan race sanitizer
+        # (analysis/dfsan.py); None whenever the sanitizer is off
+        self.vc: Optional[Dict[int, int]] = None
 
     @property
     def key(self) -> Tuple[int, Tuple[int, ...]]:

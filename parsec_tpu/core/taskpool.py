@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import itertools
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from .task import Chore, DeviceType, Flow, FlowAccess, Task
@@ -144,6 +144,10 @@ class _PendingDeps:
     def __init__(self) -> None:
         self._entries: Dict[Any, Dict[str, Any]] = {}
         self._locks = [threading.Lock() for _ in range(self._NSTRIPES)]
+        # dfsan race sanitizer (analysis/dfsan.py): when installed, the
+        # stripe locks report acquisition order so lock-order inversions
+        # are flagged; None keeps the hot path a bare Lock
+        self.sanitizer = None
         self._native = None
         self._native_lib = None
         from ..utils import mca_param
@@ -160,7 +164,14 @@ class _PendingDeps:
             self._native = None
 
     def _lock_for(self, key) -> threading.Lock:
-        return self._locks[hash(key) % self._NSTRIPES]
+        return self._stripe_lock(hash(key) % self._NSTRIPES)
+
+    def _stripe_lock(self, stripe: int):
+        lock = self._locks[stripe]
+        san = self.sanitizer
+        if san is not None:
+            return san.wrap_lock(lock, "pdep", stripe)
+        return lock
 
     @staticmethod
     def _key64(key) -> int:
@@ -256,7 +267,7 @@ class _PendingDeps:
                                  []).append(i)
         out = []
         for stripe, idxs in by_stripe.items():
-            with self._locks[stripe]:
+            with self._stripe_lock(stripe):
                 for i in idxs:
                     (key, flow_name, value, dep_index, goal, mode,
                      priority) = items[i]
@@ -339,6 +350,23 @@ class Taskpool:
         """Lookup by name (PTG taskpools shadow ``task_class`` with the
         class-builder, so the lookup has its own name)."""
         return self._tc_by_name[name]
+
+    # -- static hazard lint (analysis/lint.py) ----------------------------
+    def validate(self, mode: str = "error", max_tasks: int = 0):
+        """Run the static dataflow lint over this taskpool and return
+        the :class:`~parsec_tpu.analysis.lint.LintReport`.
+
+        ``mode="error"`` raises :class:`~parsec_tpu.analysis.lint.
+        HazardError` when any error-severity finding (undeclared
+        producer, WAW/WAR hazard, access-mode violation, dependency
+        cycle, phantom target) is present; ``mode="warn"`` logs the
+        findings instead.  Task classes without closed-form PTG specs
+        (DTD) are skipped — the dfsan runtime sanitizer covers those.
+        Also invoked at registration when the ``analysis.lint`` MCA
+        param is ``warn``/``error`` (Context.add_taskpool).
+        """
+        from ..analysis.lint import validate as _validate
+        return _validate(self, mode=mode, max_tasks=max_tasks)
 
     def new_task_class(self, name: str, params: Sequence[str],
                        flows: Sequence[Flow],

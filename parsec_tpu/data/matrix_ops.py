@@ -13,7 +13,7 @@ host runtime and the compiled SPMD lowering.
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Tuple
 
 from ..comm.collectives import BcastTopology, bcast_tree_children, bcast_tree_parent
 from ..dsl import ptg

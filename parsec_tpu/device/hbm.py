@@ -33,8 +33,7 @@ with an artificially small budget on CPU).
 from __future__ import annotations
 
 import threading
-import time
-from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
+from typing import Any, Callable, Dict, Hashable, Optional, Tuple
 
 import numpy as np
 

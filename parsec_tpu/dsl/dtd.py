@@ -234,6 +234,17 @@ class Taskpool(CoreTaskpool):
 
         self.on_enqueue = _on_enqueue
 
+    def _seq_lock(self, stripe: int):
+        """Seq-stripe lock, wrapped for acquisition-order reporting when
+        the dfsan sanitizer is installed (analysis/dfsan.py); a bare
+        Lock otherwise — the hot path pays one attribute read."""
+        lock = self._seq_locks[stripe]
+        ctx = self.context
+        san = ctx.dfsan if ctx is not None else None
+        if san is not None:
+            return san.wrap_lock(lock, "dtd-seq", stripe)
+        return lock
+
     # -- rank helpers ------------------------------------------------------
     @property
     def my_rank(self) -> int:
@@ -570,7 +581,7 @@ class Taskpool(CoreTaskpool):
 
         # register before linking so a racing writer completion can route
         # activations to this task
-        with self._seq_locks[seq & 63]:
+        with self._seq_lock(seq & 63):
             self._goals[seq] = _GOAL_UNSET
             self._tasks_by_seq[seq] = task
         with self._inflight_cv:
@@ -633,6 +644,18 @@ class Taskpool(CoreTaskpool):
                     linked = True
                 if not linked:
                     if holder == my_rank:
+                        san = self.context.dfsan
+                        if san is not None:
+                            # sync read: the tile-lock + retire protocol
+                            # orders this snapshot after the last commit
+                            # (write_tile happens-before last_writer is
+                            # cleared), so join the tile's write clock
+                            # into this task instead of race-checking —
+                            # also what keeps a LATER write by this task
+                            # WAW-ordered after a retired writer that
+                            # left no dep edge behind
+                            san.observe_read(task, a.collection, a.key,
+                                             sync=True)
                         # current version is local: snapshot the
                         # program-order value now (immutable arrays keep
                         # the snapshot valid); stage-through so one H2D
@@ -657,7 +680,7 @@ class Taskpool(CoreTaskpool):
         # lock, so an activation can never count against a stale
         # _GOAL_UNSET after we finalized (that interleaving left the
         # entry uncompletable forever — a lost-wakeup hang).
-        with self._seq_locks[seq & 63]:
+        with self._seq_lock(seq & 63):
             self._goals[seq] = goal
             ent = None if goal == 0 else self.pending.finalize(
                 tc.make_key(task.locals), goal, DEPS_COUNTER)
@@ -743,10 +766,17 @@ class Taskpool(CoreTaskpool):
                 task.data[alias] = task.data.get(primary)
 
     def _iterate_successors(self, task: Task):
+        ctx = self.context
+        san = ctx.dfsan if ctx is not None else None
         # 1) write produced versions back and retire the writer slot, so
         #    late-inserted readers snapshot the new value
         for tile, fname in task.dsl["out_tiles"]:
             if fname in task.output:
+                if san is not None:
+                    # stamp BEFORE the commit and the retire: an insert
+                    # that observes last_writer cleared is guaranteed to
+                    # find this write already clocked (sync-read join)
+                    san.observe_write(task, tile.collection, tile.key)
                 tile.collection.write_tile(tile.key, task.output[fname])
             with tile.lock:
                 if tile.last_writer is task:
@@ -772,7 +802,7 @@ class Taskpool(CoreTaskpool):
                 ref.value = task.data.get(src_flow)
             refs.append(ref)
         seq = task.locals[0]
-        with self._seq_locks[seq & 63]:
+        with self._seq_lock(seq & 63):
             self._goals.pop(seq, None)
             self._tasks_by_seq.pop(seq, None)
         with self._inflight_cv:
@@ -794,7 +824,7 @@ class Taskpool(CoreTaskpool):
         until insert_task finalizes the goal — the parked-undiscovered-task
         protocol (remote_dep_mpi.c:1935-1961)."""
         seq = ref.locals[0]
-        with self._seq_locks[seq & 63]:
+        with self._seq_lock(seq & 63):
             return self._activate_one_locked(ref)
 
     def _activate_one_locked(self, ref: SuccessorRef) -> Optional[Task]:
@@ -835,7 +865,7 @@ class Taskpool(CoreTaskpool):
             by_stripe.setdefault(ref.locals[0] & 63, []).append(ref)
         out: List[Task] = []
         for stripe, group in by_stripe.items():
-            with self._seq_locks[stripe]:
+            with self._seq_lock(stripe):
                 for ref in group:
                     task = self._activate_one_locked(ref)
                     if task is not None:

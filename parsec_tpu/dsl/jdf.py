@@ -735,8 +735,23 @@ class CompiledJDF:
         self.name = name
 
     # -- constructor ------------------------------------------------------
-    def taskpool(self, **global_values) -> ptg.Taskpool:
+    def taskpool(self, *, lint: Optional[str] = None,
+                 **global_values) -> ptg.Taskpool:
+        """Build a taskpool bound to ``global_values``.
+
+        ``lint`` optionally runs the static hazard checker on the freshly
+        compiled taskpool (``"warn"`` logs findings, ``"error"`` raises
+        :class:`~parsec_tpu.analysis.lint.HazardError`) — the ptgpp
+        compile-time sanity checks cover syntax/shape, the lint covers
+        the *instantiated* dataflow (undeclared producers, WAW/WAR
+        hazards, cycles) the compiler cannot see without the globals.
+        """
         declared = {g.name for g in self.ast.globals}
+        if "lint" in declared:
+            # the parameter would silently capture the global's value
+            raise JDFSemanticError(
+                "global name 'lint' is reserved by taskpool(lint=...); "
+                "rename the JDF global")
         ns: Dict[str, Any] = dict(_SAFE_BUILTINS)
         for g in self.ast.globals:
             if g.name in global_values:
@@ -769,6 +784,8 @@ class CompiledJDF:
             ptc = tp.task_class_by_name(tc_ast.name)
             for b in tc_ast.bodies:
                 self._attach_body(ptc, tc_ast, b, envs[tc_ast.name])
+        if lint:
+            tp.validate(mode=lint)
         return tp
 
     # -- space (startup-task enumerator analog, jdf2c.c:2989) -------------

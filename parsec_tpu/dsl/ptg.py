@@ -52,7 +52,7 @@ from __future__ import annotations
 
 import types
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ..core.future import DataCopyFuture
 from ..core.reshape import compose_specs
@@ -263,6 +263,12 @@ class PTGTaskClass(TaskClass):
                 value = dc.data_of(key)
                 ctx = self.tp.context
                 if ctx is not None:
+                    san = ctx.dfsan
+                    if san is not None:
+                        # race-checked: a collection read unordered with
+                        # a terminal writer of the same tile observes a
+                        # schedule-dependent version (analysis/dfsan.py)
+                        san.observe_read(task, dc, key)
                     # stage-through: the collection keeps the device
                     # copy so one H2D serves every reader (Context.
                     # stage_read; no-op without an accelerator)

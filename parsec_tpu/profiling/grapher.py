@@ -3,6 +3,13 @@
 Reference: parsec/parsec_prof_grapher.c (266 LoC), enabled by the --dot
 flag (parsec.c:589-607) — emits one .dot file per rank with a node per
 executed task and an edge per satisfied dependency.
+
+Edges are colored by the *consumer flow's* :class:`~parsec_tpu.core.
+task.FlowAccess` (READ/WRITE/RW solid, CTL dashed grey), and hazard
+edges reported by the static lint (analysis/lint.py ``LintReport.
+to_dot``) are drawn red/bold/dotted with the rule name — the same DOT
+output doubles as the lint's visual report and the runtime's executed
+DAG capture (``profiling.dot`` MCA param).
 """
 
 from __future__ import annotations
@@ -10,37 +17,100 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..core.task import FlowAccess
+
+#: edge color per consumer-flow access mode (colorblind-safe hues)
+ACCESS_COLORS = {
+    FlowAccess.READ: "#1b7837",     # green  — value consumed
+    FlowAccess.WRITE: "#d95f0e",    # orange — value produced
+    FlowAccess.RW: "#2166ac",       # blue   — consumed and produced
+    FlowAccess.CTL: "#878787",      # grey   — control only (dashed)
+}
+HAZARD_COLOR = "#b2182b"
+
+
+def _access_attrs(access: Optional[FlowAccess]) -> str:
+    if access is None:
+        return ""
+    if access & FlowAccess.CTL:
+        return f' color="{ACCESS_COLORS[FlowAccess.CTL]}" style=dashed'
+    color = ACCESS_COLORS.get(FlowAccess(access & FlowAccess.RW))
+    return f' color="{color}"' if color else ""
+
 
 class Grapher:
     def __init__(self) -> None:
         self._nodes: Dict[str, Dict] = {}
-        self._edges: List[Tuple[str, str, str]] = []
+        self._edges: List[Tuple[str, str, str, Optional[FlowAccess]]] = []
+        # hazard overlay: (src, dst, flow, rule) — rendered red/bold;
+        # these are NOT dependency edges (their absence is the hazard)
+        self._hazards: List[Tuple[str, str, str, str]] = []
         self._lock = threading.Lock()
 
     def install(self, context) -> "Grapher":
         context.grapher = self
         return self
 
+    # -- runtime capture (Context.complete_task) ---------------------------
     def task_executed(self, task) -> None:
         with self._lock:
             self._nodes[repr(task)] = {"class": task.task_class.name}
 
-    def dep_edge(self, src_task, dst_repr: str, flow: str) -> None:
+    def dep_edge(self, src_task, dst_class, dst_locals, flow: str) -> None:
+        """One satisfied dependency src_task → dst_class(dst_locals).flow
+        (called by the release path); colored by the consumer flow's
+        access mode."""
+        dst = f"{dst_class.name}({', '.join(map(str, dst_locals))})"
+        dst_flow = dst_class.flow_by_name.get(flow)
+        access = dst_flow.access if dst_flow is not None else None
         with self._lock:
-            self._edges.append((repr(src_task), dst_repr, flow))
+            self._edges.append((repr(src_task), dst, flow, access))
 
+    # -- static capture (analysis/lint.py visual report) -------------------
+    def add_node(self, label: str, task_class: str) -> None:
+        with self._lock:
+            self._nodes[label] = {"class": task_class}
+
+    def add_edge(self, src: str, dst: str, flow: str,
+                 access: Optional[FlowAccess] = None) -> None:
+        with self._lock:
+            self._edges.append((src, dst, flow, access))
+
+    def mark_hazard(self, src: str, dst: str, flow: str, rule: str) -> None:
+        """Overlay a hazard reported by the lint: src and dst are the
+        unordered pair (or consecutive cycle members); drawn red."""
+        with self._lock:
+            self._hazards.append((src, dst, flow, rule))
+            # hazard endpoints may not be executed/enumerated nodes yet
+            self._nodes.setdefault(src, {"class": src.split("(")[0]})
+            self._nodes.setdefault(dst, {"class": dst.split("(")[0]})
+
+    # -- rendering ---------------------------------------------------------
     def to_dot(self) -> str:
         palette = ["#66c2a5", "#fc8d62", "#8da0cb", "#e78ac3", "#a6d854",
                    "#ffd92f", "#e5c494", "#b3b3b3"]
-        classes = sorted({n["class"] for n in self._nodes.values()})
-        color = {c: palette[i % len(palette)] for i, c in enumerate(classes)}
-        lines = ["digraph G {", "  node [style=filled];"]
         with self._lock:
+            classes = sorted({n["class"] for n in self._nodes.values()})
+            color = {c: palette[i % len(palette)]
+                     for i, c in enumerate(classes)}
+            lines = ["digraph G {", "  node [style=filled];"]
+            hazard_nodes = {h[0] for h in self._hazards} | \
+                           {h[1] for h in self._hazards}
             for name, attr in self._nodes.items():
+                extra = (f' color="{HAZARD_COLOR}" penwidth=2'
+                         if name in hazard_nodes else "")
                 lines.append(
-                    f'  "{name}" [fillcolor="{color[attr["class"]]}"];')
-            for src, dst, flow in self._edges:
-                lines.append(f'  "{src}" -> "{dst}" [label="{flow}"];')
+                    f'  "{name}" [fillcolor="{color[attr["class"]]}"'
+                    f'{extra}];')
+            for src, dst, flow, access in self._edges:
+                lines.append(f'  "{src}" -> "{dst}" [label="{flow}"'
+                             f'{_access_attrs(access)}];')
+            for src, dst, flow, rule in self._hazards:
+                label = f"{rule}:{flow}" if flow else rule
+                lines.append(
+                    f'  "{src}" -> "{dst}" [label="{label}" '
+                    f'color="{HAZARD_COLOR}" fontcolor="{HAZARD_COLOR}" '
+                    f'style=dotted penwidth=2 dir=both constraint=false];')
         lines.append("}")
         return "\n".join(lines)
 

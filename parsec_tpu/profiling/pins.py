@@ -33,6 +33,12 @@ class PinsEvent(enum.IntEnum):
     DATA_FLUSH_BEGIN = 12
     DATA_FLUSH_END = 13
     TASKPOOL_INIT = 14
+    # collection-tile accesses observed by the dfsan race sanitizer
+    # (analysis/dfsan.py re-broadcasts every access it stamps, so other
+    # modules/tests can chain on tile reads/writes without their own
+    # runtime hooks); carries (task, collection, key)
+    DATA_READ = 15
+    DATA_WRITE = 16
 
 
 class PinsManager:
@@ -83,3 +89,9 @@ class PinsManager:
 
     def complete_exec_end(self, es, task) -> None:
         self._fire(PinsEvent.COMPLETE_EXEC_END, es, task)
+
+    def data_read(self, task, collection, key) -> None:
+        self._fire(PinsEvent.DATA_READ, task, collection, key)
+
+    def data_write(self, task, collection, key) -> None:
+        self._fire(PinsEvent.DATA_WRITE, task, collection, key)

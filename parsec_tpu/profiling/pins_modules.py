@@ -26,7 +26,7 @@ from ..utils.debug import debug_verbose
 mca_param.register("pins", "",
                    help="comma-separated PINS modules to install at init "
                         "(task_profiler, print_steals, alperf, "
-                        "iterators_checker, counters, overhead)")
+                        "iterators_checker, counters, overhead, dfsan)")
 
 
 class PinsModule:
@@ -347,10 +347,16 @@ _MODULES = {
 
 
 def available() -> List[str]:
-    return sorted(_MODULES)
+    return sorted(_MODULES) + ["dfsan"]
 
 
 def new_module(name: str) -> PinsModule:
+    if name == "dfsan":
+        # the runtime race sanitizer lives in analysis/ (it is half of
+        # the hazard-checker package, not a profiling concern); lazy
+        # import also keeps pins_modules free of an import cycle
+        from ..analysis.dfsan import DataflowSanitizer
+        return DataflowSanitizer()
     try:
         return _MODULES[name]()
     except KeyError:

@@ -12,7 +12,7 @@ standard gauges at :func:`install_runtime_counters`.
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict, Union
+from typing import Any, Callable, Dict
 
 
 class SDERegistry:

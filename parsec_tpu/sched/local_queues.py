@@ -19,7 +19,6 @@ versions when loaded.
 
 from __future__ import annotations
 
-import random
 import threading
 from collections import deque
 from typing import Optional, Sequence

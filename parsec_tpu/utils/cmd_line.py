@@ -18,7 +18,7 @@ leftover argv (the reference hands those back to the application).
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List
 
 from . import mca_param
 

@@ -19,8 +19,8 @@ from __future__ import annotations
 
 import os
 import threading
-from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
 
 ENV_PREFIX = "PARSEC_MCA_"
 
@@ -32,18 +32,31 @@ class _Param:
     type: type
     help: str = ""
     read_only: bool = False
+    # closed value set (reference: mca_base_var enum registration) —
+    # resolution validates against it so a typo'd env var / set() fails
+    # loudly instead of silently meaning "default"
+    choices: Optional[tuple] = None
     # explicit runtime override (set()); highest priority
     override: Any = None
     has_override: bool = False
 
+    def _validate(self, value: Any, source: str) -> Any:
+        if self.choices is not None and value not in self.choices:
+            raise ValueError(
+                f"MCA param {self.name}: invalid value {value!r} (from "
+                f"{source}); choices are {', '.join(map(str, self.choices))}")
+        return value
+
     def resolve(self, file_values: Dict[str, str]) -> Any:
         if self.has_override:
-            return self.override
+            return self._validate(self.override, "set()")
         env_key = ENV_PREFIX + self.name.replace(".", "_")
         if env_key in os.environ:
-            return _coerce(os.environ[env_key], self.type)
+            return self._validate(_coerce(os.environ[env_key], self.type),
+                                  f"env {env_key}")
         if self.name in file_values:
-            return _coerce(file_values[self.name], self.type)
+            return self._validate(_coerce(file_values[self.name], self.type),
+                                  "config file")
         return self.default
 
 
@@ -90,13 +103,16 @@ class ParamRegistry:
 
     # -- registration / access -------------------------------------------
     def register(self, name: str, default: Any, help: str = "",
-                 type: Optional[type] = None, read_only: bool = False) -> None:
+                 type: Optional[type] = None, read_only: bool = False,
+                 choices: Optional[tuple] = None) -> None:
         with self._lock:
             if name in self._params:
                 return
             typ = type if type is not None else (default.__class__ if default is not None else str)
             self._params[name] = _Param(name=name, default=default, type=typ,
-                                        help=help, read_only=read_only)
+                                        help=help, read_only=read_only,
+                                        choices=tuple(choices) if choices
+                                        else None)
 
     def get(self, name: str, default: Any = None) -> Any:
         self._load_files()

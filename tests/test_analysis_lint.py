@@ -1,0 +1,274 @@
+"""Static dataflow lint tests (analysis/model.py + analysis/lint.py):
+zero false positives on the shipped algorithms, every seeded hazard
+fixture caught with an actionable message, taskpool.validate() and the
+``analysis.lint`` registration knob, DOT hazard rendering."""
+
+import numpy as np
+import pytest
+
+from parsec_tpu import analysis
+from parsec_tpu.analysis import HazardError, lint_taskpool
+from parsec_tpu.analysis.fixtures import FIXTURES, build_racy, self_check
+from parsec_tpu.data import LocalCollection, TiledMatrix
+from parsec_tpu.dsl import jdf, ptg
+from parsec_tpu.utils import mca_param
+
+
+def _shipped():
+    from parsec_tpu.algorithms import (build_gemm_ptg, build_geqrf,
+                                       build_getrf, build_getrf_left,
+                                       build_potrf, build_stencil_1d)
+    nb = 16
+
+    def sq(name="A", nt=4):
+        return TiledMatrix(nt * nb, nt * nb, nb, nb, name=name)
+
+    return {
+        "potrf": build_potrf(sq()),
+        "getrf": build_getrf(sq()),
+        "getrf_left": build_getrf_left(sq()),
+        "geqrf": build_geqrf(TiledMatrix(5 * nb, 4 * nb, nb, nb, name="A")),
+        "gemm": build_gemm_ptg(sq("A"), sq("B"), sq("C")),
+        "stencil": build_stencil_1d(
+            LocalCollection("X", {(i,): 0.0 for i in range(4)}),
+            n_tiles=4, timesteps=3),
+    }
+
+
+@pytest.mark.parametrize("name", ["potrf", "getrf", "getrf_left", "geqrf",
+                                  "gemm", "stencil"])
+def test_shipped_algorithms_lint_clean(name):
+    """Acceptance: zero false positives (errors AND warnings) on the
+    five shipped algorithm families."""
+    tp = _shipped()[name]
+    report = lint_taskpool(tp)
+    assert not report.findings, \
+        f"{name}: unexpected findings:\n" + \
+        "\n".join(str(f) for f in report.findings)
+    assert report.model is not None and len(report.model.nodes) > 0
+
+
+@pytest.mark.parametrize("fixture", sorted(FIXTURES))
+def test_fixtures_flagged(fixture):
+    """Every seeded hazard fixture is caught with its expected rule(s);
+    the clean control stays clean."""
+    builder, rules = FIXTURES[fixture]
+    report = lint_taskpool(builder())
+    got = {f.rule for f in report.findings}
+    if not rules:
+        assert not report.findings
+    else:
+        assert set(rules) <= got, f"expected {rules}, got {got}"
+
+
+def test_self_check_passes():
+    failures, lines = self_check()
+    assert failures == 0, "\n".join(lines)
+
+
+def test_findings_name_class_flow_and_coords():
+    report = lint_taskpool(build_racy())
+    waw = report.by_rule("waw-hazard")
+    assert waw, report
+    f = waw[0]
+    # actionable: task class + coordinates, flow name, tile coordinate
+    assert "W1(0)" in f.message and "W2(0)" in f.message
+    assert ".X" in f.message
+    assert "S(0,)" in f.message
+    assert f.tile == "S(0,)"
+
+
+def test_validate_raises_and_warn_mode():
+    tp = build_racy()
+    with pytest.raises(HazardError) as ei:
+        tp.validate()                       # default mode="error"
+    assert "waw-hazard" in str(ei.value)
+    assert ei.value.report.errors
+    report = tp.validate(mode="warn")       # logs, returns report
+    assert not report.ok
+
+
+def test_registration_knob_error_refuses_taskpool(ctx):
+    mca_param.set("analysis.lint", "error")
+    try:
+        with pytest.raises(HazardError):
+            ctx.add_taskpool(build_racy())
+    finally:
+        mca_param.unset("analysis.lint")
+    # the refused pool must not have been registered
+    assert ctx.find_taskpool("racy", active_only=False) is None
+
+
+def test_registration_knob_off_admits_and_runs(ctx):
+    # default off: the racy pool registers and runs (the lint is an
+    # opt-in gate; the final tile value is schedule-dependent, which is
+    # exactly what the fixture demonstrates)
+    tp = build_racy()
+    ctx.add_taskpool(tp)
+    assert ctx.wait(timeout=30)
+    assert tp.completed
+
+
+def test_registration_knob_warn_admits(ctx):
+    mca_param.set("analysis.lint", "warn")
+    try:
+        tp = build_racy()
+        ctx.add_taskpool(tp)
+        assert ctx.wait(timeout=30)
+    finally:
+        mca_param.unset("analysis.lint")
+
+
+def test_mca_choices_validation():
+    mca_param.set("analysis.lint", "bogus")
+    try:
+        with pytest.raises(ValueError, match="choices"):
+            mca_param.get("analysis.lint", "off")
+    finally:
+        mca_param.unset("analysis.lint")
+
+
+def test_lint_truncation_cap():
+    tp = _shipped()["gemm"]                 # 64 instances
+    report = lint_taskpool(tp, max_tasks=10)
+    assert report.truncated
+    assert report.by_rule("truncated")
+    assert report.ok                        # structural checks only
+
+
+def test_lint_skips_dtd_classes(ctx):
+    from parsec_tpu.dsl import dtd
+    C = LocalCollection("C", {(0,): 0})
+    tp = dtd.Taskpool("d")
+    ctx.add_taskpool(tp)
+    tp.insert_task(lambda x: x + 1, dtd.TileArg(C, (0,), dtd.INOUT))
+    report = lint_taskpool(tp)
+    assert report.ok
+    assert report.skipped_classes           # wire class + lazy class
+    tp.wait()
+
+
+def test_cycle_message_shows_path():
+    builder, _ = FIXTURES["cyclic"]
+    report = lint_taskpool(builder())
+    (f,) = report.by_rule("cycle")
+    assert "P(0)" in f.message and "Q(0)" in f.message and "->" in f.message
+
+
+def test_cycle_with_downstream_consumer():
+    """Regression: a node merely DOWNSTREAM of a cycle is a Kahn
+    leftover too — find_cycle must still walk the cycle itself, not
+    dead-end on the downstream node (used to raise StopIteration)."""
+    S = LocalCollection("S", {(0,): 0.0})
+    tp = ptg.Taskpool("cyc_down", S=S)
+    # A is defined FIRST and consumes from the cycle member Q
+    tp.task_class(
+        "A", params=("i",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "Z", ptg.READ,
+            ins=[ptg.In(src=("Q", lambda g, i: (i,), "Y"))])])
+    tp.task_class(
+        "P", params=("i",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(src=("Q", lambda g, i: (i,), "Y"))],
+            outs=[ptg.Out(dst=("Q", lambda g, i: (i,), "Y"))])])
+    tp.task_class(
+        "Q", params=("i",), space=lambda g: ((0,),),
+        flows=[ptg.FlowSpec(
+            "Y", ptg.RW,
+            ins=[ptg.In(src=("P", lambda g, i: (i,), "X"))],
+            outs=[ptg.Out(dst=("P", lambda g, i: (i,), "X")),
+                  ptg.Out(dst=("A", lambda g, i: (i,), "Z"))])])
+    report = lint_taskpool(tp)
+    (f,) = report.by_rule("cycle")
+    assert "P(0)" in f.message and "Q(0)" in f.message
+    assert "A(0)" not in f.message      # downstream node is not the cycle
+
+
+def test_jdf_global_named_lint_reserved():
+    from parsec_tpu.dsl.jdf import JDFSemanticError
+    src = """
+lint [ type = int ]
+
+T(i)
+  i = 0 .. lint-1
+  RW X <- NEW(0)
+BODY
+  X = X
+END
+"""
+    compiled = jdf.compile_jdf(src, name="bad")
+    with pytest.raises(JDFSemanticError, match="reserved"):
+        compiled.taskpool(lint=3)
+
+
+def test_report_to_dot_marks_hazards():
+    report = lint_taskpool(build_racy())
+    dot = report.to_dot()
+    assert "digraph" in dot
+    assert "waw-hazard" in dot
+    from parsec_tpu.profiling.grapher import HAZARD_COLOR
+    assert HAZARD_COLOR in dot
+
+
+def test_dot_colors_edges_by_access():
+    report = lint_taskpool(_shipped()["potrf"])
+    dot = report.to_dot()
+    from parsec_tpu.core.task import FlowAccess
+    from parsec_tpu.profiling.grapher import ACCESS_COLORS
+    # potrf has READ (TRSM.L) and RW (POTRF.T) consumer flows
+    assert ACCESS_COLORS[FlowAccess.READ] in dot
+    assert ACCESS_COLORS[FlowAccess.RW] in dot
+
+
+def test_jdf_compile_time_lint():
+    """CompiledJDF.taskpool(lint=...) runs the hazard checker on the
+    instantiated dataflow (the globals the ptgpp-style sanity checks
+    cannot see)."""
+    src = """
+N [ type = int ]
+A [ type = collection ]
+
+STEP(k)
+  k = 0 .. N-1
+  RW T <- (k == 0) ? A(0) : T STEP(k-1)
+       -> (k < N-1) ? T STEP(k+1) : A(0)
+BODY
+  T = T + 1
+END
+"""
+    compiled = jdf.compile_jdf(src, name="chain")
+    store = LocalCollection("A", {(0,): 0})
+    tp = compiled.taskpool(lint="error", N=5, A=store)
+    assert tp is not None
+
+
+def test_undeclared_producer_vs_check_taskpool():
+    """The lint's undeclared-producer rule reports the precise edge the
+    generic check_taskpool mask-mismatch hides."""
+    builder, _ = FIXTURES["undeclared_producer"]
+    tp = builder()
+    report = lint_taskpool(tp)
+    (f,) = report.by_rule("undeclared-producer")
+    assert "P(0)" in f.message and "never emits" in f.message
+    # the runtime cross-check also rejects it, but with a bare mask diff
+    with pytest.raises(AssertionError):
+        ptg.check_taskpool(tp)
+
+
+def test_affinity_mismatch_warns():
+    S = LocalCollection("S", {(0,): 0.0, (1,): 0.0})
+    tp = ptg.Taskpool("aff", S=S)
+    tp.task_class(
+        "T", params=("i",), space=lambda g: ((0,),),
+        # placed on tile 1, but only ever touches tile 0
+        affinity=lambda g, i: (g.S, (1,)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, i: (g.S, (0,)))],
+            outs=[ptg.Out(data=lambda g, i: (g.S, (0,)))])])
+    report = lint_taskpool(tp)
+    (f,) = report.by_rule("affinity-mismatch")
+    assert f.severity == "warning"
+    assert "S(1,)" in f.message
