@@ -278,16 +278,25 @@ def _measure_latency(device_row: bool = False):
                 out["device_64k_d2h_us"] = round(d2h_us, 1)
                 out["device_64k_h2d_us"] = round(h2d_us, 1)
                 out["device_64k_link_us"] = round(link_us, 1)
-                out["device_64k_runtime_us"] = round(
-                    max(p50_med - link_us, 0.0), 1)
                 if link_us >= p50_med:
-                    # each raw transfer above pays its own blocking
-                    # roundtrip; the hop pipeline overlaps part of that,
-                    # so the sum can exceed the hop p50 — the row then
-                    # reads "hop time fully accounted for by link cost"
+                    # the probe subtraction UNDERFLOWED: each raw
+                    # transfer pays its own blocking roundtrip that the
+                    # hop pipeline overlaps, so the sum exceeded the hop
+                    # p50. A 0.0 here would read as "zero runtime
+                    # overhead" (the BENCH_r05 artifact) — fail loudly
+                    # instead: no runtime_us row, an explicit underflow
+                    # flag, and the decomposition inputs left in place
+                    # for diagnosis.
+                    out["device_64k_runtime_underflow"] = True
                     out["device_64k_split_note"] = (
-                        "link cost >= hop p50: runtime share ~0 (hop "
-                        "time is tunnel D2H/H2D, not runtime overhead)")
+                        "UNDERFLOW: link cost >= hop p50 — the blocking "
+                        "probe over-subtracts what the hop pipeline "
+                        "overlaps; runtime share not measurable from "
+                        "this decomposition (row withheld rather than "
+                        "reported as a false 0.0)")
+                else:
+                    out["device_64k_runtime_us"] = round(
+                        p50_med - link_us, 1)
             except Exception as exc:  # noqa: BLE001
                 out["device_64k_split_error"] = str(exc)[:120]
     except Exception as exc:  # noqa: BLE001 — never sink the main metric
@@ -692,6 +701,23 @@ def _section_getrf():
                 "rel_residual_check": rv["rel_residual_check"]}
         except Exception as exc:  # noqa: BLE001 — keep the headline row
             r["solve_variant"] = {"error": str(exc)[:200]}
+        # tile sweep toward the ≥60 TF/s target (PARITY "GETRF ceiling
+        # note"): opt-in — two extra panel-fused compiles are minutes
+        # of tunnel time on a cold cache
+        if os.environ.get("PARSEC_BENCH_LU_SWEEP") == "1":
+            mca_param.set("getrf.trsm_hook", "gemm")
+            sweep = {}
+            for nbs in (512, 2048):    # divisors of the N=32768 default
+                if nbs == nbl or nl % nbs:
+                    continue
+                try:
+                    rs = fused_run(nl, nbs)
+                    sweep[f"nb{nbs}"] = {"gflops": rs["gflops"],
+                                         "rel_residual_check":
+                                         rs["rel_residual_check"]}
+                except Exception as exc:  # noqa: BLE001
+                    sweep[f"nb{nbs}"] = {"error": str(exc)[:200]}
+            r["nb_sweep"] = sweep
     finally:
         mca_param.unset("getrf.trsm_hook")
     return {"getrf_fused": r}
@@ -742,6 +768,63 @@ def _section_ooc():
         "hbm_measured": {k: int(v) for k, v in mgr.stats.items()},
         "note": "manager-measured residency; above-physical-HBM "
                 "sizes blocked by tunnel bandwidth (~19/6 MB/s)"}}
+
+
+def _section_bcast():
+    """Collective data plane: 1 MB tile, one producer on rank 0, seven
+    consumer ranks (8 local socket ranks). Captures the per-consumer-
+    send baseline (comm.bcast=0) against the three tree topologies,
+    INTERLEAVED so minute-scale machine drift lands on every config,
+    and reads the root's data-plane egress from the per-kind wire
+    accounting (stats_by_kind) — the ≤2-payload root-egress guard for
+    the default fanout-capped binomial rides here. Every consumer
+    bitwise-checks each round's payload in-body, so these numbers can't
+    come from a corrupted broadcast."""
+    from parsec_tpu.comm.bcast_bench import measure_bcast
+
+    captures = max(1, int(os.environ.get("PARSEC_BENCH_BCAST_CAPTURES", 3)))
+    rounds = int(os.environ.get("PARSEC_BENCH_BCAST_ROUNDS", 8))
+    configs = [("per_consumer", dict(bcast=False)),
+               ("star", dict(topology="star")),
+               ("chain", dict(topology="chain")),
+               ("binomial", dict(topology="binomial"))]
+    samples = {name: [] for name, _ in configs}
+    egress = {}
+    out = {"payload_bytes": 1 << 20, "nb_ranks": 8, "rounds": rounds,
+           "captures": captures}
+    try:
+        for _ in range(captures):
+            for name, kw in configs:
+                r = measure_bcast(nb_ranks=8, payload_bytes=1 << 20,
+                                  rounds=rounds, **kw)
+                samples[name].append(r["p50_us"])
+                egress[name] = r["root_egress_payloads"]
+        for name, p50s in samples.items():
+            med = _trimmed_median(p50s)
+            out[f"{name}_p50_us"] = round(med, 1)
+            if len(p50s) > 1 and med > 0:
+                out[f"{name}_p50_spread_pct"] = round(
+                    (max(p50s) - min(p50s)) / med * 100, 1)
+            out[f"{name}_root_egress_payloads"] = egress[name]
+        base = out.get("per_consumer_p50_us")
+        best = out.get("binomial_p50_us")
+        if base and best:
+            out["binomial_vs_per_consumer"] = round(base / best, 2)
+        # guards (observational, like every bench guard): the default
+        # binomial tree's root egress must stay ≤ 2 payloads per round
+        # (fanout-capped tree; the per-consumer baseline pays 7), and
+        # the tree broadcast must beat the baseline's completion p50
+        if egress.get("binomial", 99) > 2.05:
+            out["egress_guard"] = (f"FAIL: binomial root egress "
+                                   f"{egress['binomial']} payloads > 2")
+        elif base and best and best >= base:
+            out["egress_guard"] = (f"FAIL: binomial p50 {best} us did "
+                                   f"not beat per-consumer {base} us")
+        else:
+            out["egress_guard"] = "OK"
+    except Exception as exc:  # noqa: BLE001 — never sink the flagship
+        out["error"] = str(exc)[:300]
+    return {"bcast": out}
 
 
 def _null_task_body():
@@ -842,6 +925,7 @@ SECTIONS = {
     "getrf": _section_getrf,
     "ooc": _section_ooc,
     "taskrate": _section_taskrate,
+    "bcast": _section_bcast,
 }
 
 # result keys each section produces — failures are recorded under these
@@ -855,6 +939,7 @@ _SECTION_KEYS = {
     "getrf": ("getrf_fused",),
     "ooc": ("ooc_potrf",),
     "taskrate": ("taskrate",),
+    "bcast": ("bcast",),
 }
 
 # geqrf stacks three programs (per-tile stress + 94-wave fused + the
@@ -915,7 +1000,7 @@ _GFLOPS_GUARD_KEYS = ("value", "gemm_panel_fused_gflops",
                       # rows, so the same >10%-drop guard applies
                       "tasks_per_sec")
 _LATENCY_GUARD_KEYS = ("eager_1k_p50_us", "rdv_1M_p50_us",
-                       "device_64k_p50_us")
+                       "device_64k_p50_us", "bcast_1M_p50_us")
 
 
 def _flatten_summary(summary: dict) -> dict:
@@ -1082,6 +1167,12 @@ def _compact_summary(result):
                 "device_64k_p50_us"),
             "device_64k_runtime_us": d.get("latency", {}).get(
                 "device_64k_runtime_us"),
+            "bcast_1M_p50_us": pick("bcast", "binomial_p50_us"),
+            "bcast_per_consumer_p50_us": pick("bcast",
+                                              "per_consumer_p50_us"),
+            "bcast_root_egress_payloads": pick(
+                "bcast", "binomial_root_egress_payloads"),
+            "bcast_egress_guard": pick("bcast", "egress_guard"),
             "full_detail": "BENCH_DETAIL.json",
         },
     }
@@ -1375,7 +1466,7 @@ def main():
     extras = {}
     if os.environ.get("PARSEC_BENCH_EXTRAS", "1") != "0":
         for name in ("hostdtd", "ptile", "gemm", "flash", "geqrf",
-                     "getrf", "ooc", "taskrate"):
+                     "getrf", "ooc", "taskrate", "bcast"):
             extras.update(_run_section(name))
         # host-vs-compiled ratio: both rows fresh in their own child
         try:
@@ -1551,16 +1642,35 @@ def render_parity():
             "remote-dep latency (socket engine)",
             f"eager 1 KB p50 {lat['eager_1k_p50_us']} µs; "
             f"rdv 1 MB p50 {lat.get('rdv_1M_p50_us')} µs", "—", note))
+    bc = x.get("bcast", {})
+    if bc.get("binomial_p50_us"):
+        note = (f"root egress {bc.get('binomial_root_egress_payloads')} "
+                f"payloads (per-consumer baseline: "
+                f"{bc.get('per_consumer_root_egress_payloads')}); "
+                f"chain {bc.get('chain_p50_us')} µs, star "
+                f"{bc.get('star_p50_us')} µs; guard "
+                f"{bc.get('egress_guard')}")
+        rows.append((
+            f"1→{bc.get('nb_ranks', 8) - 1}-rank 1 MB broadcast "
+            f"(binomial tree, segmented)",
+            f"p50 {bc['binomial_p50_us']} µs vs per-consumer "
+            f"{bc.get('per_consumer_p50_us')} µs "
+            f"({bc.get('binomial_vs_per_consumer')}×)", "—", note))
     if d.get("throughput_regression"):
         rows.append(("throughput regression guard (>10% vs prior "
                      "round)", "FIRED", "—",
                      d["throughput_regression"]))
     if lat.get("device_64k_p50_us"):
+        if lat.get("device_64k_runtime_underflow"):
+            share = ("runtime share UNMEASURABLE (blocking-probe "
+                     "underflow — row withheld)")
+        else:
+            share = (f"runtime share "
+                     f"{lat.get('device_64k_runtime_us', 0) / 1000:.1f} ms")
         note = (
             f"link-decomposed: raw D2H {lat.get('device_64k_d2h_us', 0) / 1000:.1f}"
             f" + H2D {lat.get('device_64k_h2d_us', 0) / 1000:.1f} ms "
-            f"cover the hop; runtime share "
-            f"{lat.get('device_64k_runtime_us', 0) / 1000:.1f} ms")
+            f"cover the hop; {share}")
         dsp = lat.get("device_64k_p50_spread_pct")
         if dsp is not None:
             note += f"; spread ±{dsp}%"

@@ -1,0 +1,221 @@
+"""1→(P−1) multi-consumer broadcast benchmark over the socket engine.
+
+The collective-data-plane analog of :mod:`~parsec_tpu.comm.pingpong`: a
+taskpool whose round ``r`` has ONE producer on rank 0 whose tile is
+consumed on every other rank (the 2D-block-cyclic GEMM/POTRF shape — a
+panel fanning out to a whole row of ranks), with a CTL gather closing
+each round so consecutive producer stamps measure one full broadcast.
+Every consumer checks the payload BITWISE against the round's expected
+value — a mis-assembled segment or a mis-routed tree edge fails the run,
+not just the numbers.
+
+Reported per config: p50/p90 round time and the root's data-plane
+egress in payload units (``stats_by_kind`` — "bcast" entries are
+tree-edge payload sends, "activate" entries the per-consumer-rank
+fallback), so the star-vs-tree egress claim is measured, not inferred.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from .pingpong import _free_port_base
+
+
+class _DistVec:
+    """1-D scalar-tile collection owned round-robin by index."""
+
+    def __init__(self, n: int, nb_ranks: int, my_rank: int):
+        self.n = n
+        self.nb_ranks = nb_ranks
+        self.my_rank = my_rank
+        self.dc_id = 17
+        self.v = {i: np.float32(0.0) for i in range(n)
+                  if i % nb_ranks == my_rank}
+
+    def _k(self, key):
+        return key[0] if isinstance(key, (tuple, list)) else key
+
+    def rank_of(self, key):
+        return self._k(key) % self.nb_ranks
+
+    def data_of(self, key):
+        return self.v[self._k(key)]
+
+    def write_tile(self, key, value):
+        self.v[self._k(key)] = value
+
+
+def build_bcast_bench(nb_ranks: int, rounds: int, payload_f32: int, A):
+    """Round r: SRC(r) on rank 0 → CONS(r, c) on each rank c ≥ 1 → CTL
+    gather into SRC(r+1). Returns (taskpool, src_stamps)."""
+    from ..dsl import ptg
+
+    tp = ptg.Taskpool("bcast_bench", R=rounds, P=nb_ranks, A=A,
+                      NW=payload_f32)
+    tp.task_class(
+        "SRC", params=("r",),
+        space=lambda g: ((r,) for r in range(g.R)),
+        affinity=lambda g, r: (g.A, (0,)),
+        flows=[
+            ptg.FlowSpec(
+                "X", ptg.RW,
+                ins=[ptg.In(data=lambda g, r: (g.A, (0,)))],
+                outs=[ptg.Out(dst=("CONS",
+                                   lambda g, r: [(r, c) for c in
+                                                 range(1, g.P)],
+                                   "X"))]),
+            ptg.FlowSpec(
+                "C", ptg.CTL,
+                ins=[ptg.In(src=("CONS",
+                                 lambda g, r: [(r - 1, c) for c in
+                                               range(1, g.P)],
+                                 "C"),
+                            gather=True,
+                            guard=lambda g, r: r > 0)]),
+        ])
+    tp.task_class(
+        "CONS", params=("r", "c"),
+        space=lambda g: ((r, c) for r in range(g.R)
+                         for c in range(1, g.P)),
+        affinity=lambda g, r, c: (g.A, (c,)),
+        flows=[
+            ptg.FlowSpec(
+                "X", ptg.RW,
+                ins=[ptg.In(src=("SRC", lambda g, r, c: (r,), "X"))],
+                outs=[]),
+            ptg.FlowSpec(
+                "C", ptg.CTL,
+                outs=[ptg.Out(dst=("SRC", lambda g, r, c: (r + 1,), "C"),
+                              guard=lambda g, r, c: r < g.R - 1)]),
+        ])
+
+    src_stamps = []
+
+    # batchable=False: the timestamp side effect must run per execution
+    @tp.task_class_by_name("SRC").body(batchable=False)
+    def src_body(task, X, C=None):
+        src_stamps.append(time.perf_counter())
+        r = task.locals[0]
+        # fresh array per round (the release path dedups per VALUE):
+        # deterministic content so every leaf can bitwise-check it
+        return np.arange(tp.g.NW, dtype=np.float32) + np.float32(r)
+
+    @tp.task_class_by_name("CONS").body(batchable=False)
+    def cons_body(task, X, C=None):
+        r = task.locals[0]
+        expect = np.arange(tp.g.NW, dtype=np.float32) + np.float32(r)
+        got = np.asarray(X)
+        if got.shape != expect.shape or not np.array_equal(got, expect):
+            raise AssertionError(
+                f"broadcast payload corrupt at round {r}: "
+                f"shape {got.shape} vs {expect.shape}")
+        return None
+
+    return tp, src_stamps
+
+
+def _rank_main(rank: int, nb_ranks: int, base_port: int, rounds: int,
+               payload_f32: int, cfg: Dict, q) -> None:
+    try:
+        from ..comm.socket_engine import SocketCommEngine
+        from ..core import context as ctx_mod
+        from ..utils import mca_param
+
+        for key, val in cfg.items():
+            mca_param.set(key, val)
+        # host-payload wire benchmark: no accelerator staging, and the
+        # rank fleet must never touch (or contend for) an exclusive chip
+        mca_param.set("runtime.stage_reads", "0")
+        mca_param.set("comm.stage_recv", "0")
+        mca_param.set("device.tpu.enabled", False)
+        engine = SocketCommEngine(rank, nb_ranks, base_port=base_port)
+        ctx = ctx_mod.init(nb_cores=2, comm=engine)
+        A = _DistVec(nb_ranks, nb_ranks, rank)
+        tp, src_stamps = build_bcast_bench(nb_ranks, rounds, payload_f32, A)
+        ctx.add_taskpool(tp)
+        t0 = time.perf_counter()
+        ctx.start()
+        ok = ctx.wait(timeout=300)
+        total_s = time.perf_counter() - t0
+        engine.sync()
+        stats_by_kind = {k: dict(v) for k, v in engine.stats_by_kind.items()}
+        wire = engine.wire_stats()
+        ctx.fini()
+        if not ok:
+            raise RuntimeError(f"rank {rank}: bcast bench did not terminate")
+        q.put((rank, "ok", {"total_s": total_s,
+                            "round_s": np.diff(src_stamps).tolist(),
+                            "stats_by_kind": stats_by_kind,
+                            "wire": wire}))
+    except BaseException as exc:  # noqa: BLE001 — report to parent
+        import traceback
+        q.put((rank, "error", f"{exc}\n{traceback.format_exc()}"))
+
+
+def measure_bcast(nb_ranks: int = 8, payload_bytes: int = 1 << 20,
+                  rounds: int = 10, topology: str = "binomial",
+                  bcast: bool = True, fanout: Optional[int] = None,
+                  eager_limit: int = 64 * 1024,
+                  segment_bytes: Optional[int] = None,
+                  timeout: float = 300.0) -> Dict:
+    """Spawn ``nb_ranks`` socket ranks, run ``rounds`` broadcast rounds,
+    return round-time percentiles + the root's per-kind egress. With
+    ``bcast=False`` the data plane falls back to one payload send per
+    consumer rank (the pre-collective baseline the A/B compares
+    against)."""
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    base_port = _free_port_base(nb_ranks)
+    payload_f32 = max(payload_bytes // 4, 1)
+    cfg = {"comm.bcast": 1 if bcast else 0,
+           "comm.bcast_topology": topology,
+           "comm.eager_limit": eager_limit}
+    if fanout is not None:
+        cfg["comm.bcast_fanout"] = fanout
+    if segment_bytes is not None:
+        cfg["comm.segment_bytes"] = segment_bytes
+    procs = [ctx.Process(target=_rank_main,
+                         args=(r, nb_ranks, base_port, rounds,
+                               payload_f32, cfg, q))
+             for r in range(nb_ranks)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(nb_ranks):
+            rank, status, payload = q.get(timeout=timeout)
+            if status != "ok":
+                raise RuntimeError(f"rank {rank} failed:\n{payload}")
+            results[rank] = payload
+    finally:
+        for p in procs:
+            p.join(timeout=10.0)
+            if p.is_alive():
+                p.terminate()
+
+    root = results[0]
+    round_us = np.asarray(root["round_s"][1:]) * 1e6   # drop warmup round
+    payload = payload_f32 * 4
+    bk = root["stats_by_kind"]
+    data_plane_bytes = sum(bk.get(k, {}).get("sent_bytes", 0)
+                           for k in ("bcast", "activate"))
+    return {
+        "payload_bytes": payload,
+        "nb_ranks": nb_ranks,
+        "rounds": rounds,
+        "config": ("per_consumer" if not bcast else topology),
+        "p50_us": float(np.percentile(round_us, 50)),
+        "p90_us": float(np.percentile(round_us, 90)),
+        # per-round data-plane egress at the root, in payload units —
+        # 7.0 for the per-consumer baseline at 8 ranks, ≤2.0 for the
+        # fanout-capped binomial, 1.0 for the chain pipeline
+        "root_egress_payloads": round(
+            data_plane_bytes / payload / rounds, 3),
+        "root_stats_by_kind": bk,
+        "total_s": max(r["total_s"] for r in results.values()),
+    }

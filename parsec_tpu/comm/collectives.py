@@ -6,14 +6,53 @@ node from the root's participant list (parsec_gather_collective_pattern
 remote_dep.c:382-413). DTD is restricted to star (remote_dep.c:543-551).
 
 These topology functions are shared by the control plane (loopback/DCN
-activations) and by the compiled SPMD path when it lowers a broadcast to
+activations), the DATA plane (`CommEngine.remote_dep_broadcast` routes a
+multi-rank payload down the same tree, each edge carrying the payload
+exactly once), and the compiled SPMD path when it lowers a broadcast to
 ``ppermute`` steps over the mesh.
+
+Degree cap (``comm.bcast_fanout``): for segmented/pipelined payloads a
+bounded out-degree beats the classic binomial — the root of a classic
+binomial over P ranks pays ⌈log₂P⌉ full payload egresses, while a
+fanout-capped tree (the NCCL-style binary tree at the default fanout 2)
+pays exactly ``fanout`` at the same O(log P) depth, so the segment
+pipeline saturates each edge instead of splitting root bandwidth
+log P ways.  ``comm.bcast_fanout=0`` restores the reference's classic
+binomial shape.  The cap only applies to the BINOMIAL topology — star
+and chain are explicit shape requests.
 """
 
 from __future__ import annotations
 
 import enum
-from typing import List, Sequence
+from typing import Callable, List, Sequence
+
+from ..utils import mca_param
+
+mca_param.register(
+    "comm.bcast_topology", "binomial",
+    help="data-plane broadcast tree for multi-rank consumers of one "
+         "produced value (remote_dep.c:334-372 analog); DTD taskpools "
+         "are pinned to star (remote_dep.c:543-551)",
+    choices=("star", "chain", "binomial"))
+mca_param.register(
+    "comm.bcast_fanout", 2,
+    help="max children per node of the BINOMIAL data-plane tree "
+         "(0 = classic binomial, root degree log2(P); 2 = NCCL-style "
+         "binary tree, root egress capped at 2 payloads)")
+mca_param.register(
+    "comm.bcast", 1,
+    help="tree-route one produced value to consumers on >=2 ranks "
+         "through the broadcast topology (0 = one payload send per "
+         "consumer rank from the producer)")
+mca_param.register(
+    "comm.segment_bytes", 128 * 1024,
+    help="payloads >= this many bytes stream as pipelined segments: a "
+         "forwarding tree node re-sends segment k to its children while "
+         "receiving k+1 (the chain topology becomes a true pipeline). "
+         "128 KiB measured best for 1 MiB payloads over loopback TCP "
+         "(2.6 ms p50 vs 3.5 at 256 KiB, 4.5 unsegmented — the "
+         "sender's kernel copy overlaps the receiver's drain)")
 
 
 class BcastTopology(enum.Enum):
@@ -52,6 +91,8 @@ def bcast_tree_children(topology: BcastTopology, participants: Sequence[int],
 def bcast_tree_parent(topology: BcastTopology, participants: Sequence[int],
                       me: int) -> int:
     ranks = list(participants)
+    if me not in ranks:
+        return -1       # mirror bcast_tree_children's [] for outsiders
     idx = ranks.index(me)
     if idx == 0:
         return -1
@@ -63,3 +104,68 @@ def bcast_tree_parent(topology: BcastTopology, participants: Sequence[int],
     while idx % (2 * k) == 0:
         k *= 2
     return ranks[idx - k]
+
+
+def bcast_children(topology: BcastTopology, participants: Sequence[int],
+                   me: int, fanout: int = 0) -> List[int]:
+    """Data-plane children of ``me``: the classic tree shapes, except
+    BINOMIAL with ``fanout`` > 0, which becomes the deterministic
+    fanout-ary heap tree (children of index i are f*i+1 .. f*i+f) — same
+    O(log P) depth, out-degree bounded by ``fanout`` at every node (see
+    the module docstring). Star and chain ignore the cap."""
+    if fanout <= 0 or topology is not BcastTopology.BINOMIAL:
+        return bcast_tree_children(topology, participants, me)
+    ranks = list(participants)
+    if me not in ranks:
+        return []
+    idx = ranks.index(me)
+    lo = fanout * idx + 1
+    return ranks[lo:min(lo + fanout, len(ranks))]
+
+
+def bcast_parent(topology: BcastTopology, participants: Sequence[int],
+                 me: int, fanout: int = 0) -> int:
+    """Inverse of :func:`bcast_children` (−1 for the root or a
+    non-participant)."""
+    if fanout <= 0 or topology is not BcastTopology.BINOMIAL:
+        return bcast_tree_parent(topology, participants, me)
+    ranks = list(participants)
+    if me not in ranks:
+        return -1
+    idx = ranks.index(me)
+    if idx == 0:
+        return -1
+    return ranks[(idx - 1) // fanout]
+
+
+def bcast_live_children(topology: BcastTopology,
+                        participants: Sequence[int], me: int, fanout: int,
+                        alive: Callable[[int], bool]) -> List[int]:
+    """Children of ``me`` with dead subtree roots REPARENTED: a child
+    known dead is replaced by its own children, recursively, so the
+    payload still reaches every live descendant (the forwarding side of
+    dead-peer handling — detection/abort semantics stay with the
+    engine's failure path)."""
+    out: List[int] = []
+    stack = list(bcast_children(topology, participants, me, fanout))
+    while stack:
+        c = stack.pop(0)
+        if alive(c):
+            out.append(c)
+        else:
+            stack.extend(bcast_children(topology, participants, c, fanout))
+    return out
+
+
+def resolve_topology(taskpool=None) -> BcastTopology:
+    """The topology for one broadcast: the taskpool's pin wins (DTD pins
+    ``star``, remote_dep.c:543-551), else the ``comm.bcast_topology``
+    MCA knob."""
+    pin = getattr(taskpool, "bcast_topology", None) if taskpool is not None \
+        else None
+    name = pin or str(mca_param.cached_get("comm.bcast_topology", "binomial"))
+    return BcastTopology(name)
+
+
+def resolve_fanout() -> int:
+    return int(mca_param.cached_get("comm.bcast_fanout", 2))

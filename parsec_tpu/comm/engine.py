@@ -26,7 +26,9 @@ class AMTag(enum.IntEnum):
     TILE_FETCH = 7        # one-sided collection-tile GET (RMA analog)
     BYE = 8               # orderly-shutdown notice (MPI_Finalize analog):
     #                       a peer closing WITHOUT it is a failure
-    FIRST_USER_TAG = 9
+    DATA_SEG = 9          # pipelined payload segment of an activation
+    #                       stream (segmented rendezvous / broadcast edge)
+    FIRST_USER_TAG = 10
 
 MAX_REGISTERED_TAGS = 32     # PARSEC_MAX_REGISTERED_TAGS (parsec_comm_engine.h:24)
 
@@ -49,6 +51,13 @@ class CommEngine:
         # gauges and the comm trace read these
         self.stats = {"activations_sent": 0, "activations_recv": 0,
                       "bytes_sent": 0, "bytes_recv": 0}
+        # per-message-kind wire accounting (profiling msg-size info,
+        # remote_dep.h:374-384): kind -> sent/recv message+byte counters.
+        # "activate" = p2p activation payloads, "bcast" = tree-edge
+        # broadcast payloads (the root's entry IS its data-plane egress),
+        # "seg" = pipelined payload segments (wire-level), "put"/"get" =
+        # classic rendezvous legs.
+        self.stats_by_kind: Dict[str, Dict[str, int]] = {}
         self._stats_lock = threading.Lock()
         self._trace = None
         # one-sided tile-fetch service (RMA GET over AMs): exposed
@@ -91,12 +100,26 @@ class CommEngine:
     def record_msg(self, direction: str, kind: str, peer: int,
                    nbytes: int) -> None:
         with self._stats_lock:
-            if direction == "sent":
-                self.stats["activations_sent"] += 1
-                self.stats["bytes_sent"] += nbytes
-            else:
-                self.stats["activations_recv"] += 1
-                self.stats["bytes_recv"] += nbytes
+            if kind in ("activate", "bcast"):
+                # only activation-class messages feed the aggregate
+                # payload-level counters: segments and rendezvous legs
+                # carry bytes of an already-counted activation, so
+                # adding them would double-count every large payload
+                # (and break the one-message-per-(value, rank) dedup
+                # assertions)
+                if direction == "sent":
+                    self.stats["activations_sent"] += 1
+                    self.stats["bytes_sent"] += nbytes
+                else:
+                    self.stats["activations_recv"] += 1
+                    self.stats["bytes_recv"] += nbytes
+            bk = self.stats_by_kind.get(kind)
+            if bk is None:
+                bk = self.stats_by_kind[kind] = {
+                    "sent_msgs": 0, "sent_bytes": 0,
+                    "recv_msgs": 0, "recv_bytes": 0}
+            bk[f"{direction}_msgs"] += 1
+            bk[f"{direction}_bytes"] += nbytes
         if self._trace is not None:
             self._trace.event(f"comm_{kind}", direction, stream_id=-1,
                               object_id=peer, info={"msg_size": nbytes})
@@ -286,6 +309,56 @@ class CommEngine:
         the single-dep path."""
         for ref in refs:
             self.remote_dep_activate(task, ref, target_rank)
+
+    @staticmethod
+    def _targets_of(refs) -> list:
+        """Wire shape of a packed activation's target list — ONE
+        definition for every transport (loopback and socket engines
+        must never desynchronize on the dep-addressing fields)."""
+        return [{"class": ref.task_class.name,
+                 "locals": tuple(ref.locals), "flow": ref.flow_name,
+                 "dep_index": ref.dep_index,
+                 "priority": ref.priority} for ref in refs]
+
+    def _bcast_envelope(self, tp, rank_refs):
+        """Wire envelope of one broadcast: the participant list every
+        node rebuilds the identical tree from, plus the per-rank packed
+        targets — ONE builder for every transport (a parts-ordering or
+        key drift between engines would mis-route the tree). Returns
+        ``(msg, parts, topology, fanout)``; the caller attaches the
+        payload (inline value or stream header)."""
+        from .collectives import resolve_fanout, resolve_topology
+        topo = resolve_topology(tp)
+        fanout = resolve_fanout()
+        parts = [self.rank] + sorted(rank_refs)
+        targets_by_rank = {r: self._targets_of(refs)
+                           for r, refs in rank_refs.items()}
+        msg = {"taskpool": tp.name,
+               "bcast": {"parts": parts, "topo": topo.value,
+                         "fanout": fanout},
+               "targets_by_rank": targets_by_rank,
+               # per-peer aggregation ranks a packed msg by its most
+               # urgent target (remote_dep_mpi.c:1089-1139)
+               "priority": max(t["priority"]
+                               for ts in targets_by_rank.values()
+                               for t in ts)}
+        return msg, parts, topo, fanout
+
+    def _msg_targets(self, msg) -> list:
+        """This rank's targets of a packed/broadcast activation msg."""
+        if "targets" in msg:
+            return msg["targets"]
+        return msg.get("targets_by_rank", {}).get(self.rank, [])
+
+    def remote_dep_broadcast(self, task, rank_refs) -> None:
+        """Route ONE produced value to its consumers on several ranks.
+        ``rank_refs``: ``{target_rank: [SuccessorRef, ...]}`` — every ref
+        carries the same value. Transports with a tree data plane
+        override this (payload travels each tree edge exactly once,
+        remote_dep.c:334-413); the base engine falls back to one packed
+        activation per rank (star from the producer)."""
+        for target_rank, refs in rank_refs.items():
+            self.remote_dep_activate_multi(task, target_rank, refs)
 
     def remote_dep_activate(self, task, ref, target_rank: int) -> None:
         """parsec_remote_dep_activate analog — forward one satisfied dep to

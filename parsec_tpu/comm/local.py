@@ -127,23 +127,51 @@ class LocalCommEngine(CommEngine):
         the owning rank's engine, which re-activates it there (the wire
         protocol's eager path — remote_dep_wire_activate + inline payload,
         remote_dep.h:41-48)."""
+        self.remote_dep_activate_multi(task, target_rank, [ref])
+
+    def remote_dep_activate_multi(self, task, target_rank: int,
+                                  refs) -> None:
+        """Packed multi-target activation: N deps of ONE produced value
+        to one rank ride a single loopback message carrying the payload
+        once (the reference's one-data-per-(dep, rank) aggregation)."""
         tp = task.taskpool
         monitor = tp.monitor
         monitor.outgoing_message_start(target_rank)
-        msg = {"taskpool": tp.name, "class": ref.task_class.name,
-               "locals": ref.locals, "flow": ref.flow_name,
-               "dep_index": ref.dep_index, "priority": ref.priority,
-               "value": ref.value}
+        msg = {"taskpool": tp.name, "targets": self._targets_of(refs),
+               "value": refs[0].value}
         self.record_msg("sent", "activate", target_rank,
-                        self.payload_bytes(ref.value))
+                        self.payload_bytes(refs[0].value))
         self.send_am(AMTag.ACTIVATE, target_rank, msg)
         monitor.outgoing_message_end(target_rank)
 
+    def remote_dep_broadcast(self, task, rank_refs) -> None:
+        """Tree-routed broadcast over the loopback fabric: same
+        participant-list/topology contract as the socket engine
+        (remote_dep.c:334-413) — the root sends one message per TREE
+        EDGE, receivers re-forward to their children before releasing
+        locally. Loopback has no failure detection, so reparenting
+        never fires here."""
+        from .collectives import bcast_live_children
+        tp = task.taskpool
+        monitor = tp.monitor
+        msg, parts, topo, fanout = self._bcast_envelope(tp, rank_refs)
+        value = next(iter(rank_refs.values()))[0].value
+        msg["value"] = value
+        nbytes = self.payload_bytes(value)
+        for c in bcast_live_children(topo, parts, self.rank, fanout,
+                                     self.peer_alive):
+            monitor.outgoing_message_start(c)
+            self.record_msg("sent", "bcast", c, nbytes)
+            self.send_am(AMTag.ACTIVATE, c, msg)
+            monitor.outgoing_message_end(c)
+
     def install_activate_handler(self, context) -> None:
         """Wire the ACTIVATE AM into a context: reconstruct the
-        SuccessorRef and count the dep on the local taskpool replica
-        (remote_dep_mpi_save_activate_cb analog)."""
+        SuccessorRefs and count the deps on the local taskpool replica
+        (remote_dep_mpi_save_activate_cb analog); broadcast messages
+        re-forward down the tree before the local release."""
         from ..core.taskpool import SuccessorRef
+        from .collectives import BcastTopology, bcast_live_children
 
         def _on_activate(src_rank: int, msg: Dict) -> None:
             with context._lock:
@@ -156,16 +184,38 @@ class LocalCommEngine(CommEngine):
                         (src_rank, msg))
                     return
             tp.monitor.incoming_message_start(src_rank)
-            self.record_msg("recv", "activate", src_rank,
-                            self.payload_bytes(msg["value"]))
-            tc = tp.get_task_class(msg["class"])
-            ref = SuccessorRef(task_class=tc, locals=tuple(msg["locals"]),
-                               flow_name=msg["flow"], value=msg["value"],
-                               dep_index=msg["dep_index"],
-                               priority=msg["priority"])
-            new_task = tp.activate_dep(ref)
-            if new_task is not None:
-                context.schedule(None, [new_task])
+            value = msg["value"]
+            nbytes = self.payload_bytes(value)
+            if "bcast" in msg:
+                b = msg["bcast"]
+                children = bcast_live_children(
+                    BcastTopology(b["topo"]), b["parts"], self.rank,
+                    b.get("fanout", 0), self.peer_alive)
+                if children and context.pins is not None:
+                    context.pins.bcast_fwd(tp.name, src_rank, children,
+                                           nbytes)
+                for c in children:
+                    tp.monitor.outgoing_message_start(c)
+                    self.record_msg("sent", "bcast", c, nbytes)
+                    self.send_am(AMTag.ACTIVATE, c, msg)
+                    tp.monitor.outgoing_message_end(c)
+                self.record_msg("recv", "bcast", src_rank, nbytes)
+            else:
+                self.record_msg("recv", "activate", src_rank, nbytes)
+            targets = self._msg_targets(msg)
+            ready = []
+            for t in targets:
+                tc = tp.get_task_class(t["class"])
+                ref = SuccessorRef(task_class=tc,
+                                   locals=tuple(t["locals"]),
+                                   flow_name=t["flow"], value=value,
+                                   dep_index=t["dep_index"],
+                                   priority=t["priority"])
+                new_task = tp.activate_dep(ref)
+                if new_task is not None:
+                    ready.append(new_task)
+            if ready:
+                context.schedule(None, ready)
             tp.monitor.incoming_message_end(src_rank)
 
         self.tag_register(AMTag.ACTIVATE, _on_activate)

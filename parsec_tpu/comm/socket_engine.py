@@ -32,6 +32,7 @@ SURVEY §4; DCN between TPU hosts is the production transport this models):
 
 from __future__ import annotations
 
+import itertools
 import pickle
 import queue
 import selectors
@@ -42,6 +43,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .engine import AMTag, CommEngine
+from .collectives import BcastTopology, bcast_live_children
 from ..utils import mca_param
 from ..utils.debug import debug_verbose, warning
 
@@ -57,6 +59,13 @@ mca_param.register("comm.stage_recv", "auto",
                         "only) | 1 | 0")
 mca_param.register("comm.wireup_timeout_s", 30.0,
                    help="seconds to wait for the full mesh to connect")
+mca_param.register("comm.rdv_push", 1,
+                   help="above-eager-limit payloads stream as pushed "
+                        "segment frames right behind their activation "
+                        "(the GET leg's round trip is elided; TCP "
+                        "backpressure replaces receiver pacing); 0 = "
+                        "classic registered-memory GET/PUT rendezvous "
+                        "(remote_dep_mpi.c:1963-2118)")
 mca_param.register("comm.thread_multiple", 0,
                    help="MPI_THREAD_MULTIPLE analog (parsec_param_comm_"
                         "thread_multiple, remote_dep.h:166): worker "
@@ -121,6 +130,14 @@ class SocketCommEngine(CommEngine):
         self._context = None
         self._parked: Dict[str, List[tuple]] = {}
         self._pending_gets: Dict[int, Tuple] = {}    # my recv handle -> state
+        # segmented payload streams (comm-thread-only state):
+        # (src_rank, sid) -> reassembly dict; sender-side sid counter
+        self._rx_streams: Dict[Tuple[int, int], Dict] = {}
+        self._sid_next = itertools.count(1)
+        # mid-large-frame receive: peer -> [frame bytearray, filled]
+        # (bytes land straight in the frame via recv_into — the staging
+        # rxbuf never holds more than the small-frame working set)
+        self._rxlarge: Dict[int, List] = {}
         self._termdet_monitors: Dict[str, object] = {}
         # wave coordination (rank 0)
         self._waves: Dict[str, _WaveState] = {}
@@ -135,7 +152,8 @@ class SocketCommEngine(CommEngine):
         # frame-level wire counters only; payload-level activation
         # counters live in the base ``stats`` dict (record_msg)
         self._stats = {"frames_sent": 0, "frames_recv": 0, "bytes_sent": 0,
-                       "bytes_recv": 0, "gets": 0, "puts": 0}
+                       "bytes_recv": 0, "gets": 0, "puts": 0,
+                       "segs_sent": 0, "segs_recv": 0}
         # self-pipe: workers posting commands interrupt the comm thread's
         # selector block so sends don't wait out the poll timeout (the
         # reference relies on MPI progress being driven by the same
@@ -335,6 +353,10 @@ class SocketCommEngine(CommEngine):
                 per_peer.setdefault(dst, []).append(msg)
             elif kind == "self":       # ("self", tag, msg)
                 self._dispatch(cmd[1], self.rank, cmd[2])
+            elif kind == "deliver":    # ("deliver", tp) — drain parked
+                tp = cmd[1]            # activations on the comm thread
+                for (src, msg) in self._parked.pop(tp.name, []):
+                    self._deliver_activation(tp, src, msg)
             elif kind == "peer_dead":  # ("peer_dead", peer, why) — posted
                 self._mark_peer_dead(cmd[1], cmd[2])  # by worker threads
             else:                      # ("am", tag, dst, msg)
@@ -530,52 +552,97 @@ class SocketCommEngine(CommEngine):
                 except (BlockingIOError, OSError):
                     pass
                 continue
-            try:
-                chunk = s.recv(1 << 20)
-            except BlockingIOError:
+            n += self._recv_ready(peer, s)
+        return n
+
+    _LARGE_FRAME = 32 * 1024
+
+    def _recv_ready(self, peer: int, s: socket.socket) -> int:
+        """Drain ``peer``'s readable socket completely. Small frames
+        parse out of the staging rxbuf; a frame ≥ ``_LARGE_FRAME``
+        switches to ``recv_into`` a preallocated frame buffer, so each
+        payload byte is copied exactly once (kernel → frame) instead of
+        the round-5 append-to-rxbuf + slice-out pair (two extra full
+        copies per 1 MB frame), and the whole remainder arrives without
+        one selector round trip per kernel-buffer chunk."""
+        n = 0
+        buf = self._rxbuf[peer]
+        while True:
+            large = self._rxlarge.get(peer)
+            if large is not None:
+                frame, filled = large
+                try:
+                    m = s.recv_into(memoryview(frame)[filled:])
+                except BlockingIOError:
+                    return n
+                except OSError as exc:
+                    self._peer_closed(peer, s, f"recv failed: {exc}")
+                    return n
+                if not m:
+                    self._peer_closed(peer, s, "connection closed by peer")
+                    return n
+                filled += m
+                if filled < len(frame):
+                    large[1] = filled
+                    continue          # keep draining; EAGAIN exits
+                del self._rxlarge[peer]
+                self._deliver_frame(frame)
+                n += 1
                 continue
+            try:
+                chunk = s.recv(1 << 18)
+            except BlockingIOError:
+                return n
             except OSError as exc:
                 self._peer_closed(peer, s, f"recv failed: {exc}")
-                continue
+                return n
             if not chunk:
                 self._peer_closed(peer, s, "connection closed by peer")
-                continue
-            buf = self._rxbuf[peer]
+                return n
             buf += chunk
             while len(buf) >= _HDR.size:
                 (ln,) = _HDR.unpack_from(buf, 0)
-                if len(buf) < _HDR.size + ln:
-                    break
-                # slicing a bytearray yields a (writable) bytearray —
-                # arrays reconstructed over the out-of-band views below
-                # may be updated in place by bodies. (Round 5 wrapped
-                # the slice in an extra bytearray(), paying a second
-                # full-frame copy per received frame.)
-                frame = buf[_HDR.size:_HDR.size + ln]
-                del buf[:_HDR.size + ln]
-                (plen,) = _U32.unpack_from(frame, 0)
-                off = _U32.size
-                payload = frame[off:off + plen]
-                off += plen
-                # out-of-band buffers: zero-copy views into ``frame`` for
-                # payloads that dominate the frame; smaller ones are
-                # copied out so a retained array doesn't pin an entire
-                # aggregated multi-payload frame in memory
-                views: List[Any] = []
-                while off < len(frame):
-                    (bl,) = _HDR.unpack_from(frame, off)
-                    off += _HDR.size
-                    if 2 * bl >= len(frame):
-                        views.append(memoryview(frame)[off:off + bl])
-                    else:
-                        views.append(bytearray(frame[off:off + bl]))
-                    off += bl
-                tag, src, msg = pickle.loads(payload, buffers=views)
-                self._stats["frames_recv"] += 1
-                self._stats["bytes_recv"] += _HDR.size + ln
-                self._dispatch(tag, src, msg)
-                n += 1
-        return n
+                if _HDR.size + ln <= len(buf):
+                    # slicing a bytearray yields a (writable) bytearray —
+                    # arrays reconstructed over the out-of-band views may
+                    # be updated in place by bodies
+                    frame = buf[_HDR.size:_HDR.size + ln]
+                    del buf[:_HDR.size + ln]
+                    self._deliver_frame(frame)
+                    n += 1
+                    continue
+                if ln >= self._LARGE_FRAME:
+                    frame = bytearray(ln)
+                    have = len(buf) - _HDR.size
+                    frame[:have] = memoryview(buf)[_HDR.size:]
+                    del buf[:]
+                    self._rxlarge[peer] = [frame, have]
+                break
+
+    def _deliver_frame(self, frame: bytearray) -> None:
+        """Parse one complete frame and dispatch its AM."""
+        (plen,) = _U32.unpack_from(frame, 0)
+        off = _U32.size
+        payload = frame[off:off + plen]
+        off += plen
+        # out-of-band buffers: zero-copy views into ``frame`` for
+        # payloads that dominate the frame; smaller ones are copied out
+        # so a retained array doesn't pin an entire aggregated
+        # multi-payload frame in memory
+        views: List[Any] = []
+        ln = len(frame)
+        while off < ln:
+            (bl,) = _HDR.unpack_from(frame, off)
+            off += _HDR.size
+            if 2 * bl >= ln:
+                views.append(memoryview(frame)[off:off + bl])
+            else:
+                views.append(bytearray(frame[off:off + bl]))
+            off += bl
+        tag, src, msg = pickle.loads(payload, buffers=views)
+        self._stats["frames_recv"] += 1
+        self._stats["bytes_recv"] += _HDR.size + ln
+        self._dispatch(tag, src, msg)
 
     def _peer_closed(self, peer: int, s: socket.socket, why: str) -> None:
         """A peer's socket went away (comm thread). During orderly
@@ -610,6 +677,23 @@ class SocketCommEngine(CommEngine):
                 with self._mem_lock:
                     self._mem[h] = exc
                 st[1]()
+        # segment streams fed by the dead peer can never complete —
+        # their activations are in flight exactly like a pending GET
+        # (comm-thread state, same thread as this sweep)
+        self._rxlarge.pop(peer, None)
+        for sid, state in list(self._rx_streams.items()):
+            if state["src"] == peer:
+                del self._rx_streams[sid]
+                if state["tp"] is not None:
+                    doomed.append(
+                        (None, ("activation", state["tp"], peer,
+                                state["msg"])))
+                else:
+                    # activation is PARKED (taskpool unknown): poison
+                    # the parked msg so a later registration aborts the
+                    # pool loudly instead of releasing its deps with a
+                    # silent None payload
+                    state["msg"]["failed"] = str(exc)
         with self._fetch_lock:
             for req, fut in list(self._fetch_futures.items()):
                 if getattr(fut, "owner", None) == peer:
@@ -806,12 +890,15 @@ class SocketCommEngine(CommEngine):
                      {"handle": remote_handle, "value": value,
                       "done_tag": on_remote_done_tag})
         self._stats["puts"] += 1
+        self.record_msg("sent", "put", remote_rank,
+                        self.payload_bytes(value))
         if on_local_done is not None:
             on_local_done()
 
     def get(self, remote_rank: int, remote_handle: int, local_handle: int,
             on_done: Optional[Callable] = None) -> None:
         self._stats["gets"] += 1
+        self.record_msg("sent", "get", remote_rank, 0)
         # register the completion BEFORE the request leaves: the reply may
         # be processed before this function returns (self-rank inline path)
         if on_done is not None:
@@ -829,6 +916,84 @@ class SocketCommEngine(CommEngine):
         through the registered-memory rendezvous."""
         self.remote_dep_activate_multi(task, target_rank, [ref])
 
+    @staticmethod
+    def _encode_value(value) -> Tuple[bytes, List[Any], List[int], int]:
+        """Protocol-5 split of a wire value: ``(head, raws, sizes,
+        total)`` — the pickled control head plus the out-of-band raw
+        buffers that a segment stream carries (the reference's datatype
+        pack path, parsec_comm_engine.h:113-183)."""
+        bufs: List[pickle.PickleBuffer] = []
+        head = pickle.dumps(value, protocol=5, buffer_callback=bufs.append)
+        raws = [b.raw() for b in bufs]
+        sizes = [r.nbytes for r in raws]
+        return head, raws, sizes, sum(sizes)
+
+    @staticmethod
+    def _segments(raws, seg_bytes: int):
+        """Yield per-segment lists of memoryview slices over the
+        concatenated ``raws`` — a virtual split, no copies."""
+        out: List[Any] = []
+        used = 0
+        for r in raws:
+            mv = r if isinstance(r, memoryview) else memoryview(r)
+            off = 0
+            while off < mv.nbytes:
+                take = min(seg_bytes - used, mv.nbytes - off)
+                out.append(mv[off:off + take])
+                used += take
+                off += take
+                if used == seg_bytes:
+                    yield out
+                    out, used = [], 0
+        if out:
+            yield out
+
+    def _new_sid(self) -> int:
+        # globally unique across ranks (forwarders keep the root's sid,
+        # so a stream id must never collide with another sender's)
+        return (self.rank << 32) | next(self._sid_next)
+
+    def _attach_stream(self, msg: Dict, value) -> Optional[List[Any]]:
+        """Above-eager payloads become a pushed segment stream: the
+        activation carries the stream header, the raw bytes follow as
+        DATA_SEG frames (``comm.segment_bytes`` granularity). Returns
+        the raw buffers to stream, or None when the value packed small
+        (inline) — mutates ``msg`` accordingly."""
+        eager_limit = int(mca_param.cached_get("comm.eager_limit",
+                                               256 * 1024))
+        head, raws, sizes, total = self._encode_value(value)
+        if total <= eager_limit:
+            msg["value"] = value      # head-heavy or small: inline
+            return None
+        sid = self._new_sid()
+        msg["stream"] = {"sid": sid, "head": head, "sizes": sizes,
+                         "nbytes": total}
+        msg["nbytes"] = total
+        return raws
+
+    def _send_stream(self, dsts, sid: int, raws) -> None:
+        """Stream the raw buffers to every rank in ``dsts`` as DATA_SEG
+        frames, breadth-first: segment k reaches every child before
+        k+1 leaves, so a forwarding chain overlaps its receive of k+1
+        with the children's receive of k (the pipelined-rendezvous
+        overlap; remote_dep_mpi.c:1963-2118's GET/PUT legs collapse
+        into the stream)."""
+        seg_b = max(4096, int(mca_param.cached_get("comm.segment_bytes",
+                                                   128 * 1024)))
+        direct = self._thread_multiple()
+        for seq, views in enumerate(self._segments(raws, seg_b)):
+            data = [pickle.PickleBuffer(v) for v in views]
+            msg = {"sid": sid, "seq": seq, "data": data}
+            seg_nb = sum(v.nbytes for v in views)
+            for dst in dsts:
+                with self._stats_lock:
+                    self._stats["segs_sent"] += 1
+                self.record_msg("sent", "seg", dst, seg_nb)
+                if direct and dst != self.rank:
+                    self._direct_send(dst, AMTag.DATA_SEG, msg)
+                else:
+                    self._post_cmd(("am", AMTag.DATA_SEG, dst, msg))
+
     def remote_dep_activate_multi(self, task, target_rank: int,
                                   refs) -> None:
         """Packed multi-target activation: N deps of ONE produced value
@@ -839,10 +1004,7 @@ class SocketCommEngine(CommEngine):
         tp = task.taskpool
         monitor = tp.monitor
         monitor.outgoing_message_start(target_rank)
-        targets = [{"class": ref.task_class.name,
-                    "locals": tuple(ref.locals), "flow": ref.flow_name,
-                    "dep_index": ref.dep_index,
-                    "priority": ref.priority} for ref in refs]
+        targets = self._targets_of(refs)
         msg = {"taskpool": tp.name, "targets": targets}
         from ..utils import debug_history
         if debug_history.enabled():   # DEBUG_MARK_CTL_MSG_ACTIVATE_SENT
@@ -850,6 +1012,10 @@ class SocketCommEngine(CommEngine):
                 debug_history.mark("ACTIVATE_SENT to=%d %s.%s%r flow=%s",
                                    target_rank, tp.name, t["class"],
                                    t["locals"], t["flow"])
+        # per-peer aggregation orders same-drain activations by priority
+        # (remote_dep_mpi.c:1089-1139) — a packed msg ranks by its most
+        # urgent target
+        msg["priority"] = max(t["priority"] for t in targets)
         dev_seen = [False]
         value = self.wire_value(refs[0].value, dev_seen)
         if dev_seen[0]:
@@ -858,9 +1024,14 @@ class SocketCommEngine(CommEngine):
             msg["dev"] = True
         nbytes = self.payload_bytes(value)
         eager_limit = int(mca_param.cached_get("comm.eager_limit", 256 * 1024))
+        raws = None
         if value is not None and nbytes > eager_limit:
-            msg["value_handle"] = self.mem_register(value)
-            msg["nbytes"] = nbytes
+            if str(mca_param.cached_get("comm.rdv_push", 1)).lower() \
+                    not in ("0", "off", "false"):
+                raws = self._attach_stream(msg, value)
+            else:
+                msg["value_handle"] = self.mem_register(value)
+                msg["nbytes"] = nbytes
         else:
             msg["value"] = value
         self.record_msg("sent", "activate", target_rank, nbytes)
@@ -871,7 +1042,73 @@ class SocketCommEngine(CommEngine):
             self._direct_send(target_rank, AMTag.ACTIVATE, [msg])
         else:
             self._post_cmd(("activate", target_rank, msg))
+        if raws is not None:
+            self._send_stream((target_rank,), msg["stream"]["sid"], raws)
         monitor.outgoing_message_end(target_rank)
+
+    def remote_dep_broadcast(self, task, rank_refs) -> None:
+        """Tree-routed data-plane broadcast (remote_dep.c:334-413
+        analog): ONE produced value with consumers on >=2 ranks travels
+        each tree edge exactly once. The root computes the participant
+        list, every node rebuilds the identical tree from it
+        (bcast_children over comm.bcast_topology/comm.bcast_fanout; DTD
+        taskpools pin star), forwards to its children before releasing
+        locally, and dead children are reparented — the payload still
+        reaches their live subtrees."""
+        tp = task.taskpool
+        monitor = tp.monitor
+        msg, parts, topo, fanout = self._bcast_envelope(tp, rank_refs)
+        dev_seen = [False]
+        first = next(iter(rank_refs.values()))[0]
+        value = self.wire_value(first.value, dev_seen)
+        if dev_seen[0]:
+            msg["dev"] = True
+        nbytes = self.payload_bytes(value)
+        eager_limit = int(mca_param.cached_get("comm.eager_limit",
+                                               256 * 1024))
+        if nbytes > eager_limit and \
+                str(mca_param.cached_get("comm.rdv_push", 1)).lower() \
+                in ("0", "off", "false"):
+            # comm.rdv_push=0 selects the classic registered-memory
+            # GET/PUT protocol, which cannot pipeline a payload down
+            # the tree (each hop would have to re-register and serve
+            # its own GETs) — honor the knob: one packed classic
+            # activation per consumer rank, no tree
+            for target_rank, refs in rank_refs.items():
+                self.remote_dep_activate_multi(task, target_rank, refs)
+            return
+        if nbytes > eager_limit:
+            raws = self._attach_stream(msg, value)
+        else:
+            # below-eager: inline, without _attach_stream's throwaway
+            # trial serialization
+            msg["value"] = value
+            raws = None
+        children = bcast_live_children(topo, parts, self.rank, fanout,
+                                       self.peer_alive)
+        from ..utils import debug_history
+        if debug_history.enabled():
+            debug_history.mark("BCAST_ROOT %s parts=%r topo=%s kids=%r "
+                               "nbytes=%d", tp.name, parts, topo.value,
+                               children, nbytes)
+        ctx = self._context
+        if ctx is not None and ctx.pins is not None:
+            ctx.pins.bcast_fwd(tp.name, -1, children, nbytes)
+        direct = self._thread_multiple()
+        for c in children:
+            monitor.outgoing_message_start(c)
+            # one entry per tree edge at the logical payload size — the
+            # "bcast" kind's sent_bytes at the root IS its data-plane
+            # egress (the bench guard reads exactly this)
+            self.record_msg("sent", "bcast", c, nbytes)
+            if direct and c != self.rank:
+                self._direct_send(c, AMTag.ACTIVATE, [msg])
+            else:
+                self._post_cmd(("activate", c, msg))
+        if raws is not None:
+            self._send_stream(children, msg["stream"]["sid"], raws)
+        for c in children:
+            monitor.outgoing_message_end(c)
 
     def install_activate_handler(self, context) -> None:
         """Register the runtime AM handlers (ACTIVATE / GET / PUT) — the
@@ -880,6 +1117,7 @@ class SocketCommEngine(CommEngine):
         self.tag_register(AMTag.ACTIVATE, self._on_activate)
         self.tag_register(AMTag.GET_DATA, self._on_get)
         self.tag_register(AMTag.PUT_DATA, self._on_put)
+        self.tag_register(AMTag.DATA_SEG, self._on_data_seg)
         self.tag_register(AMTag.DTD_CONTROL, self._on_dtd_control)
 
     def _find_taskpool(self, name: str):
@@ -891,6 +1129,11 @@ class SocketCommEngine(CommEngine):
     def _on_activate(self, src: int, msgs: List[Dict]) -> None:
         ctx = self._context
         for msg in msgs:
+            if "stream" in msg:
+                # reassembly state must exist BEFORE the taskpool check:
+                # the stream's DATA_SEG frames are right behind this
+                # frame on the socket, taskpool registered or not
+                self._open_rx_stream(src, msg)
             # lookup AND park under the context lock: otherwise the
             # taskpool can register between the miss and the park and the
             # activation is orphaned (local.py does the same)
@@ -904,21 +1147,144 @@ class SocketCommEngine(CommEngine):
                     continue
             self._deliver_activation(tp, src, msg)
 
-    def _deliver_activation(self, tp, src: int, msg: Dict) -> None:
-        from ..core.taskpool import SuccessorRef
+    # ------------------------------------------------ segmented streams
+    def _open_rx_stream(self, src: int, msg: Dict) -> Dict:
+        st = msg["stream"]
+        state = {"sid": st["sid"], "buf": bytearray(st["nbytes"]),
+                 "got": 0, "nbytes": st["nbytes"], "head": st["head"],
+                 "sizes": st["sizes"], "msg": msg, "src": src,
+                 "tp": None, "fwd": ()}
+        self._rx_streams[st["sid"]] = state
+        return state
+
+    def _on_data_seg(self, src: int, msg: Dict) -> None:
+        self._stats["segs_recv"] += 1
+        seg_nb = sum(d.nbytes if isinstance(d, memoryview) else len(d)
+                     for d in msg["data"])
+        self.record_msg("recv", "seg", src, seg_nb)
+        state = self._rx_streams.get(msg["sid"])
+        if state is None:
+            return            # stream swept (peer death) — drop
+        fwd = state["fwd"]
+        if fwd:
+            # pipelined tree edge: re-send segment k downstream BEFORE
+            # copying it in — children receive k while k+1 is in flight
+            out = {"sid": msg["sid"], "seq": msg["seq"],
+                   "data": [pickle.PickleBuffer(d) for d in msg["data"]]}
+            for c in fwd:
+                with self._stats_lock:
+                    self._stats["segs_sent"] += 1
+                self.record_msg("sent", "seg", c, seg_nb)
+                self._send_frame(c, AMTag.DATA_SEG, out)
+        buf, got = state["buf"], state["got"]
+        for d in msg["data"]:
+            n = d.nbytes if isinstance(d, memoryview) else len(d)
+            buf[got:got + n] = d
+            got += n
+        state["got"] = got
+        if got >= state["nbytes"]:
+            self._finish_stream(state)
+
+    def _finish_stream(self, state: Dict) -> None:
+        self._rx_streams.pop(state["sid"], None)
+        mv = memoryview(state["buf"])
+        views: List[Any] = []
+        off = 0
+        for sz in state["sizes"]:
+            views.append(mv[off:off + sz])
+            off += sz
+        value = pickle.loads(state["head"], buffers=views)
+        msg = state["msg"]
+        msg.pop("stream", None)
+        tp = state["tp"]
+        if tp is None:
+            # activation is parked (unknown taskpool): stash the value
+            # in the SAME parked msg — taskpool_registered delivers it
+            msg["value"] = value
+            return
+        self._finish_activation(tp, state["src"], msg, value)
+
+    def _bcast_forward(self, tp, src: int, msg: Dict,
+                       state: Optional[Dict]) -> None:
+        """Receiver-side tree hop: rebuild the identical tree from the
+        participant list, reparent dead children, forward the
+        activation (and, for streams, the bytes received so far — live
+        segments follow in _on_data_seg) BEFORE local release."""
+        b = msg["bcast"]
+        children = bcast_live_children(
+            BcastTopology(b["topo"]), b["parts"], self.rank,
+            b.get("fanout", 0), self.peer_alive)
+        if not children:
+            return
+        nbytes = msg.get("nbytes",
+                         self.payload_bytes(msg.get("value")))
         from ..utils import debug_history
+        if debug_history.enabled():
+            debug_history.mark("BCAST_FWD %s from=%d kids=%r nbytes=%d",
+                               tp.name, src, children, nbytes)
+        ctx = self._context
+        if ctx is not None and ctx.pins is not None:
+            ctx.pins.bcast_fwd(tp.name, src, children, nbytes)
+        monitor = tp.monitor
+        for c in children:
+            monitor.outgoing_message_start(c)
+            self.record_msg("sent", "bcast", c, nbytes)
+            # forwarding runs on the comm thread, which owns the
+            # sockets: write the frame directly (ordering with the
+            # stream catch-up + live segments below is per-socket FIFO)
+            self._send_frame(c, AMTag.ACTIVATE, [msg])
+        if state is not None:
+            got = state["got"]
+            if got:
+                # catch-up: bytes that landed before the taskpool was
+                # known re-stream as one segment; live ones follow
+                catch = {"sid": state["sid"], "seq": -1,
+                         "data": [pickle.PickleBuffer(
+                             memoryview(state["buf"])[:got])]}
+                for c in children:
+                    with self._stats_lock:
+                        self._stats["segs_sent"] += 1
+                    self.record_msg("sent", "seg", c, got)
+                    self._send_frame(c, AMTag.DATA_SEG, catch)
+            state["fwd"] = tuple(children)
+        for c in children:
+            monitor.outgoing_message_end(c)
+
+    def _deliver_activation(self, tp, src: int, msg: Dict) -> None:
+        from ..utils import debug_history
+        if "failed" in msg:
+            # the payload stream died (peer gone) while this activation
+            # was parked — its deps can never be satisfied
+            tp.abort(ConnectionError(
+                f"rank {self.rank}: activation from rank {src} lost "
+                f"its payload stream: {msg['failed']}"))
+            return
+        targets = self._msg_targets(msg)
         if debug_history.enabled():   # DEBUG_MARK_CTL_MSG_ACTIVATE_RECV
-            for t in msg["targets"]:
+            for t in targets:
                 debug_history.mark("ACTIVATE_RECV from=%d %s.%s%r "
                                    "flow=%s", src, tp.name, t["class"],
                                    tuple(t["locals"]), t["flow"])
-        self.record_msg("recv", "activate", src,
+        kind = "bcast" if "bcast" in msg else "activate"
+        self.record_msg("recv", kind, src,
                         msg.get("nbytes",
                                 self.payload_bytes(msg.get("value"))))
         tp.monitor.incoming_message_start(src)
+        state = None
+        if "stream" in msg:
+            state = self._rx_streams.get(msg["stream"]["sid"])
+        if "bcast" in msg:
+            # forward down the tree BEFORE releasing locally
+            self._bcast_forward(tp, src, msg, state)
+        if state is not None:
+            # stream still in flight: completion finishes the
+            # activation (incoming_message_end fires there)
+            state["tp"] = tp
+            return
         if "value_handle" in msg:
-            # rendezvous: allocate the receive slot, GET the payload, and
-            # finish the activation when it lands (get_start analog)
+            # classic rendezvous (comm.rdv_push=0): allocate the receive
+            # slot, GET the payload, and finish the activation when it
+            # lands (get_start analog)
             with self._mem_lock:
                 h = (self.rank << 48) | self._mem_next
                 self._mem_next += 1
@@ -927,6 +1293,7 @@ class SocketCommEngine(CommEngine):
                          {"remote_handle": msg["value_handle"],
                           "reply_handle": h})
             self._stats["gets"] += 1
+            self.record_msg("sent", "get", src, 0)
             return
         self._finish_activation(tp, src, msg, msg.get("value"))
 
@@ -982,8 +1349,9 @@ class SocketCommEngine(CommEngine):
     def _finish_activation(self, tp, src: int, msg: Dict, value) -> None:
         from ..core.taskpool import SuccessorRef
         value = self.stage_recv_value(value, tagged=msg.get("dev", False))
+        targets = self._msg_targets(msg)
         ready = []
-        for t in msg["targets"]:        # one payload, N dependent tasks
+        for t in targets:               # one payload, N dependent tasks
             tc = tp.get_task_class(t["class"])
             ref = SuccessorRef(task_class=tc, locals=tuple(t["locals"]),
                                flow_name=t["flow"], value=value,
@@ -999,14 +1367,18 @@ class SocketCommEngine(CommEngine):
     def _on_get(self, src: int, msg: Dict) -> None:
         """Sender side of the rendezvous: peer asks for a registered
         payload (remote_dep_mpi_save_put_cb → put_start analog)."""
+        self.record_msg("recv", "get", src, 0)
         value = self._mem.get(msg["remote_handle"])
         self.mem_unregister(msg["remote_handle"])
         self.send_am(AMTag.PUT_DATA, src,
                      {"handle": msg["reply_handle"], "value": value})
         self._stats["puts"] += 1
+        self.record_msg("sent", "put", src, self.payload_bytes(value))
 
     def _on_put(self, src: int, msg: Dict) -> None:
         """Receiver side: payload landed (get_end_cb analog)."""
+        self.record_msg("recv", "put", src,
+                        self.payload_bytes(msg.get("value")))
         with self._mem_lock:
             st = self._pending_gets.pop(msg["handle"], None)
         if st is None:
@@ -1041,9 +1413,20 @@ class SocketCommEngine(CommEngine):
             # mesh and termination doesn't fire a second time
             tp.abort(ConnectionError(str(self._peer_failure)))
             return False
-        parked = self._parked.pop(tp.name, [])
-        for (src, msg) in parked:
-            self._deliver_activation(tp, src, msg)
+        # deliver ON THE COMM THREAD: a parked activation may have a
+        # segment stream mid-reassembly there — delivering inline from
+        # this (user) thread would race _on_data_seg/_finish_stream
+        # over the stream state (lost segments between the catch-up
+        # forward and the fwd-list install, or an attach to a state the
+        # comm thread just popped). All _rx_streams access stays
+        # comm-thread-only by construction.
+        if self._thread is None:
+            # no comm thread (single-rank / pre-enable): nothing can be
+            # racing, and a queued command would never drain
+            for (src, msg) in self._parked.pop(tp.name, []):
+                self._deliver_activation(tp, src, msg)
+        else:
+            self._post_cmd(("deliver", tp))
         return True
 
     # ---------------------------------------------------- termdet services

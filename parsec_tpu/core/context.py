@@ -132,6 +132,10 @@ class Context:
             "runtime.release_batch", 1)).lower() not in ("0", "off", "false")
         self._bypass_chain = str(mca_param.get(
             "runtime.bypass_chain", 1)).lower() not in ("0", "off", "false")
+        # data-plane broadcast enable (comm.bcast, registered by
+        # comm.collectives); resolved once like the release knobs
+        self._comm_bcast = str(mca_param.get(
+            "comm.bcast", 1)).lower() not in ("0", "off", "false")
         # per-stage overhead timers (select/dispatch/release into
         # es.stats, insert on DTD taskpools); enabled by the MCA param
         # or the profiling `overhead` PINS module
@@ -548,11 +552,13 @@ class Context:
         # (runtime.release_batch; parsec_release_dep_fct walks its
         # ready-ring the same way) instead of a lock pair per dep
         local_refs: List[SuccessorRef] = []
-        # remote deps sharing one produced value to one rank ship the
-        # payload ONCE (the reference's one-data-per-(dep, rank)
-        # aggregation, remote_dep.c) — grouped here, packed by the
-        # engine's remote_dep_activate_multi
-        remote_groups: Optional[Dict[Tuple[int, int], List]] = \
+        # remote deps sharing one produced value ship the payload ONCE
+        # per rank (the reference's one-data-per-(dep, rank) aggregation,
+        # remote_dep.c) — grouped per VALUE here so the engine can also
+        # tree-route a value with consumers on >=2 ranks down a
+        # broadcast topology (remote_dep_broadcast) instead of paying
+        # one root egress per rank
+        remote_groups: Optional[Dict[int, Dict[int, List]]] = \
             {} if self.nb_ranks > 1 else None
         san = self.dfsan
         grapher = self.grapher
@@ -591,7 +597,8 @@ class Context:
                     if hasattr(ref.task_class, "affinity_rank") else self.my_rank
                 if target_rank != self.my_rank:
                     remote_groups.setdefault(
-                        (target_rank, id(ref.value)), []).append(ref)
+                        id(ref.value), {}).setdefault(
+                            target_rank, []).append(ref)
                     continue
             if self._release_batch:
                 local_refs.append(ref)
@@ -602,8 +609,18 @@ class Context:
         if local_refs:
             ready.extend(tp.activate_deps(local_refs))
         if remote_groups:
-            for (target_rank, _vid), refs in remote_groups.items():
-                self.comm.remote_dep_activate_multi(task, target_rank, refs)
+            for _vid, rank_refs in remote_groups.items():
+                first = next(iter(rank_refs.values()))[0]
+                if self._comm_bcast and len(rank_refs) >= 2 and \
+                        first.value is not None:
+                    # one value, consumers on >=2 ranks: tree-routed
+                    # broadcast (payload leaves this rank once per tree
+                    # edge, not once per consumer rank)
+                    self.comm.remote_dep_broadcast(task, rank_refs)
+                else:
+                    for target_rank, refs in rank_refs.items():
+                        self.comm.remote_dep_activate_multi(
+                            task, target_rank, refs)
         if tc.on_complete is not None:
             tc.on_complete(task)
         if task.on_complete is not None:

@@ -211,6 +211,10 @@ class Taskpool(CoreTaskpool):
         self._wire_tc = TaskClass("__dtd__", -1, params=("seq",), flows=[])
         self._wire_tc.make_key = lambda locals: ("dtd", locals[0])
         self._tc_by_name["__dtd__"] = self._wire_tc
+        # collective pin: the reference restricts DTD broadcasts to the
+        # star topology (remote_dep.c:543-551) — the data plane reads
+        # this before comm.bcast_topology (collectives.resolve_topology)
+        self.bcast_topology = "star"
         self._flush_lock = threading.Lock()
         self._flush_acks = 0
         self._flush_cv = threading.Condition(self._flush_lock)
@@ -788,12 +792,20 @@ class Taskpool(CoreTaskpool):
             succ = list(task.dsl["succ"])
             task.dsl["succ"].clear()
         refs: List[SuccessorRef] = []
+        # remote shell deliveries grouped per (rank, produced value):
+        # one packed activation per rank carries the payload ONCE even
+        # when several shells on that rank read it (star fan-out from
+        # the producer — the DTD collective pin, remote_dep.c:543-551)
+        rsends: Dict[tuple, List[SuccessorRef]] = {}
         for ref in succ:
             if isinstance(ref, tuple):      # remote shell successor
                 _, rank, seq, dst_fname, src_flow, prio = ref
                 value = task.output.get(src_flow, task.data.get(src_flow)) \
                     if src_flow is not None else None
-                self._send_value(rank, seq, dst_fname, value, prio)
+                rsends.setdefault((rank, id(value)), []).append(
+                    SuccessorRef(task_class=self._wire_tc, locals=(seq,),
+                                 flow_name=dst_fname, value=value,
+                                 dep_index=0, priority=prio))
                 continue
             src_flow = getattr(ref, "src_flow", None)
             if src_flow is not None and src_flow in task.output:
@@ -801,6 +813,12 @@ class Taskpool(CoreTaskpool):
             elif src_flow is not None:
                 ref.value = task.data.get(src_flow)
             refs.append(ref)
+        if rsends:
+            import types as _types
+            shim = _types.SimpleNamespace(taskpool=self)
+            for (rank, _vid), wire_refs in rsends.items():
+                self.context.comm.remote_dep_activate_multi(
+                    shim, rank, wire_refs)
         seq = task.locals[0]
         with self._seq_lock(seq & 63):
             self._goals.pop(seq, None)

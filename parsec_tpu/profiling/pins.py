@@ -39,6 +39,10 @@ class PinsEvent(enum.IntEnum):
     # runtime hooks); carries (task, collection, key)
     DATA_READ = 15
     DATA_WRITE = 16
+    # one tree-edge forward of a data-plane broadcast (comm thread):
+    # carries (taskpool_name, src_rank, children, payload_nbytes) —
+    # the collective-propagation visibility check-comms.py asserts on
+    BCAST_FWD = 17
 
 
 class PinsManager:
@@ -95,3 +99,7 @@ class PinsManager:
 
     def data_write(self, task, collection, key) -> None:
         self._fire(PinsEvent.DATA_WRITE, task, collection, key)
+
+    def bcast_fwd(self, taskpool_name, src_rank, children, nbytes) -> None:
+        self._fire(PinsEvent.BCAST_FWD, taskpool_name, src_rank,
+                   children, nbytes)

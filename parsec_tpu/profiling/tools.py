@@ -81,20 +81,37 @@ def summary(traces: List[Dict[str, Any]]) -> Dict[str, Any]:
 def comms(traces: List[Dict[str, Any]]) -> Dict[str, Any]:
     """check-comms.py analog: activation counts and payload byte sums
     from the comm msg_size events (reference asserts e.g. 100 activates
-    / 209,715,200 bytes for bw_test)."""
-    out = {}
+    / 209,715,200 bytes for bw_test). Only ACTIVATION-class events
+    (comm_activate / comm_bcast) feed the headline counters — segment
+    and rendezvous-leg events (comm_seg/comm_put/comm_get) carry bytes
+    of an already-counted activation and would double-count every
+    large payload; they get their own per-kind breakdown instead
+    (mirrors ``CommEngine.stats_by_kind``)."""
+    out: Dict[str, Any] = {}
+    by_kind: Dict[str, Dict[str, int]] = {}
     for rank, tr in enumerate(traces):
         sent = recv = bytes_sent = bytes_recv = 0
         for ev in tr["events"]:
-            if not str(ev["key"]).startswith("comm_"):
+            key = str(ev["key"])
+            if not key.startswith("comm_"):
                 continue
             n = int(ev.get("info", {}).get("msg_size", 0))
+            kind = key[len("comm_"):]
+            bk = by_kind.setdefault(kind, {
+                "sent_msgs": 0, "sent_bytes": 0,
+                "recv_msgs": 0, "recv_bytes": 0})
             if ev["phase"] == "sent":
-                sent += 1
-                bytes_sent += n
+                bk["sent_msgs"] += 1
+                bk["sent_bytes"] += n
+                if kind in ("activate", "bcast"):
+                    sent += 1
+                    bytes_sent += n
             elif ev["phase"] == "recv":
-                recv += 1
-                bytes_recv += n
+                bk["recv_msgs"] += 1
+                bk["recv_bytes"] += n
+                if kind in ("activate", "bcast"):
+                    recv += 1
+                    bytes_recv += n
         out[f"rank{rank}"] = {
             "activations_sent": sent, "activations_recv": recv,
             "bytes_sent": bytes_sent, "bytes_recv": bytes_recv}
@@ -102,6 +119,7 @@ def comms(traces: List[Dict[str, Any]]) -> Dict[str, Any]:
         k: sum(r[k] for r in out.values() if isinstance(r, dict))
         for k in ("activations_sent", "activations_recv",
                   "bytes_sent", "bytes_recv")}
+    out["by_kind"] = by_kind
     return out
 
 

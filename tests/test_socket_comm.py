@@ -190,7 +190,9 @@ def scenario_rendezvous(ctx, engine, rank, nb_ranks, nbytes=2 * 1024 * 1024):
         assert float(A.v[1]) == 2.0 * n
         if B.rank_of((0,)) != rank:
             st = engine.wire_stats()
-            assert st["gets"] >= 1, st     # rendezvous actually used
+            # above-eager transfer actually used: pushed segment stream
+            # (comm.rdv_push default) or the classic GET/PUT legs
+            assert st["gets"] >= 1 or st["segs_recv"] >= 1, st
     return engine.stats["activations_recv"]
 
 
@@ -660,9 +662,10 @@ def scenario_rendezvous_roundtrip(ctx, engine, rank, nb_ranks,
         expect = np.arange(n, dtype=np.float32) * -2.0
         np.testing.assert_array_equal(np.asarray(A.v[2]), expect)
     st = engine.wire_stats()
-    # each rank received one >1 MB value → one rendezvous GET each
-    assert st["gets"] >= 1, st
-    return st["gets"]
+    # each rank received one >1 MB value: a pushed segment stream
+    # (comm.rdv_push default) or one classic rendezvous GET
+    assert st["gets"] >= 1 or st["segs_recv"] >= 1, st
+    return st["gets"] + st["segs_recv"]
 
 
 def scenario_rendezvous_roundtrip_thread_multiple(ctx, engine, rank,
@@ -680,11 +683,31 @@ def scenario_rendezvous_roundtrip_thread_multiple(ctx, engine, rank,
 
 def test_rendezvous_1m_roundtrip_2ranks():
     res = _run_ranks("scenario_rendezvous_roundtrip", 2)
-    assert sum(res.values()) >= 2, res     # one GET per direction
+    assert sum(res.values()) >= 2, res     # one stream/GET per direction
 
 
 def test_rendezvous_1m_roundtrip_thread_multiple():
     res = _run_ranks("scenario_rendezvous_roundtrip_thread_multiple", 2)
+    assert sum(res.values()) >= 2, res
+
+
+def scenario_rendezvous_roundtrip_classic(ctx, engine, rank, nb_ranks):
+    """comm.rdv_push=0: the classic registered-memory GET/PUT rendezvous
+    must keep working bitwise — it is the fallback protocol and the
+    reference-parity path (remote_dep_mpi.c:1963-2118)."""
+    from parsec_tpu.utils import mca_param
+    mca_param.set("comm.rdv_push", 0)
+    try:
+        result = scenario_rendezvous_roundtrip(ctx, engine, rank, nb_ranks)
+        st = engine.wire_stats()
+        assert st["gets"] >= 1 and st["segs_recv"] == 0, st
+        return result
+    finally:
+        mca_param.unset("comm.rdv_push")
+
+
+def test_rendezvous_1m_roundtrip_classic_getput():
+    res = _run_ranks("scenario_rendezvous_roundtrip_classic", 2)
     assert sum(res.values()) >= 2, res
 
 
