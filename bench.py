@@ -916,6 +916,19 @@ def _section_ptile():
                            round(2.0 * n ** 3 / comp_s / 1e9, 1)}}
 
 
+def _section_recovery():
+    """8-rank kill-and-recover (ISSUE 6): a multi-epoch halo-sweep job
+    with periodic async checkpoints; a deterministic injected fault
+    kills rank 3 late in the final epoch; survivors shrink the rank
+    set, adopt the dead shard, and lineage-replay ONLY the failed
+    epoch's affected sub-DAG — reported as time-to-recover (abort →
+    bitwise-checked completion) and lost-work fraction (replayed /
+    whole-job tasks; a checkpoint-restart without lineage would pay
+    the full failed epoch, a restart without checkpoints 1.0)."""
+    from parsec_tpu.comm.recovery_bench import measure_recovery
+    return {"recovery": measure_recovery()}
+
+
 SECTIONS = {
     "hostdtd": _section_hostdtd,
     "ptile": _section_ptile,
@@ -926,6 +939,7 @@ SECTIONS = {
     "ooc": _section_ooc,
     "taskrate": _section_taskrate,
     "bcast": _section_bcast,
+    "recovery": _section_recovery,
 }
 
 # result keys each section produces — failures are recorded under these
@@ -940,6 +954,7 @@ _SECTION_KEYS = {
     "ooc": ("ooc_potrf",),
     "taskrate": ("taskrate",),
     "bcast": ("bcast",),
+    "recovery": ("recovery",),
 }
 
 # geqrf stacks three programs (per-tile stress + 94-wave fused + the
@@ -1000,7 +1015,13 @@ _GFLOPS_GUARD_KEYS = ("value", "gemm_panel_fused_gflops",
                       # rows, so the same >10%-drop guard applies
                       "tasks_per_sec")
 _LATENCY_GUARD_KEYS = ("eager_1k_p50_us", "rdv_1M_p50_us",
-                       "device_64k_p50_us", "bcast_1M_p50_us")
+                       "device_64k_p50_us", "bcast_1M_p50_us",
+                       # recovery rows ride the same rise-guard: a
+                       # slower time-to-recover or a fatter replay
+                       # (lost-work ppm) is a regression that must
+                       # fail loudly, not drift
+                       "recovery_time_to_recover_ms",
+                       "recovery_lost_work_ppm")
 
 
 def _flatten_summary(summary: dict) -> dict:
@@ -1173,6 +1194,15 @@ def _compact_summary(result):
             "bcast_root_egress_payloads": pick(
                 "bcast", "binomial_root_egress_payloads"),
             "bcast_egress_guard": pick("bcast", "egress_guard"),
+            "recovery_time_to_recover_ms": pick(
+                "recovery", "time_to_recover_ms"),
+            # fraction → integer ppm so the generic latency rise-guard
+            # (which needs plain numbers) can watch replay-size creep
+            "recovery_lost_work_ppm": (
+                int(pick("recovery", "lost_work_fraction") * 1e6)
+                if isinstance(pick("recovery", "lost_work_fraction"),
+                              (int, float)) else None),
+            "recovery_bitwise_check": pick("recovery", "bitwise_check"),
             "full_detail": "BENCH_DETAIL.json",
         },
     }
@@ -1466,7 +1496,7 @@ def main():
     extras = {}
     if os.environ.get("PARSEC_BENCH_EXTRAS", "1") != "0":
         for name in ("hostdtd", "ptile", "gemm", "flash", "geqrf",
-                     "getrf", "ooc", "taskrate", "bcast"):
+                     "getrf", "ooc", "taskrate", "bcast", "recovery"):
             extras.update(_run_section(name))
         # host-vs-compiled ratio: both rows fresh in their own child
         try:

@@ -104,6 +104,9 @@ class Model:
     node_writes: Dict[int, List[Tuple[str, Tuple]]] = field(default_factory=dict)
     node_touch: Dict[int, set] = field(default_factory=dict)
     node_affinity: Dict[int, Tuple[str, Tuple]] = field(default_factory=dict)
+    # tile label -> live collection object (data/recovery.py resolves
+    # lost-tile ownership and cut-read sources through this)
+    collections: Dict[str, Any] = field(default_factory=dict)
     # build diagnostics consumed by the lint
     problems: List[Tuple[str, str, str, str]] = field(default_factory=list)
     #         (rule, task_label, flow, message)
@@ -219,6 +222,11 @@ def build_model(tp, max_tasks: int = 0) -> Model:
             m.succ.append([])
             m.index[(tc.name, tuple(p))] = idx
 
+    def _reg_tile(dc, key):
+        tk = _tile_key(dc, key)
+        m.collections.setdefault(tk[0], dc)
+        return tk
+
     # pass 2: producer-side expansion (outs) — edges + collection writes
     for node in m.nodes:
         tc, p = node.tc, node.coords
@@ -228,7 +236,7 @@ def build_model(tp, max_tasks: int = 0) -> Model:
                     continue
                 if dep.data is not None:
                     dc, key = dep.data(g, *p)
-                    tk = _tile_key(dc, key)
+                    tk = _reg_tile(dc, key)
                     acc = TileAccess(node.idx, spec.name, tk, spec.access,
                                      "write")
                     m.writes.setdefault(tk, []).append(acc)
@@ -275,7 +283,7 @@ def build_model(tp, max_tasks: int = 0) -> Model:
             if dep is None or dep.data is None:
                 continue
             dc, key = dep.data(g, *p)
-            tk = _tile_key(dc, key)
+            tk = _reg_tile(dc, key)
             acc = TileAccess(node.idx, spec.name, tk, spec.access, "read")
             m.reads.setdefault(tk, []).append(acc)
 
@@ -290,12 +298,12 @@ def build_model(tp, max_tasks: int = 0) -> Model:
         for spec in node.tc.spec_list:
             if spec.tile is not None:
                 dc, key = spec.tile(g, *node.coords)
-                touch.add(_tile_key(dc, key))
+                touch.add(_reg_tile(dc, key))
         aff = getattr(node.tc, "affinity", None)
         if aff is None:
             continue
         dc, key = aff(g, *node.coords)
-        m.node_affinity[node.idx] = _tile_key(dc, key)
+        m.node_affinity[node.idx] = _reg_tile(dc, key)
     for tk, accs in m.reads.items():
         for a in accs:
             m.node_touch.setdefault(a.node, set()).add(tk)

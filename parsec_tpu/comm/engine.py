@@ -28,7 +28,10 @@ class AMTag(enum.IntEnum):
     #                       a peer closing WITHOUT it is a failure
     DATA_SEG = 9          # pipelined payload segment of an activation
     #                       stream (segmented rendezvous / broadcast edge)
-    FIRST_USER_TAG = 10
+    RECOVER = 10          # fault-recovery control plane: completed-set
+    #                       allgather across the live rank set
+    #                       (data/recovery.exchange_completed)
+    FIRST_USER_TAG = 11
 
 MAX_REGISTERED_TAGS = 32     # PARSEC_MAX_REGISTERED_TAGS (parsec_comm_engine.h:24)
 
@@ -290,6 +293,24 @@ class CommEngine:
         """False once ``rank`` is known dead (failure detection).
         Engines without failure detection report every peer alive."""
         return True
+
+    def recover_exchange(self, token: str, payload: Any, dead_ranks,
+                         timeout: float = 60.0) -> Dict[int, Any]:
+        """Allgather ``payload`` across the LIVE rank set (everyone
+        minus ``dead_ranks``) under a caller-chosen ``token`` — the
+        completed-set exchange of survivor-side recovery
+        (data/recovery.exchange_completed). Engines without failure
+        handling only support the trivial single-rank case."""
+        if self.nb_ranks <= 1:
+            return {self.rank: payload}
+        raise NotImplementedError
+
+    def acknowledge_failure(self) -> None:
+        """Shrink-mode continuation (ULFM agreement analog): the caller
+        has planned around the recorded dead peers — clear the sticky
+        failure so NEW taskpools (the replay pool) may register. The
+        dead set itself stays: sends toward dead ranks keep dropping
+        and broadcast trees keep routing around them."""
 
     # -- progress ---------------------------------------------------------
     def progress(self) -> int:

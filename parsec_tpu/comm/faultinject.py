@@ -1,0 +1,133 @@
+"""Deterministic, seedable failure injection (``comm.fault_inject``).
+
+Real SIGKILL tests race the signal against the DAG's progress — the
+round-5/6 suites had to pad sleeps into task bodies so the kill landed
+mid-flight. This harness makes the failure a *deterministic point in the
+execution*: the victim rank counts its own completed tasks (or sent
+frames) and fails itself at exactly the Nth one, so an 8-rank
+kill-and-recover test is reproducible in-suite with no timing sleeps.
+
+Modes (``comm.fault_inject``):
+
+- ``off``  — disabled (default);
+- ``kill`` — the victim hard-exits (``os._exit(137)``), the SIGKILL
+  analog: peers see the socket close and run the failure path;
+- ``drop`` — the victim goes silent: every subsequent outbound frame is
+  dropped and its peer sockets are torn down (a crashed process from
+  the peers' view) but the PROCESS SURVIVES, so a single test harness
+  can still collect its state. Locally the engine runs the same
+  peer-death sweep, aborting the victim's own taskpools.
+
+The trigger is ``comm.fault_inject_after`` counted units on
+``comm.fault_inject_rank``.  ``comm.fault_inject_seed`` adds a
+deterministic, seed-derived jitter of up to +100% to the trigger point —
+property-style sweeps get varied-but-reproducible failure positions
+without a timing dependence.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from typing import Optional
+
+from ..utils import mca_param
+from ..utils.debug import warning
+
+mca_param.register("comm.fault_inject", "off",
+                   help="failure injection mode: off | drop (victim "
+                        "goes silent but survives) | kill (victim "
+                        "hard-exits, the SIGKILL analog)",
+                   choices=("off", "drop", "kill"))
+mca_param.register("comm.fault_inject_rank", -1,
+                   help="victim rank of the injected failure (-1 = "
+                        "disabled)")
+mca_param.register("comm.fault_inject_after", 0,
+                   help="fire after this many counted units on the "
+                        "victim (completed tasks or sent frames, see "
+                        "comm.fault_inject_unit); 0 = disabled")
+mca_param.register("comm.fault_inject_unit", "tasks",
+                   help="what comm.fault_inject_after counts: tasks "
+                        "(completed locally — a deterministic DAG "
+                        "position) or frames (outbound wire frames)",
+                   choices=("tasks", "frames"))
+mca_param.register("comm.fault_inject_seed", 0,
+                   help="0 = fire exactly at `after`; otherwise a "
+                        "deterministic jitter derived from "
+                        "(seed, rank) stretches the trigger to "
+                        "[after, 2*after) — reproducible variation")
+
+
+class FaultInjector:
+    """Counts execution units on the victim rank and fires the
+    configured failure exactly once. Thread-safe: ticks come from worker
+    threads (task units) or send paths (frame units)."""
+
+    def __init__(self, rank: int, mode: str, after: int, unit: str,
+                 seed: int):
+        self.rank = rank
+        self.mode = mode
+        self.unit = unit
+        if seed:
+            h = int.from_bytes(
+                hashlib.sha256(f"{seed}:{rank}".encode()).digest()[:4],
+                "big")
+            after = after + (h % max(after, 1))
+        self.trigger = after
+        self._count = 0
+        self._fired = False
+        self._lock = threading.Lock()
+        self._engine = None        # set by the engine that owns us
+
+    @classmethod
+    def from_mca(cls, rank: int) -> Optional["FaultInjector"]:
+        mode = str(mca_param.get("comm.fault_inject", "off")).lower()
+        victim = int(mca_param.get("comm.fault_inject_rank", -1))
+        after = int(mca_param.get("comm.fault_inject_after", 0))
+        if mode == "off" or victim != rank or after <= 0:
+            return None
+        return cls(rank, mode,
+                   after,
+                   str(mca_param.get("comm.fault_inject_unit", "tasks")),
+                   int(mca_param.get("comm.fault_inject_seed", 0)))
+
+    def attach(self, engine) -> None:
+        self._engine = engine
+
+    # -- tick points ------------------------------------------------------
+    def on_task_complete(self) -> None:
+        if self.unit == "tasks":
+            self._tick()
+
+    def on_frame_sent(self) -> bool:
+        """Returns True when the frame should be DROPPED (drop mode has
+        fired: the victim is silent)."""
+        if self.unit == "frames":
+            self._tick()
+        return self._fired and self.mode == "drop"
+
+    @property
+    def fired(self) -> bool:
+        return self._fired
+
+    def _tick(self) -> None:
+        with self._lock:
+            if self._fired:
+                return
+            self._count += 1
+            if self._count < self.trigger:
+                return
+            self._fired = True
+        self._fire()
+
+    def _fire(self) -> None:
+        warning("faultinject",
+                "rank %d: injected fault fires (%s after %d %s)",
+                self.rank, self.mode, self.trigger, self.unit)
+        if self.mode == "kill":
+            # the SIGKILL analog: no atexit, no flush, no goodbye frame
+            os._exit(137)
+        engine = self._engine
+        if engine is not None and hasattr(engine, "go_silent"):
+            engine.go_silent("injected fault (drop mode)")

@@ -1,0 +1,358 @@
+"""Kill-and-recover benchmark over the socket engine (``--section
+recovery``).
+
+An 8-rank halo-sweep taskpool (the stencil shape: cross-rank neighbor
+edges every sweep, one terminal write per tile) runs with deterministic
+failure injection (:mod:`~parsec_tpu.comm.faultinject`): the victim rank
+goes silent after a fixed number of completed tasks, the survivors'
+taskpools abort through the failure-detection path, and the survivors
+then run the full recovery loop — completed-set exchange, lineage plan,
+shrink remap + shard adoption, sub-DAG replay — to a bitwise-checked
+finish. Reported: **time-to-recover** (abort → replay completion, the
+latency a serving system pays per failure) and **lost-work fraction**
+(replayed tasks / total tasks — how much of the job the lineage cut
+saved vs a full restart, which would be 1.0)."""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import time
+from typing import Dict
+
+import numpy as np
+
+from .pingpong import _free_port_base
+
+
+class DistVec:
+    """1-D float32-tile collection, round-robin owner by index; carries
+    the full vtable recovery needs (name/keys/is_local for exposure,
+    checkpointing and shard adoption)."""
+
+    def __init__(self, name: str, n: int, nb_ranks: int, my_rank: int,
+                 init_fn=None):
+        self.name = name
+        self.n = n
+        self.nb_ranks = nb_ranks
+        self.myrank = my_rank
+        self.dc_id = 29
+        self.v = {}
+        if init_fn is not None:
+            self.v = {(i,): np.float32(init_fn(i)) for i in range(n)
+                      if i % nb_ranks == my_rank}
+
+    @staticmethod
+    def _k(key):
+        return (key[0],) if isinstance(key, (tuple, list)) else (key,)
+
+    def rank_of(self, key) -> int:
+        return self._k(key)[0] % self.nb_ranks
+
+    def data_of(self, key):
+        return self.v[self._k(key)]
+
+    def write_tile(self, key, value) -> None:
+        self.v[self._k(key)] = value
+
+    def keys(self):
+        return [(i,) for i in range(self.n)]
+
+    def is_local(self, key) -> bool:
+        return self.rank_of(key) == self.myrank
+
+
+def build_sweep(X, n_tiles: int, timesteps: int, weight=1.0 / 3.0):
+    """Halo-sweep taskpool (the stencil shape, made rank-correct for
+    owner-computes: sweep 0 reads ONLY the task's own tile — boundary
+    halos reflect through the center — so every collection read is
+    owner-local and cross-rank traffic is pure task→task halo edges)."""
+    from ..dsl import ptg
+
+    tp = ptg.Taskpool("sweep", X=X, N=n_tiles, T=timesteps, w=weight)
+    S = tp.task_class(
+        "S", params=("t", "i"),
+        space=lambda g: ((t, i) for t in range(g.T) for i in range(g.N)),
+        affinity=lambda g, t, i: (g.X, (i,)),
+        priority=lambda g, t, i: g.T - t,
+        flows=[
+            ptg.FlowSpec(
+                "L", ptg.READ,
+                ins=[ptg.In(src=("S", lambda g, t, i: (t - 1, i - 1),
+                                 "C"),
+                            guard=lambda g, t, i: t > 0 and i > 0)]),
+            ptg.FlowSpec(
+                "C", ptg.RW,
+                tile=lambda g, t, i: (g.X, (i,)),
+                ins=[ptg.In(data=lambda g, t, i: (g.X, (i,)),
+                            guard=lambda g, t, i: t == 0),
+                     ptg.In(src=("S", lambda g, t, i: (t - 1, i), "C"),
+                            guard=lambda g, t, i: t > 0)],
+                outs=[
+                    ptg.Out(dst=("S", lambda g, t, i: (t + 1, i), "C"),
+                            guard=lambda g, t, i: t < g.T - 1),
+                    ptg.Out(dst=("S", lambda g, t, i: (t + 1, i + 1),
+                                 "L"),
+                            guard=lambda g, t, i: t < g.T - 1 and
+                            i + 1 < g.N),
+                    ptg.Out(dst=("S", lambda g, t, i: (t + 1, i - 1),
+                                 "R"),
+                            guard=lambda g, t, i: t < g.T - 1 and i > 0),
+                    ptg.Out(data=lambda g, t, i: (g.X, (i,)),
+                            guard=lambda g, t, i: t == g.T - 1)]),
+            ptg.FlowSpec(
+                "R", ptg.READ,
+                ins=[ptg.In(src=("S", lambda g, t, i: (t - 1, i + 1),
+                                 "C"),
+                            guard=lambda g, t, i: t > 0 and
+                            i < g.N - 1)]),
+        ])
+
+    @S.body(batchable=False)
+    def s_body(task, L, C, R):
+        left = C if L is None else L
+        right = C if R is None else R
+        return np.float32((left + C + right) * np.float32(tp.g.w))
+
+    return tp
+
+
+def sweep_reference(n_tiles: int, timesteps: int, init_fn,
+                    weight=1.0 / 3.0) -> np.ndarray:
+    """Bitwise reference of :func:`build_sweep` (same float32 op
+    order as the body)."""
+    w = np.float32(weight)
+    x = np.array([np.float32(init_fn(i)) for i in range(n_tiles)],
+                 dtype=np.float32)
+    for t in range(timesteps):
+        nx = np.empty_like(x)
+        for i in range(n_tiles):
+            left = x[i - 1] if (t > 0 and i > 0) else x[i]
+            right = x[i + 1] if (t > 0 and i < n_tiles - 1) else x[i]
+            nx[i] = np.float32((left + x[i] + right) * w)
+        x = nx
+    return x
+
+
+def _init(i: int) -> float:
+    return float(i % 11) + 0.25
+
+
+def _rank_main(rank: int, nb_ranks: int, base_port: int, n_tiles: int,
+               epochs: int, sweeps_per_epoch: int, victim: int,
+               after: int, ckpt_dir: str, q) -> None:
+    """One rank of the kill-and-recover round: ``epochs`` sequential
+    sweep taskpools with a checkpoint at every quiesce; the victim goes
+    silent mid-final-epoch; survivors replay only the failed epoch's
+    affected sub-DAG from the latest complete checkpoint."""
+    try:
+        from ..comm.socket_engine import SocketCommEngine
+        from ..core import context as ctx_mod
+        from ..data import recovery
+        from ..utils import mca_param
+
+        mca_param.set("runtime.stage_reads", "0")
+        mca_param.set("comm.stage_recv", "0")
+        mca_param.set("device.tpu.enabled", False)
+        if rank == victim:
+            # drop (go-silent) rather than kill: the victim process
+            # survives to report, while peers see a crashed rank
+            mca_param.set("comm.fault_inject", "drop")
+            mca_param.set("comm.fault_inject_rank", victim)
+            mca_param.set("comm.fault_inject_after", after)
+            mca_param.set("comm.fault_inject_unit", "tasks")
+        engine = SocketCommEngine(rank, nb_ranks, base_port=base_port)
+        ctx = ctx_mod.init(nb_cores=2, comm=engine)
+        X = DistVec("X", n_tiles, nb_ranks, rank, _init)
+        mgr = None
+        if ckpt_dir:
+            mgr = ctx.enable_checkpoints({"X": X}, directory=ckpt_dir,
+                                         interval=1)
+        t_start = time.perf_counter()
+        ctx.start()
+        failed_epoch = None
+        tp = None
+        e = 0
+        try:
+            for e in range(epochs):
+                tp = build_sweep(X, n_tiles, sweeps_per_epoch)
+                tp.name = f"sweep{e}"
+                ctx.add_taskpool(tp)
+                if not ctx.wait(timeout=120):
+                    raise RuntimeError(f"epoch {e} did not terminate")
+                ctx.checkpoint_wait()
+                engine.sync()    # step complete on EVERY rank before
+                #                  the next epoch may fail into it
+        except RuntimeError:
+            if tp is None or tp.error is None:
+                raise
+            failed_epoch = e     # this rank's pool aborted mid-epoch
+        except ConnectionError:
+            # the failure landed while THIS rank sat in the epoch-e
+            # boundary (ckpt barrier). Ranks only pass barrier e after
+            # every rank completed epoch e, so the failed epoch is e+1
+            # (the victim raced ahead) — unless e was the last epoch:
+            # then e itself is suspect (its termdet wave may have
+            # completed over the shrunk live set, silently missing the
+            # dead rank's tail tasks) and is conservatively replayed.
+            if e + 1 < epochs:
+                failed_epoch = e + 1
+                tp = build_sweep(X, n_tiles, sweeps_per_epoch)
+                tp.name = f"sweep{failed_epoch}"
+            else:
+                failed_epoch = e
+        if failed_epoch is None and rank != victim and \
+                not engine.peer_alive(victim):
+            # the death landed after the last wave completed shrunk on
+            # every survivor: the final epoch is missing the victim's
+            # tail — replay it
+            failed_epoch = epochs - 1
+        failed_at = time.perf_counter()
+        if rank == victim:
+            q.put((rank, "victim",
+                   {"aborted": failed_epoch is not None}))
+            engine.disable()
+            return
+        if failed_epoch is None:
+            raise RuntimeError("expected the victim's death to abort")
+        if mgr is not None and failed_epoch > 0:
+            # replay of epoch f starts from step f exactly (the state
+            # after epochs 0..f-1) — NOT latest_step(): racy local
+            # completions around the death can leave a LATER step
+            # complete, and replaying from the wrong base would skip or
+            # redo whole epochs
+            src = recovery.checkpoint_shadow_source(mgr, failed_epoch,
+                                                    {"X": X})
+        else:
+            src = (lambda label, key: np.float32(_init(key[0])))
+        _rtp, plan = recovery.replay_lost_work(
+            ctx, tp, {victim}, src, shrink=True, adopt={"X": X})
+        if not ctx.wait(timeout=120):
+            raise RuntimeError("replay did not terminate")
+        recovered_at = time.perf_counter()
+        vals = {i: float(X.data_of((i,))) for i in range(n_tiles)
+                if X.rank_of((i,)) == rank}
+        engine.sync()
+        ctx.fini()
+        q.put((rank, "ok", {
+            "vals": vals,
+            "failed_epoch": failed_epoch,
+            "replayed": plan.replayed_tasks,
+            "epoch_tasks": plan.total_tasks,
+            "t_run_to_fail_s": failed_at - t_start,
+            "t_recover_s": recovered_at - failed_at}))
+    except BaseException as exc:  # noqa: BLE001 — report to parent
+        import traceback
+        q.put((rank, "error", f"{exc}\n{traceback.format_exc()}"))
+
+
+def _baseline_main(rank: int, nb_ranks: int, base_port: int,
+                   n_tiles: int, epochs: int, sweeps_per_epoch: int,
+                   q) -> None:
+    try:
+        from ..comm.socket_engine import SocketCommEngine
+        from ..core import context as ctx_mod
+        from ..utils import mca_param
+
+        mca_param.set("runtime.stage_reads", "0")
+        mca_param.set("comm.stage_recv", "0")
+        mca_param.set("device.tpu.enabled", False)
+        engine = SocketCommEngine(rank, nb_ranks, base_port=base_port)
+        ctx = ctx_mod.init(nb_cores=2, comm=engine)
+        X = DistVec("X", n_tiles, nb_ranks, rank, _init)
+        t0 = time.perf_counter()
+        ctx.start()
+        for e in range(epochs):
+            tp = build_sweep(X, n_tiles, sweeps_per_epoch)
+            tp.name = f"sweep{e}"
+            ctx.add_taskpool(tp)
+            if not ctx.wait(timeout=120):
+                raise RuntimeError(f"epoch {e} did not terminate")
+            engine.sync()
+        total_s = time.perf_counter() - t0
+        vals = {i: float(X.data_of((i,))) for i in range(n_tiles)
+                if X.rank_of((i,)) == rank}
+        engine.sync()
+        ctx.fini()
+        q.put((rank, "ok", {"total_s": total_s, "vals": vals}))
+    except BaseException as exc:  # noqa: BLE001
+        import traceback
+        q.put((rank, "error", f"{exc}\n{traceback.format_exc()}"))
+
+
+def measure_recovery(nb_ranks: int = 8, n_tiles: int = 32,
+                     epochs: int = 6, sweeps_per_epoch: int = 2,
+                     victim: int = 3, after_frac: float = 0.75,
+                     timeout: float = 240.0) -> Dict:
+    """Run the no-failure baseline, then the kill-and-recover round
+    (periodic checkpoints + failure injected late in the final epoch),
+    and return time-to-recover + lost-work-fraction rows, both
+    bitwise-checked against the uninterrupted run."""
+    import tempfile
+    ctx = mp.get_context("spawn")
+
+    def run(target, extra):
+        q = ctx.Queue()
+        base_port = _free_port_base(nb_ranks)
+        procs = [ctx.Process(target=target,
+                             args=(r, nb_ranks, base_port, n_tiles,
+                                   epochs, sweeps_per_epoch) + extra
+                             + (q,))
+                 for r in range(nb_ranks)]
+        for p in procs:
+            p.start()
+        out = {}
+        try:
+            for _ in range(nb_ranks):
+                rank, status, payload = q.get(timeout=timeout)
+                if status == "error":
+                    raise RuntimeError(f"rank {rank} failed:\n{payload}")
+                out[rank] = (status, payload)
+        finally:
+            for p in procs:
+                p.join(timeout=10.0)
+                if p.is_alive():
+                    p.terminate()
+        return out
+
+    base = run(_baseline_main, ())
+    baseline_s = max(p["total_s"] for (_s, p) in base.values())
+    ref = {}
+    for (_s, p) in base.values():
+        ref.update(p["vals"])
+
+    # victim dies ~after_frac through ITS OWN work of the final epoch
+    per_epoch_victim = sweeps_per_epoch * n_tiles // nb_ranks
+    after = (epochs - 1) * per_epoch_victim + \
+        max(1, int(per_epoch_victim * after_frac))
+    with tempfile.TemporaryDirectory(prefix="parsec_reco_") as ckpt:
+        res = run(_rank_main, (victim, after, ckpt))
+
+    survivors = [(r, p) for r, (s, p) in res.items() if s == "ok"]
+    got = {}
+    for _r, p in survivors:
+        got.update(p["vals"])
+    mism = [i for i in range(n_tiles)
+            if got.get(i) is None or np.float32(got[i]) !=
+            np.float32(ref[i])]
+    replayed = survivors[0][1]["replayed"]
+    epoch_tasks = survivors[0][1]["epoch_tasks"]
+    job_tasks = epochs * sweeps_per_epoch * n_tiles
+    t_recover = max(p["t_recover_s"] for (_r, p) in survivors)
+    return {
+        "nb_ranks": nb_ranks,
+        "epochs": epochs,
+        "job_tasks": job_tasks,
+        "victim_rank": victim,
+        "injected_after_tasks": after,
+        "failed_epoch": survivors[0][1]["failed_epoch"],
+        "baseline_s": round(baseline_s, 3),
+        "time_to_recover_s": round(t_recover, 3),
+        "time_to_recover_ms": round(t_recover * 1e3, 1),
+        "replayed_tasks": replayed,
+        "failed_epoch_tasks": epoch_tasks,
+        # of the WHOLE JOB: a full restart would be 1.0; checkpointing
+        # bounds it to the failed epoch, lineage to its affected sub-DAG
+        "lost_work_fraction": round(replayed / job_tasks, 4),
+        "bitwise_check": "OK" if not mism else
+        f"FAIL: {len(mism)} tiles differ ({mism[:8]})",
+    }

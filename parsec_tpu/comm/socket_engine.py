@@ -66,6 +66,13 @@ mca_param.register("comm.rdv_push", 1,
                         "backpressure replaces receiver pacing); 0 = "
                         "classic registered-memory GET/PUT rendezvous "
                         "(remote_dep_mpi.c:1963-2118)")
+mca_param.register("comm.rejoin", 0,
+                   help="accept a replacement rank for a dead peer: on "
+                        "death detection this rank re-opens its wireup "
+                        "listener and a process started with "
+                        "SocketCommEngine(..., rejoin=True) can adopt "
+                        "the dead rank's slot (ULFM-style shrink/"
+                        "respawn); 0 = a dead rank stays dead")
 mca_param.register("comm.thread_multiple", 0,
                    help="MPI_THREAD_MULTIPLE analog (parsec_param_comm_"
                         "thread_multiple, remote_dep.h:166): worker "
@@ -80,15 +87,19 @@ mca_param.register("comm.thread_multiple", 0,
 _HDR = struct.Struct("!Q")     # frame length prefix
 _U32 = struct.Struct("!I")     # pickle-section length prefix
 _WAKE_PEER = -1                # selector data tag of the self-pipe
+_LISTEN_PEER = -2              # selector data tag of the rejoin listener
 
 
 class _WaveState:
-    """Coordinator-side (rank 0) state of one in-flight termdet wave."""
+    """Coordinator-side state of one in-flight termdet wave (the
+    coordinator is the lowest LIVE rank — rank 0 unless it died)."""
 
-    def __init__(self, name: str, wave_id: int, nb_ranks: int):
+    def __init__(self, name: str, wave_id: int, live):
         self.name = name
         self.wave_id = wave_id
-        self.pending = nb_ranks
+        self.live = set(live)
+        self.pending = len(self.live)
+        self.replied: set = set()
         self.sent = 0
         self.received = 0
         self.all_idle = True
@@ -98,7 +109,7 @@ class SocketCommEngine(CommEngine):
     """parsec_comm_engine_t implementation over localhost TCP."""
 
     def __init__(self, rank: int, nb_ranks: int, base_port: int = 27450,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", rejoin: bool = False):
         super().__init__(rank, nb_ranks)
         self.host = host
         self.base_port = base_port
@@ -139,11 +150,30 @@ class SocketCommEngine(CommEngine):
         # rxbuf never holds more than the small-frame working set)
         self._rxlarge: Dict[int, List] = {}
         self._termdet_monitors: Dict[str, object] = {}
-        # wave coordination (rank 0)
+        # wave coordination (lowest live rank)
         self._waves: Dict[str, _WaveState] = {}
         self._wave_next_id = 0
         self._barrier_release = threading.Event()
-        self._barrier_count = 0                  # rank 0, comm thread only
+        # coordinator-side barrier entries, keyed by GENERATION (= the
+        # entrant's observed death count): entries abandoned when a
+        # peer death failed their barrier stay in their own bucket and
+        # can never release a post-recovery barrier early
+        self._barrier_counts: Dict[int, int] = {}
+        self._barrier_gen = 0                    # this rank's last entry
+        # fault recovery: rejoin listener + per-rank admit events, and
+        # the RECOVER-tag allgather state (comm-thread-only dicts)
+        self._rejoin_listener: Optional[socket.socket] = None
+        self._rejoin_evts: Dict[int, threading.Event] = {}
+        self._rejoin_lock = threading.Lock()
+        self._recover_state: Dict[str, Dict] = {}
+        self._recover_futs: Dict[str, object] = {}
+        self._silenced = False
+        self.tag_register(AMTag.RECOVER, self._on_recover)
+        # deterministic failure injection (comm.fault_inject)
+        from .faultinject import FaultInjector
+        self.fault = FaultInjector.from_mca(rank)
+        if self.fault is not None:
+            self.fault.attach(self)
         # control-plane tags usable without a Context
         self.tag_register(AMTag.BARRIER, self._on_barrier)
         self.tag_register(AMTag.TERMDET_FOURCOUNTER, self._on_termdet)
@@ -163,7 +193,10 @@ class SocketCommEngine(CommEngine):
         self._wake_w.setblocking(False)
         self._sel.register(self._wake_r, selectors.EVENT_READ, _WAKE_PEER)
         if nb_ranks > 1:
-            self._wireup()
+            if rejoin:
+                self._wireup_rejoin()
+            else:
+                self._wireup()
 
     def _post_cmd(self, cmd: Tuple) -> None:
         """Enqueue a command for the comm thread and kick its selector —
@@ -213,6 +246,146 @@ class SocketCommEngine(CommEngine):
         self._listener = None
         debug_verbose(3, "comm", "rank %d: mesh up (%d peers)",
                       self.rank, len(self._socks))
+
+    def _wireup_rejoin(self) -> None:
+        """Replacement-rank wireup: adopt a dead rank's slot by
+        connecting OUT to every other rank (their rejoin listeners
+        reopen on death detection — comm.rejoin); retried until the
+        wireup deadline, since survivors open their listeners only once
+        they detect the death."""
+        timeout = float(mca_param.get("comm.wireup_timeout_s", 30.0))
+        deadline = time.monotonic() + timeout
+        for peer in range(self.nb_ranks):
+            if peer == self.rank:
+                continue
+            while True:
+                s = None
+                try:
+                    s = socket.create_connection(
+                        (self.host, self.base_port + peer), timeout=2.0)
+                    s.settimeout(2.0)
+                    s.sendall(struct.pack("!I", self.rank))
+                    # explicit admit/deny: a TCP connect alone is NOT
+                    # admission — the peer may refuse (it has not
+                    # detected our predecessor's death yet, or the rank
+                    # id is still live there); retry until admitted
+                    if self._recv_exact(s, 1) == b"\x01":
+                        break
+                    raise ConnectionRefusedError("rejoin denied")
+                except OSError:
+                    if s is not None:
+                        try:
+                            s.close()
+                        except OSError:
+                            pass
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"rank {self.rank}: rejoin to {peer} timed "
+                            f"out (is comm.rejoin enabled there?)")
+                    time.sleep(0.05)
+            self._register_peer(peer, s)
+        debug_verbose(2, "comm", "rank %d: rejoined mesh (%d peers)",
+                      self.rank, len(self._socks))
+
+    def _open_rejoin_listener(self) -> None:
+        """Re-open this rank's wireup port so a replacement for a dead
+        peer can connect (comm thread; idempotent)."""
+        if self._rejoin_listener is not None:
+            return
+        try:
+            lst = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            lst.bind((self.host, self.base_port + self.rank))
+            lst.listen(self.nb_ranks)
+            lst.setblocking(False)
+        except OSError as exc:
+            warning("comm", "rank %d: cannot open rejoin listener: %s",
+                    self.rank, exc)
+            return
+        self._rejoin_listener = lst
+        self._sel.register(lst, selectors.EVENT_READ, _LISTEN_PEER)
+        debug_verbose(2, "comm", "rank %d: rejoin listener open",
+                      self.rank)
+
+    def _close_rejoin_listener(self) -> None:
+        lst = self._rejoin_listener
+        if lst is None:
+            return
+        self._rejoin_listener = None
+        try:
+            self._sel.unregister(lst)
+        except (KeyError, ValueError):
+            pass
+        try:
+            lst.close()
+        except OSError:
+            pass
+
+    def _accept_rejoin(self, lst: socket.socket) -> None:
+        """Admit a replacement rank (comm thread): it identifies itself
+        with its adopted rank id, which must currently be dead."""
+        while True:
+            try:
+                s, _addr = lst.accept()
+            except (BlockingIOError, OSError):
+                return
+            try:
+                s.settimeout(2.0)
+                peer = struct.unpack("!I", self._recv_exact(s, 4))[0]
+            except (OSError, struct.error) as exc:
+                warning("comm", "rank %d: bad rejoin handshake: %s",
+                        self.rank, exc)
+                s.close()
+                continue
+            if peer not in self._dead_peers:
+                # deny explicitly (the replacement retries — e.g. we
+                # have not detected its predecessor's death yet)
+                warning("comm", "rank %d: rejoin for live rank %d "
+                        "refused", self.rank, peer)
+                try:
+                    s.sendall(b"\x00")
+                except OSError:
+                    pass
+                s.close()
+                continue
+            try:
+                s.sendall(b"\x01")      # admit BEFORE going non-blocking
+            except OSError as exc:
+                warning("comm", "rank %d: rejoin admit failed: %s",
+                        self.rank, exc)
+                s.close()
+                continue
+            self._register_peer(peer, s)
+            self._sel.register(s, selectors.EVENT_READ, peer)
+            self._dead_peers.discard(peer)
+            self._bye_peers.discard(peer)
+            if not self._dead_peers:
+                # mesh whole again: new taskpools may launch
+                self._peer_failure = None
+                self._close_rejoin_listener()
+            with self._rejoin_lock:
+                evt = self._rejoin_evts.setdefault(peer,
+                                                   threading.Event())
+            evt.set()
+            warning("comm", "rank %d: rank %d rejoined the mesh",
+                    self.rank, peer)
+
+    def wait_rejoin(self, rank: int, timeout: float = 60.0) -> bool:
+        """Block until a replacement for dead ``rank`` has been
+        admitted (survivor-side rendezvous before planning replay)."""
+        with self._rejoin_lock:
+            evt = self._rejoin_evts.setdefault(rank, threading.Event())
+        return evt.wait(timeout)
+
+    def acknowledge_failure(self) -> None:
+        self._peer_failure = None
+
+    def go_silent(self, why: str) -> None:
+        """Drop-mode fault injection: stop all outbound traffic and
+        tear down the peer sockets so peers detect a crash — but keep
+        the process alive (the in-suite failure harness)."""
+        self._silenced = True
+        self._post_cmd(("go_silent", why))
 
     @staticmethod
     def _recv_exact(s: socket.socket, n: int) -> bytes:
@@ -276,6 +449,7 @@ class SocketCommEngine(CommEngine):
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        self._close_rejoin_listener()     # after the join: comm-thread state
         for s in self._socks.values():
             # unregister BEFORE closing: a stale selector entry whose fd
             # number gets reused by a later socket would break re-enable
@@ -359,6 +533,13 @@ class SocketCommEngine(CommEngine):
                     self._deliver_activation(tp, src, msg)
             elif kind == "peer_dead":  # ("peer_dead", peer, why) — posted
                 self._mark_peer_dead(cmd[1], cmd[2])  # by worker threads
+            elif kind == "go_silent":  # drop-mode fault injection: the
+                # victim "crashes" from the peers' view — every peer
+                # socket torn down, no BYE, local pools aborted through
+                # the same peer-death sweep the survivors run
+                for peer in [p for p in list(self._socks)
+                             if p != self.rank]:
+                    self._mark_peer_dead(peer, cmd[1])
             else:                      # ("am", tag, dst, msg)
                 other.append(cmd)
         for dst, msgs in per_peer.items():
@@ -446,6 +627,8 @@ class SocketCommEngine(CommEngine):
         head-of-line deadlock of two ranks pushing large frames at each
         other with full TCP buffers; no wait ever happens under the
         per-peer lock (unsent remainders go to txbuf)."""
+        if self.fault is not None and self.fault.on_frame_sent():
+            return                    # injected silence (drop mode)
         if dst in self._dead_peers:
             debug_verbose(3, "comm", "rank %d: dropping frame for dead "
                           "peer %d", self.rank, dst)
@@ -474,6 +657,8 @@ class SocketCommEngine(CommEngine):
         thread's _send_frame/_flush_sends; with two ranks symmetrically
         direct-sending large frames, both receive loops would stop
         draining and the ranks deadlock."""
+        if self.fault is not None and self.fault.on_frame_sent():
+            return                # injected silence (drop mode)
         if dst in self._dead_peers:
             return                # drop before paying the encode
         parts, nbytes = self._encode_parts(tag, msg)
@@ -551,6 +736,9 @@ class SocketCommEngine(CommEngine):
                     s.recv(4096)      # drain wakeup tokens
                 except (BlockingIOError, OSError):
                     pass
+                continue
+            if peer == _LISTEN_PEER:
+                self._accept_rejoin(s)
                 continue
             n += self._recv_ready(peer, s)
         return n
@@ -761,6 +949,50 @@ class SocketCommEngine(CommEngine):
         exc = ConnectionError(
             f"rank {self.rank}: peer rank {peer} died ({why})")
         doomed = self._sweep_peer_inflight(peer, exc)
+        # elastic recovery: re-open the wireup listener so a
+        # replacement rank can adopt the dead slot (comm.rejoin)
+        if not self._silenced and str(mca_param.cached_get(
+                "comm.rejoin", 0)).lower() not in ("0", "off", "false"):
+            self._open_rejoin_listener()
+        # in-flight termdet waves this rank coordinates can never hear
+        # from the dead peer — shrink them to the live set (a partial
+        # wave can only FAIL to terminate, never falsely terminate:
+        # sent == received still has to hold globally)
+        for name, ws in list(self._waves.items()):
+            if peer in ws.live and peer not in ws.replied:
+                ws.live.discard(peer)
+                ws.pending -= 1
+                if ws.pending == 0:
+                    self._finish_wave(name, ws)
+        # barrier entries of the now-failed generation are NOT
+        # reclaimed: waiters wake locally (above) and re-enter under
+        # the next generation; the stale per-generation count can never
+        # release a later barrier (release/entry are generation-tagged).
+        # But entries for the NEW generation may already be complete
+        # (entrants that detected this death first) — re-check.
+        self._maybe_release_barrier()
+        #
+        # recovery exchanges in flight: ABORT them everywhere — local
+        # waiters now, remote ones via an error result. Completing with
+        # a shrunken contributor set would hand ranks that have not yet
+        # detected this death a success whose completed-set omits the
+        # dead rank's record, and their replay plan would diverge from
+        # the ranks that restart with the larger dead set.
+        with self._rejoin_lock:
+            rfuts = list(self._recover_futs.values())
+            self._recover_futs.clear()
+        for fut in rfuts:
+            if not fut.is_ready():
+                fut.set(("error", f"peer rank {peer} died mid-exchange"))
+        for token, st in list(self._recover_state.items()):
+            if st["want"] is not None and peer in st["want"]:
+                del self._recover_state[token]
+                for r in st["want"]:
+                    if r != peer:
+                        self.send_am(AMTag.RECOVER, r,
+                                     {"op": "result", "token": token,
+                                      "error": f"rank {peer} died "
+                                               f"mid-exchange"})
         # release a barrier this rank is blocked in (the dead peer can
         # never enter it) — sync() re-raises _peer_failure
         self._peer_failure = exc
@@ -1434,15 +1666,38 @@ class SocketCommEngine(CommEngine):
         monitor._termdet_name = name
         self._termdet_monitors[name] = monitor
 
+    def _live_ranks(self) -> List[int]:
+        """Every rank not known dead (self included) — the participant
+        set of waves, barriers and recovery exchanges after a failure.
+        The full mesh when nothing died."""
+        return [r for r in range(self.nb_ranks)
+                if r == self.rank or r not in self._dead_peers]
+
+    def _td_coordinator(self) -> int:
+        """Wave/barrier coordinator: the lowest LIVE rank (rank 0
+        unless it died — survivor-side continuation must not wedge on a
+        dead coordinator)."""
+        return self._live_ranks()[0]
+
     def start_termdet_wave(self, monitor) -> None:
-        """Fourcounter wave, rank 0 coordinating (the reference builds the
-        wave over its own AM tag, termdet/fourcounter)."""
+        """Fourcounter wave, the lowest live rank coordinating (the
+        reference builds the wave over its own AM tag,
+        termdet/fourcounter)."""
         name = getattr(monitor, "_termdet_name", None)
         if name is None:
             monitor.wave_result(0, 1, False)
             return
-        self.send_am(AMTag.TERMDET_FOURCOUNTER, 0,
+        self.send_am(AMTag.TERMDET_FOURCOUNTER, self._td_coordinator(),
                      {"op": "request", "name": name})
+
+    def _finish_wave(self, name: str, ws: _WaveState) -> None:
+        if self._waves.get(name) is ws:
+            del self._waves[name]
+        for r in ws.live:
+            self.send_am(AMTag.TERMDET_FOURCOUNTER, r,
+                         {"op": "result", "name": name,
+                          "sent": ws.sent, "received": ws.received,
+                          "idle": ws.all_idle})
 
     def _on_termdet(self, src: int, msg: Dict) -> None:
         op = msg["op"]
@@ -1451,9 +1706,9 @@ class SocketCommEngine(CommEngine):
             if name in self._waves:
                 return                           # wave already in flight
             self._wave_next_id += 1
-            ws = _WaveState(name, self._wave_next_id, self.nb_ranks)
+            ws = _WaveState(name, self._wave_next_id, self._live_ranks())
             self._waves[name] = ws
-            for r in range(self.nb_ranks):
+            for r in sorted(ws.live):
                 self.send_am(AMTag.TERMDET_FOURCOUNTER, r,
                              {"op": "query", "name": name,
                               "wave_id": ws.wave_id})
@@ -1463,25 +1718,22 @@ class SocketCommEngine(CommEngine):
                 sent, received, idle = 0, 0, False
             else:
                 sent, received, idle = mon.local_wave_contribution()
-            self.send_am(AMTag.TERMDET_FOURCOUNTER, 0,
+            self.send_am(AMTag.TERMDET_FOURCOUNTER, src,
                          {"op": "reply", "name": name,
                           "wave_id": msg["wave_id"], "sent": sent,
                           "received": received, "idle": idle})
         elif op == "reply":                      # coordinator: collect
             ws = self._waves.get(name)
-            if ws is None or ws.wave_id != msg["wave_id"]:
+            if ws is None or ws.wave_id != msg["wave_id"] or \
+                    src in ws.replied:
                 return
+            ws.replied.add(src)
             ws.sent += msg["sent"]
             ws.received += msg["received"]
             ws.all_idle = ws.all_idle and msg["idle"]
             ws.pending -= 1
             if ws.pending == 0:
-                del self._waves[name]
-                for r in range(self.nb_ranks):
-                    self.send_am(AMTag.TERMDET_FOURCOUNTER, r,
-                                 {"op": "result", "name": name,
-                                  "sent": ws.sent, "received": ws.received,
-                                  "idle": ws.all_idle})
+                self._finish_wave(name, ws)
         elif op == "result":                     # everyone: apply
             mon = self._termdet_monitors.get(name)
             if mon is not None:
@@ -1517,7 +1769,9 @@ class SocketCommEngine(CommEngine):
             if self._peer_failure is not None:
                 # a dead peer can never enter the barrier — fail fast
                 raise ConnectionError(str(self._peer_failure))
-            self.send_am(AMTag.BARRIER, 0, {"op": "enter"})
+            self._barrier_gen = len(self._dead_peers)
+            self.send_am(AMTag.BARRIER, self._td_coordinator(),
+                         {"op": "enter", "gen": self._barrier_gen})
             released = self._barrier_release.wait(timeout=60.0)
             if self._peer_failure is not None:   # checked first: a peer
                 raise ConnectionError(           # death IS the timeout's
@@ -1528,18 +1782,95 @@ class SocketCommEngine(CommEngine):
             self._barrier_waiting = False
 
     def _on_barrier(self, src: int, msg: Dict) -> None:
-        # comm-thread only (all handlers are)
-        if msg["op"] == "enter":                 # rank 0 collects
-            self._barrier_count += 1
-            if self._barrier_count == self.nb_ranks:
-                self._barrier_count = 0
-                for r in range(self.nb_ranks):
-                    self.send_am(AMTag.BARRIER, r, {"op": "release"})
-        else:
+        # comm-thread only (all handlers are); the collector is the
+        # lowest live rank and the quorum is the LIVE set of the
+        # CURRENT generation — a shrunk mesh still synchronizes
+        # (post-recovery collectives) while a pre-failure barrier's
+        # abandoned entries stay quarantined in their own generation
+        if msg["op"] == "enter":
+            g = msg.get("gen", 0)
+            self._barrier_counts[g] = self._barrier_counts.get(g, 0) + 1
+            self._maybe_release_barrier()
+        elif msg.get("gen", 0) == self._barrier_gen:
             self._barrier_release.set()
+
+    def _maybe_release_barrier(self) -> None:
+        """Release the current-generation barrier when its live quorum
+        is in (comm thread; also re-checked when a death advances the
+        generation this rank would collect for)."""
+        g = len(self._dead_peers)
+        if self._barrier_counts.get(g, 0) >= len(self._live_ranks()):
+            self._barrier_counts[g] = 0
+            for r in self._live_ranks():
+                self.send_am(AMTag.BARRIER, r,
+                             {"op": "release", "gen": g})
 
     def peer_alive(self, rank: int) -> bool:
         return rank not in self._dead_peers
+
+    # ------------------------------------------------- recovery exchange
+    def recover_exchange(self, token: str, payload: Any, dead_ranks,
+                         timeout: float = 60.0) -> Dict[int, Any]:
+        """Allgather ``payload`` across the live rank set (everyone
+        minus ``dead_ranks``): the completed-set exchange survivors run
+        before planning a replay. All live ranks must call with the
+        SAME token and dead set; the lowest live rank coordinates. A
+        further peer death mid-exchange fails every waiter promptly —
+        the caller restarts recovery with the larger dead set."""
+        if self.nb_ranks <= 1:
+            return {self.rank: payload}
+        from ..core.future import Future
+        dead = {int(r) for r in dead_ranks}
+        live = [r for r in range(self.nb_ranks) if r not in dead]
+        if self.rank not in live:
+            raise RuntimeError(f"rank {self.rank} is in the dead set")
+        fut = Future()
+        with self._rejoin_lock:
+            if token in self._recover_futs:
+                raise RuntimeError(f"recovery exchange {token!r} "
+                                   f"already in flight")
+            self._recover_futs[token] = fut
+        self.send_am(AMTag.RECOVER, live[0],
+                     {"op": "contrib", "token": token,
+                      "rank": self.rank, "want": live, "data": payload})
+        try:
+            status, value = fut.get(timeout=timeout)
+        finally:
+            with self._rejoin_lock:
+                self._recover_futs.pop(token, None)
+        if status != "ok":
+            raise ConnectionError(
+                f"recovery exchange {token!r} failed: {value}")
+        return value
+
+    def _on_recover(self, src: int, msg: Dict) -> None:
+        # comm-thread only (all handlers are)
+        token = msg["token"]
+        if msg["op"] == "contrib":
+            st = self._recover_state.setdefault(
+                token, {"got": {}, "want": None})
+            st["got"][msg["rank"]] = msg["data"]
+            if st["want"] is None:
+                st["want"] = set(msg["want"])
+            self._maybe_finish_recover(token, st)
+            return
+        with self._rejoin_lock:
+            fut = self._recover_futs.get(token)
+        if fut is not None and not fut.is_ready():
+            if "error" in msg:
+                fut.set(("error", msg["error"]))
+            else:
+                fut.set(("ok", msg["data"]))
+
+    def _maybe_finish_recover(self, token: str, st: Dict) -> None:
+        want = st["want"]
+        if want is None or not set(st["got"]) >= want:
+            return
+        del self._recover_state[token]
+        data = {r: st["got"][r] for r in sorted(want)}
+        for r in sorted(want):
+            self.send_am(AMTag.RECOVER, r,
+                         {"op": "result", "token": token, "data": data})
 
     def wire_stats(self) -> Dict[str, int]:
         """Frame-level wire counters (header+payload bytes on the socket);

@@ -61,6 +61,22 @@ mca_param.register("vpmap", "flat",
 mca_param.register("profiling.dot", "",
                    help="capture the executed DAG to this .dot file at "
                         "fini (--dot flag, parsec.c:589-607 analog)")
+mca_param.register("runtime.lineage", 1,
+                   help="record (class, coords) of every completed task "
+                        "on its taskpool (Taskpool.completed_tasks) — "
+                        "the survivors' lineage input for fault "
+                        "recovery (data/recovery.py); 0 disables")
+mca_param.register("runtime.ckpt_interval", 0,
+                   help="checkpoint the registered collections every N "
+                        "completed taskpools at quiesce points (see "
+                        "Context.enable_checkpoints); 0 = only the "
+                        "seconds-based trigger (or off)")
+mca_param.register("runtime.ckpt_interval_s", 0.0,
+                   help="also checkpoint when this many seconds passed "
+                        "since the last save, checked at quiesce "
+                        "points; 0 = only the taskpool-count trigger")
+mca_param.register("runtime.ckpt_dir", "",
+                   help="default directory for Context.enable_checkpoints")
 
 
 class ExecutionStream:
@@ -142,6 +158,14 @@ class Context:
         self.stage_timers = str(mca_param.get(
             "runtime.stage_timers", 0)).lower() not in ("0", "off",
                                                         "false", "")
+        # lineage record for fault recovery (runtime.lineage)
+        self._track_completed = str(mca_param.get(
+            "runtime.lineage", 1)).lower() not in ("0", "off", "false")
+        # deterministic failure injection: tick task units on the
+        # victim rank (comm.fault_inject_unit = tasks)
+        self._fault = getattr(comm, "fault", None)
+        # periodic async checkpoints (enable_checkpoints): None = off
+        self._ckpt = None
 
         self.devices = device_mod.Registry(self)
         self.pins = pins_mod.PinsManager(self)
@@ -316,6 +340,10 @@ class Context:
 
     def fini(self) -> None:
         """parsec_fini analog: drain and stop the workers."""
+        if self._ckpt is not None:
+            # let an in-flight async save land — a torn final step would
+            # be discarded by the atomic protocol, but the work is paid
+            self._ckpt.wait(timeout=30.0)
         with self._lock:
             self._shutdown = True
         self._work_evt.set()
@@ -385,11 +413,58 @@ class Context:
                 pass
             if tp.error is not None and tp not in self._aborted:
                 self._aborted.append(tp)
+            quiesced = not self._active_taskpools
             self._cv.notify_all()
         if self.hbm is not None:
             # entries whose collection died with its taskpool: free the
             # accounting, skip the pointless spill
             self.hbm.sweep(_hbm_entry_dead)
+        if quiesced and tp.error is None and self._ckpt is not None:
+            self._ckpt.quiesce_point()
+
+    # ------------------------------------------------- async checkpoints
+    def enable_checkpoints(self, collections: Dict[str, object],
+                           directory: Optional[str] = None,
+                           interval: Optional[int] = None,
+                           interval_s: Optional[float] = None):
+        """Register ``collections`` (``{name: DataCollection}``) for
+        periodic asynchronous checkpoints: at each QUIESCE point (the
+        last active taskpool terminating cleanly — all state lives in
+        the collections, the model data/checkpoint.py documents), if
+        ``interval`` completed taskpools or ``interval_s`` seconds have
+        passed since the last save, this rank's local tile references
+        are captured synchronously (write_tile replaces references, so
+        the captured cut is consistent) and serialized to disk on a
+        background saver thread with the Orbax-style atomic-rename
+        protocol. Defaults come from ``runtime.ckpt_interval``/
+        ``runtime.ckpt_interval_s``/``runtime.ckpt_dir``. Returns the
+        underlying :class:`~parsec_tpu.data.checkpoint.CheckpointManager`.
+        """
+        from ..data.checkpoint import CheckpointManager
+        directory = directory or str(mca_param.get("runtime.ckpt_dir", ""))
+        if not directory:
+            raise ValueError("enable_checkpoints: no directory (argument "
+                             "or runtime.ckpt_dir)")
+        if interval is None:
+            interval = int(mca_param.get("runtime.ckpt_interval", 0))
+        if interval_s is None:
+            interval_s = float(mca_param.get("runtime.ckpt_interval_s",
+                                             0.0))
+        mgr = CheckpointManager(directory, my_rank=self.my_rank,
+                                nb_ranks=self.nb_ranks)
+        self._ckpt = _CkptState(mgr, dict(collections), interval,
+                                interval_s)
+        return mgr
+
+    def checkpoint_wait(self, timeout: Optional[float] = None) -> bool:
+        """Join the in-flight background checkpoint save, if any (tests
+        and pre-shutdown flushes). True when no save is pending."""
+        return self._ckpt.wait(timeout) if self._ckpt is not None else True
+
+    def checkpoint_now(self) -> Optional[str]:
+        """Force a synchronous checkpoint of the registered collections
+        (caller guarantees quiesce). Returns the step directory."""
+        return self._ckpt.save_now() if self._ckpt is not None else None
 
     # --------------------------------------------------------- worker loop
     def _worker_main(self, es: ExecutionStream) -> None:
@@ -621,6 +696,12 @@ class Context:
                     for target_rank, refs in rank_refs.items():
                         self.comm.remote_dep_activate_multi(
                             task, target_rank, refs)
+        if self._track_completed:
+            # lineage record: survivors report these after a peer death
+            # so replay recomputes only the unfinished sub-DAG
+            tp.completed_tasks.add((tc.name, tuple(task.locals)))
+        if self._fault is not None:
+            self._fault.on_task_complete()   # injected failure point
         if tc.on_complete is not None:
             tc.on_complete(task)
         if task.on_complete is not None:
@@ -650,6 +731,121 @@ class Context:
         # live-object count that drives GC pressure in startup bursts.
         # The reference's mempool.c amortizes C malloc, which CPython's
         # refcounting already covers. Native-path tasks use pmempool_*.
+
+
+class _SnapshotCollection:
+    """A frozen (key → value-reference) cut of one collection, captured
+    synchronously at a quiesce point; quacks enough like a
+    DataCollection for CheckpointManager.save to serialize it from the
+    background saver thread."""
+
+    def __init__(self, items: Dict):
+        self._items = items
+
+    def keys(self):
+        return list(self._items)
+
+    def is_local(self, _key) -> bool:
+        return True         # pre-filtered at capture
+
+    def data_of(self, key):
+        return self._items[key]
+
+
+class _CkptState:
+    """Per-context periodic-checkpoint driver (Context.enable_checkpoints).
+
+    Reference capture is synchronous (cheap: ``write_tile`` REPLACES
+    tile references rather than mutating arrays, so holding the old
+    references is a consistent cut even while the next taskpool runs);
+    serialization runs on a daemon saver thread using the atomic-rename
+    protocol, so a crash mid-save never corrupts the latest durable
+    step. If the saver is still busy at the next due point the save is
+    skipped with a warning (the async saver falling behind must not
+    stall the runtime)."""
+
+    def __init__(self, mgr, collections: Dict, interval: int,
+                 interval_s: float, keep: int = 2):
+        self.mgr = mgr
+        self.collections = collections
+        self.interval = int(interval)
+        self.interval_s = float(interval_s)
+        self.keep = keep
+        self.pools_done = 0
+        self._last_pools = 0
+        self._last_t = time.monotonic()
+        self.saves = 0
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def _capture(self) -> Dict[str, _SnapshotCollection]:
+        snap = {}
+        for name, dc in self.collections.items():
+            items = {}
+            for key in dc.keys():
+                if hasattr(dc, "is_local") and not dc.is_local(key):
+                    continue
+                val = dc.data_of(key)
+                if val is not None:
+                    items[key] = val
+            snap[name] = _SnapshotCollection(items)
+        return snap
+
+    def _save(self, step: int, snap: Dict) -> Optional[str]:
+        try:
+            path = self.mgr.save(step, snap,
+                                 meta={"pools_done": step})
+            self.saves += 1
+            if self.keep:
+                self.mgr.prune(keep=self.keep)
+            return path
+        except Exception as exc:  # noqa: BLE001 — saver must not kill
+            warning("checkpoint", "async save of step %d failed: %s",
+                    step, exc)
+            return None
+
+    def quiesce_point(self) -> None:
+        with self._lock:
+            self.pools_done += 1
+            due = (self.interval > 0 and
+                   self.pools_done - self._last_pools >= self.interval)
+            if not due and self.interval_s > 0:
+                due = time.monotonic() - self._last_t >= self.interval_s
+            if not due:
+                return
+            if self._thread is not None and self._thread.is_alive():
+                warning("checkpoint", "saver still writing step at "
+                        "quiesce %d — skipping this interval",
+                        self.pools_done)
+                return
+            step = self.pools_done
+            snap = self._capture()       # synchronous: consistent cut
+            self._last_pools = self.pools_done
+            self._last_t = time.monotonic()
+            t = threading.Thread(target=self._save, args=(step, snap),
+                                 name="parsec-ckpt", daemon=True)
+            self._thread = t
+            t.start()
+
+    def save_now(self) -> Optional[str]:
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join()
+        with self._lock:
+            step = max(self.pools_done, 1)
+            snap = self._capture()
+            self._last_pools = self.pools_done
+            self._last_t = time.monotonic()
+        return self._save(step, snap)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        with self._lock:
+            t = self._thread
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
 
 
 def _hbm_entry_dead(_key, entry) -> bool:

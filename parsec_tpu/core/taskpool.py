@@ -337,6 +337,12 @@ class Taskpool:
         self.error: Optional[BaseException] = None
         self._complete_evt = threading.Event()
         self.priority = 0
+        # lineage record: (class name, locals) of every locally-completed
+        # task (runtime.lineage) — after a peer death the survivors'
+        # union of these is the completed-set input of
+        # data.recovery.plan_recovery. GIL-atomic set.add on the release
+        # path; measured noise vs the 14.2k tasks/s baseline.
+        self.completed_tasks: set = set()
         # DSL hook: enumerate startup (no-predecessor) tasks
         self.startup_hook: Callable[["Taskpool"], List[Task]] = lambda tp: []
 
@@ -389,6 +395,13 @@ class Taskpool:
         return self.monitor.nb_tasks if self.monitor else 0
 
     def _on_terminated(self) -> None:
+        if self._complete_evt.is_set():
+            # terminated is final: an abort()ed pool's still-queued
+            # tasks keep draining, and the monitor re-fires when their
+            # counters hit zero — a refire must not re-report the pool
+            # to the context (it would poison a LATER wait, e.g. the
+            # recovery replay's, with the stale abort)
+            return
         debug_verbose(4, "taskpool", "%s terminated", self.name)
         self._complete_evt.set()
         if self.on_complete is not None:

@@ -22,5 +22,10 @@ from .data import Data, DataCopy, CoherencyState
 from .arena import Arena, ArenaDatatype, ArenaRegistry
 from .redistribute import build_redistribute_ptg, insert_redistribute_dtd
 from .checkpoint import CheckpointManager
+from .recovery import (RecoveryError, RecoveryPlan, plan_recovery,
+                       build_replay_taskpool, materialize_shadow,
+                       checkpoint_shadow_source, adopt_shard,
+                       remap_collection_ranks, shrink_remap,
+                       exchange_completed, replay_lost_work)
 from .matrix_ops import (build_apply, build_broadcast, build_map_operator,
                          build_reduce)

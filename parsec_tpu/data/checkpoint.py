@@ -27,6 +27,15 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+_RANK_RE = re.compile(r"\.rank(\d+)(?:\.|$)")
+
+
+def _rank_of_file(name: str) -> int:
+    """Numeric rank id of a per-rank checkpoint file (``meta.rank10.json``
+    → 10). Lexicographic ordering puts ``rank10`` before ``rank2``, so
+    every "pick a representative rank file" site must sort by THIS."""
+    m = _RANK_RE.search(name)
+    return int(m.group(1)) if m else -1
 
 
 def _key_to_str(key: Tuple) -> str:
@@ -81,7 +90,9 @@ class CheckpointManager:
         metas = [n for n in names if n.startswith("meta.rank")]
         if not metas or done == 0:
             return False
-        with open(os.path.join(d, sorted(metas)[0])) as fh:
+        # numeric rank order (sorted(metas)[0] would pick "rank10"
+        # before "rank2"): the representative meta is the lowest RANK's
+        with open(os.path.join(d, min(metas, key=_rank_of_file))) as fh:
             expected = json.load(fh).get("nb_ranks", 1)
         return done >= expected
 
@@ -150,11 +161,15 @@ class CheckpointManager:
         shutil.rmtree(tmp, ignore_errors=True)
 
     # ----------------------------------------------------------- restore
-    def restore(self, step: int, collections: Dict[str, Any]) -> Dict:
+    def restore(self, step: int, collections: Dict[str, Any],
+                only_rank: Optional[int] = None) -> Dict:
         """Write the saved tiles of ``step`` back into ``collections``
         (every rank file present is applied — a single-process resume of
-        a multi-rank checkpoint sees all tiles). Returns the saved meta
-        dict."""
+        a multi-rank checkpoint sees all tiles). ``only_rank`` restricts
+        the restore to the files one rank saved — the shard-adoption
+        path: a replacement rank adopts a dead rank's tiles without
+        pulling every other rank's shard through its memory. Returns the
+        saved meta dict."""
         d = self._step_dir(step)
         if not os.path.isdir(d):
             raise FileNotFoundError(f"no checkpoint step {step} in "
@@ -165,9 +180,12 @@ class CheckpointManager:
                 f"mid-save); pick an earlier step")
         for name, dc in collections.items():
             found = False
-            for fname in sorted(os.listdir(d)):
+            for fname in sorted(os.listdir(d), key=_rank_of_file):
                 if not (fname.startswith(name + ".rank") and
                         fname.endswith(".npz")):
+                    continue
+                if only_rank is not None and \
+                        _rank_of_file(fname) != only_rank:
                     continue
                 found = True
                 with np.load(os.path.join(d, fname)) as data:
@@ -185,12 +203,25 @@ class CheckpointManager:
         if not os.path.exists(meta_path):
             ranks = [f for f in os.listdir(d)
                      if f.startswith("meta.rank")]
-            meta_path = os.path.join(d, sorted(ranks)[0])
+            # numeric rank order: sorted()[0] would hand back rank10's
+            # meta on a 12-rank step instead of the lowest rank's
+            meta_path = os.path.join(d, min(ranks, key=_rank_of_file))
         with open(meta_path) as fh:
             return json.load(fh)["meta"]
 
     # ------------------------------------------------------------- prune
     def prune(self, keep: int = 2) -> None:
-        """Delete all but the newest ``keep`` steps."""
-        for step in self.steps()[:-keep if keep else None]:
+        """Delete all but the newest ``keep`` complete steps.
+
+        Retention contract: ``keep`` must be >= 1 — the latest durable
+        step is the recovery anchor and pruning may never delete it
+        (``keep=0`` used to silently delete EVERY step via the
+        ``[:-0]`` → ``[:None]`` slice; it now raises). Incomplete steps
+        (another rank mid-save, or a crash) are never touched: deleting
+        a step a peer is still merging into would corrupt its save."""
+        if keep < 1:
+            raise ValueError(
+                f"prune(keep={keep}): at least the latest checkpoint "
+                f"step must be retained (keep >= 1)")
+        for step in self.steps()[:-keep]:
             shutil.rmtree(self._step_dir(step), ignore_errors=True)

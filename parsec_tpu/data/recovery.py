@@ -1,0 +1,703 @@
+"""Lineage-based tile recovery and sub-DAG replay.
+
+The reference PaRSEC has no checkpoint/restart or elasticity (SURVEY §5)
+— a dead rank kills the job via MPI's default error handler. This module
+closes the detect→recover loop instead: owner-computes over closed-form
+PTG flow specs means every lost tile has a *recomputable producer* — the
+insight behind lineage recovery in Spark RDDs (Zaharia et al., NSDI'12)
+— and the materialized instance DAG (:mod:`parsec_tpu.analysis.model`)
+is exactly the lineage graph.
+
+Model of the world after a failure:
+
+- every collection tile owned by a dead rank is LOST (its current value
+  is gone with the process);
+- every surviving rank's tiles hold whatever the partial execution left
+  in them — versions identified by how many of the tile's (dependency-
+  ordered) terminal writers completed;
+- values in flight task→task died with the aborted taskpool;
+- each survivor knows exactly which of its local tasks completed
+  (``Taskpool.completed_tasks``); the dead rank's completion record is
+  lost, so ALL of its tasks are conservatively treated as not-run.
+
+:func:`plan_recovery` walks the instance DAG backwards from the lost
+state to the *minimal affected sub-DAG*: every task that never completed,
+every writer of a lost tile, plus the backward closure of producers whose
+output values cannot be rematerialized from a surviving tile at the right
+version (a completed producer whose flow value was terminally written to
+a surviving, current tile is a CUT POINT — replay reads the tile instead
+of re-running the producer). :func:`build_replay_taskpool` then emits a
+fresh PTG taskpool that runs exactly that sub-DAG, sourcing cut inputs
+from surviving tiles (remote ones through the one-sided tile-fetch path)
+and version-0 inputs from a *shadow* snapshot (the latest complete
+:class:`~parsec_tpu.data.checkpoint.CheckpointManager` step, or re-loaded
+input data) — so replay never restarts the whole DAG from scratch.
+
+Survivor-side continuation follows the ULFM model (Bland et al.,
+EuroMPI'12): the rank set either *shrinks* (a survivor adopts the dead
+rank's shard via :func:`remap_collection_ranks` + :func:`adopt_shard`) or
+a replacement rank *rejoins* (``SocketCommEngine(..., rejoin=True)``)
+and adopts the dead rank's slot and 2D-block-cyclic shard.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..analysis.model import Model, _norm, _tile_key, build_model
+from .collection import LocalCollection
+
+TaskKey = Tuple[str, Tuple]           # (class name, coords)
+TileKey = Tuple[str, Tuple]           # (collection label, key)
+
+
+class RecoveryError(RuntimeError):
+    """The failure is not recoverable by sub-DAG replay (non-PTG
+    classes, truncated model, unordered writers, reshape deps, ...) —
+    the caller should fall back to a full restart from the latest
+    checkpoint."""
+
+
+@dataclass
+class RecoveryPlan:
+    """The minimal affected sub-DAG and how to feed it.
+
+    ``input_mode`` maps ``(class, coords, flow)`` of every replayed
+    instance to how that flow's input is sourced in the replay pool:
+
+    - ``("src",)`` — from its (replayed) producer, through normal
+      dataflow;
+    - ``("tile", label, key, "live")`` — rematerialized from the
+      surviving collection tile (a lineage cut point);
+    - ``("tile", label, key, "shadow")`` — from the version-0 shadow
+      snapshot (checkpoint / re-loaded input);
+    - ``("new",)`` — the original ``In(new=...)`` constructor;
+    - ``None`` — no active input (the original guard, or a dropped CTL
+      edge from a completed, non-replayed producer).
+    """
+
+    taskpool_name: str
+    dead_ranks: FrozenSet[int]
+    replay: Dict[str, List[Tuple]]              # class -> sorted coords
+    replay_index: Set[TaskKey]
+    input_mode: Dict[Tuple[str, Tuple, str], Optional[Tuple]]
+    shadow_tiles: Set[TileKey]
+    lost_tiles: Dict[str, Set[Tuple]]           # label -> keys
+    collections: Dict[str, Any] = field(default_factory=dict, repr=False)
+    replayed_tasks: int = 0
+    total_tasks: int = 0
+
+    @property
+    def lost_work_fraction(self) -> float:
+        return self.replayed_tasks / max(self.total_tasks, 1)
+
+
+def _node_key(m: Model, n: int) -> TaskKey:
+    node = m.nodes[n]
+    return (node.tc.name, node.coords)
+
+
+def plan_recovery(tp, dead_ranks, completed, max_tasks: int = 0
+                  ) -> RecoveryPlan:
+    """Compute the minimal replay sub-DAG of ``tp`` after ``dead_ranks``
+    died mid-execution.
+
+    ``completed``: the union of every SURVIVOR's
+    ``Taskpool.completed_tasks`` (see :func:`exchange_completed`) —
+    ``(class_name, coords)`` pairs. The dead ranks' completion records
+    are lost; their tasks are conservatively replayed in full.
+
+    The plan is a pure function of (flow specs, dead set, completed
+    set), so every rank computes an identical plan from the allgathered
+    inputs — no plan coordination message is needed.
+    """
+    from ..dsl.ptg import taskpool_uses_reshape
+    m = build_model(tp, max_tasks=max_tasks or 1_000_000)
+    if m.skipped_classes:
+        raise RecoveryError(
+            f"taskpool {tp.name}: non-PTG task classes "
+            f"{m.skipped_classes} have no closed-form lineage")
+    if m.truncated:
+        raise RecoveryError(f"taskpool {tp.name}: instance DAG "
+                            f"enumeration truncated — cannot plan replay")
+    if taskpool_uses_reshape(tp):
+        raise RecoveryError(
+            f"taskpool {tp.name}: reshape deps are not replayable "
+            f"(cut values would skip the conversion chain)")
+    order, on_cycle = m.topo_order()
+    if on_cycle:
+        raise RecoveryError(f"taskpool {tp.name}: dependency cycle")
+    pos = {n: i for i, n in enumerate(order)}
+    g = tp.g
+    dead = frozenset(int(r) for r in dead_ranks)
+    nb_nodes = len(m.nodes)
+    completed_keys = {(c, tuple(p)) for (c, p) in completed}
+
+    dead_nodes = set()
+    for n in range(nb_nodes):
+        node = m.nodes[n]
+        if node.tc.affinity_rank(node.coords) in dead:
+            dead_nodes.add(n)
+    # a dead rank's completion record died with it — distrust it even
+    # if the caller's set mentions its tasks
+    completed_nodes = {n for n in range(nb_nodes)
+                       if n not in dead_nodes
+                       and _node_key(m, n) in completed_keys}
+
+    # ---- tile geography --------------------------------------------------
+    all_tiles = set(m.writes) | set(m.reads)
+    lost: Set[TileKey] = set()
+    for tk in all_tiles:
+        dc = m.collections.get(tk[0])
+        if dc is not None and dc.rank_of(tk[1]) in dead:
+            lost.add(tk)
+
+    # dependency-ordered writer chain per tile (the lint's WAW check
+    # guarantees consecutive writers are ordered on clean pools)
+    writers: Dict[TileKey, List[int]] = {}
+    for tk, accs in m.writes.items():
+        ws = sorted({a.node for a in accs}, key=pos.get)
+        for a, b in zip(ws, ws[1:]):
+            if not m.reaches(a, b):
+                raise RecoveryError(
+                    f"tile {tk}: writers {m.nodes[a].label} and "
+                    f"{m.nodes[b].label} are unordered (WAW hazard) — "
+                    f"tile versions are schedule-dependent")
+        writers[tk] = ws
+
+    # current version of each SURVIVING tile = #completed writers; the
+    # completed writers must form a dependency prefix, else (or when any
+    # writer sat on a dead rank) the version is unknowable → rebuild
+    rebuilt: Set[TileKey] = set()       # survivors to rewrite from v0
+    cur_version: Dict[TileKey, int] = {}
+    for tk, ws in writers.items():
+        if tk in lost:
+            continue
+        flags = [w in completed_nodes for w in ws]
+        k = sum(flags)
+        if any(w in dead_nodes for w in ws) or \
+                flags != [True] * k + [False] * (len(ws) - k):
+            rebuilt.add(tk)
+        else:
+            cur_version[tk] = k
+    for tk in all_tiles:
+        cur_version.setdefault(tk, 0)
+
+    def version_before(n: int, tk: TileKey) -> int:
+        """How many writers of ``tk`` are dependency-ordered before
+        ``n`` — the tile version a read by ``n`` observes."""
+        return sum(1 for w in writers.get(tk, ())
+                   if w != n and m.reaches(w, n))
+
+    # ---- phase 1: grow the replay set to its least fixpoint --------------
+    R: Set[int] = set()
+    work: List[int] = []
+
+    def add(n: int) -> None:
+        if n in R:
+            return
+        R.add(n)
+        work.append(n)
+        # a COMPLETED writer re-running rewinds its tile to an earlier
+        # version — every later writer must re-run too or the final
+        # value regresses: rebuild the whole tile
+        if n in completed_nodes:
+            for tk in m.node_writes.get(n, ()):
+                if tk not in lost:
+                    rebuilt.add(tk)
+                for w in writers.get(tk, ()):
+                    add(w)
+
+    for n in range(nb_nodes):
+        if n in dead_nodes or n not in completed_nodes:
+            add(n)
+    for tk in lost:
+        for w in writers.get(tk, ()):
+            add(w)
+    for tk in list(rebuilt):
+        for w in writers.get(tk, ()):
+            add(w)
+
+    def producer_cut_tile(pi: int, src_flow: str) -> Optional[TileKey]:
+        """The surviving tile holding producer ``pi``'s ``src_flow``
+        value at the CURRENT version, or None when the value is not
+        rematerializable (no active terminal write / tile lost or
+        rebuilt / overwritten by a later completed writer)."""
+        node = m.nodes[pi]
+        for spec in node.tc.spec_list:
+            if spec.name != src_flow:
+                continue
+            for d in spec.outs:
+                if d.data is None or not d.active(g, node.coords):
+                    continue
+                dc, key = d.data(g, *node.coords)
+                tk = _tile_key(dc, key)
+                if tk in lost or tk in rebuilt:
+                    continue
+                ws = writers.get(tk, ())
+                v = cur_version.get(tk, 0)
+                if v >= 1 and v <= len(ws) and ws[v - 1] == pi:
+                    return tk
+        return None
+
+    def process(n: int) -> None:
+        """Apply the growth rules to one replay-set member: pull
+        producers whose value cannot be rematerialized, rebuild tiles
+        read at a version that is neither current nor input state."""
+        node = m.nodes[n]
+        tc, p = node.tc, node.coords
+        for spec in tc.spec_list:
+            dep = tc._active_in(g, spec, p)
+            if dep is None or dep.new is not None or dep.gather:
+                continue
+            if dep.data is not None:
+                dc, key = dep.data(g, *p)
+                tk = _tile_key(dc, key)
+                if tk in lost or tk in rebuilt:
+                    continue
+                v = version_before(n, tk)
+                cur = cur_version.get(tk, 0)
+                if v != cur and v != 0:
+                    # mid-chain version neither current nor input state:
+                    # rebuild the tile from v0 (its completed writers
+                    # join the replay through add()'s rebuild rule)
+                    rebuilt.add(tk)
+                    for w in writers.get(tk, ()):
+                        add(w)
+                continue
+            cls, fn, src_flow = dep.src
+            pi = m.index.get((cls, _norm(fn(g, *p))))
+            if pi is None:
+                raise RecoveryError(
+                    f"{node.label}.{spec.name}: producer instance "
+                    f"missing (phantom target)")
+            if pi not in R and producer_cut_tile(pi, src_flow) is None:
+                add(pi)     # value not rematerializable — recompute it
+
+    # the worklist re-examines every added node; growing ``rebuilt`` can
+    # invalidate a cut decided earlier, so sweep the whole set until no
+    # rule fires (monotone → least fixpoint, order-independent)
+    while work:
+        while work:
+            process(work.pop())
+        for n in sorted(R):
+            process(n)
+
+    # ---- phase 2: assign input modes from the final replay set -----------
+    input_mode: Dict[Tuple[str, Tuple, str], Optional[Tuple]] = {}
+    shadow_tiles: Set[TileKey] = set()
+    live_reads: List[Tuple[int, TileKey, int]] = []
+
+    def tile_mode(n: int, tk: TileKey) -> Tuple:
+        v = version_before(n, tk)
+        if tk in lost or tk in rebuilt:
+            if v == 0:
+                shadow_tiles.add(tk)
+                return ("tile", tk[0], tk[1], "shadow")
+            live_reads.append((n, tk, v))
+            return ("tile", tk[0], tk[1], "live")
+        cur = cur_version.get(tk, 0)
+        if v == cur:
+            live_reads.append((n, tk, v))
+            return ("tile", tk[0], tk[1], "live")
+        if v == 0:
+            shadow_tiles.add(tk)
+            return ("tile", tk[0], tk[1], "shadow")
+        raise AssertionError(
+            f"unsourced tile read {tk} v={v} cur={cur}")   # phase 1 bug
+
+    for n in sorted(R):
+        node = m.nodes[n]
+        tc, p = node.tc, node.coords
+        for spec in tc.spec_list:
+            fk = (tc.name, p, spec.name)
+            dep = tc._active_in(g, spec, p)
+            if dep is None:
+                input_mode[fk] = None
+            elif dep.new is not None:
+                input_mode[fk] = ("new",)
+            elif dep.data is not None:
+                dc, key = dep.data(g, *p)
+                input_mode[fk] = tile_mode(n, _tile_key(dc, key))
+            elif dep.gather:
+                input_mode[fk] = ("src",)   # producers filtered at build
+            else:
+                cls, fn, src_flow = dep.src
+                pi = m.index[(cls, _norm(fn(g, *p)))]
+                if pi in R:
+                    input_mode[fk] = ("src",)
+                else:
+                    tk = producer_cut_tile(pi, src_flow)
+                    assert tk is not None        # phase 1 invariant
+                    live_reads.append((n, tk, cur_version[tk]))
+                    input_mode[fk] = ("tile", tk[0], tk[1], "live")
+
+    # ---- safety: live (non-shadow) tile reads must be ordered within
+    # the REPLAY DAG — before every replayed writer that advances the
+    # tile past the read version (WAR), and after every replayed writer
+    # the read version depends on (RAW through a rebuilt tile). Shadow
+    # reads are immune: the shadow is an immutable snapshot. Build the
+    # replay adjacency from the assigned modes and check reachability.
+    radj: Dict[int, List[int]] = {n: [] for n in R}
+    for n in sorted(R):
+        node = m.nodes[n]
+        for spec in node.tc.spec_list:
+            fk = (node.tc.name, node.coords, spec.name)
+            mm = input_mode.get(fk)
+            if mm != ("src",):
+                continue
+            dep = node.tc._active_in(g, spec, node.coords)
+            if dep is None or dep.src is None:
+                continue
+            targets = dep.src[1](g, *node.coords)
+            if not dep.gather:
+                targets = [targets]
+            elif isinstance(targets, tuple):
+                targets = [targets]
+            for tgt in targets:
+                pi = m.index.get((dep.src[0], _norm(tgt)))
+                if pi is not None and pi in R:
+                    radj[pi].append(n)
+
+    _rmemo: Dict[int, Set[int]] = {}
+
+    def rreaches(a: int, b: int) -> bool:
+        desc = _rmemo.get(a)
+        if desc is None:
+            desc = set()
+            stack = list(radj.get(a, ()))
+            while stack:
+                u = stack.pop()
+                if u in desc:
+                    continue
+                desc.add(u)
+                stack.extend(radj.get(u, ()))
+            _rmemo[a] = desc
+        return b in desc
+
+    for (n, tk, v) in live_reads:
+        ws = writers.get(tk, ())
+        for w in ws[v:]:
+            if w != n and w in R and not rreaches(n, w):
+                raise RecoveryError(
+                    f"replayed writer {m.nodes[w].label} of tile {tk} "
+                    f"is unordered with surviving-value reader "
+                    f"{m.nodes[n].label} in the replay DAG (WAR) — "
+                    f"fall back to a full checkpoint restart")
+        for w in ws[:v]:
+            if w != n and w in R and not rreaches(w, n):
+                raise RecoveryError(
+                    f"reader {m.nodes[n].label} of tile {tk} needs "
+                    f"version {v} but replayed writer "
+                    f"{m.nodes[w].label} is unordered with it in the "
+                    f"replay DAG (RAW) — fall back to a full "
+                    f"checkpoint restart")
+
+    replay: Dict[str, List[Tuple]] = {}
+    for n in sorted(R, key=lambda x: (m.nodes[x].tc.name,
+                                      m.nodes[x].coords)):
+        node = m.nodes[n]
+        replay.setdefault(node.tc.name, []).append(node.coords)
+    lost_by_label: Dict[str, Set[Tuple]] = {}
+    for (label, key) in lost:
+        lost_by_label.setdefault(label, set()).add(key)
+    return RecoveryPlan(
+        taskpool_name=tp.name, dead_ranks=dead,
+        replay=replay,
+        replay_index={_node_key(m, n) for n in R},
+        input_mode=input_mode, shadow_tiles=shadow_tiles,
+        lost_tiles=lost_by_label, collections=dict(m.collections),
+        replayed_tasks=len(R), total_tasks=nb_nodes)
+
+
+# ---------------------------------------------------------------- replay
+
+
+def build_replay_taskpool(tp, plan: RecoveryPlan,
+                          shadow: Optional[Dict[str, Any]] = None,
+                          name: Optional[str] = None):
+    """Emit the replay taskpool for ``plan``: the replayed instances of
+    every class of ``tp``, with producer edges restricted to the replay
+    set, cut inputs rematerialized from surviving tiles (remote ones
+    through the owner's one-sided tile fetch) and version-0 inputs read
+    from ``shadow`` (label → collection, see :func:`materialize_shadow`).
+    Bodies, priorities and terminal writes are the original ones —
+    deterministic bodies make the replayed results bitwise-identical.
+    """
+    from ..dsl import ptg
+
+    shadow = shadow or {}
+    rtp = ptg.Taskpool(name or f"{tp.name}@replay", **vars(tp.g))
+    mode_table = plan.input_mode
+    replay_index = plan.replay_index
+
+    def _norm_c(c):
+        return tuple(c) if isinstance(c, (tuple, list)) else (c,)
+
+    def _resolve_tile(label: str, key: Tuple, where: str):
+        if where == "shadow":
+            sdc = shadow.get(label)
+            if sdc is None:
+                raise RecoveryError(
+                    f"replay of {tp.name} needs a shadow (checkpoint / "
+                    f"input) source for collection {label!r}")
+            val = sdc.data_of(tuple(key))
+            if val is None:
+                raise RecoveryError(
+                    f"shadow for {label!r} has no tile {key}")
+            return val
+        dc = plan.collections[label]
+        ctx = rtp.context
+        if ctx is not None and ctx.nb_ranks > 1:
+            owner = dc.rank_of(key)
+            if owner != ctx.my_rank:
+                # surviving value on another rank: one-sided fetch under
+                # the replay pool's scope; ordering is guaranteed by the
+                # plan (the read version is current NOW and every
+                # replayed writer of the tile depends on this reader)
+                return ctx.comm.fetch_tile(dc, key, owner, scope=rtp.name)
+        return dc.data_of(tuple(key))
+
+    for tc in tp.task_classes:
+        insts = tuple(plan.replay.get(tc.name, ()))
+        cname = tc.name
+        specs2 = []
+        for s in tc.spec_list:
+            fname = s.name
+            ins2: List[ptg.In] = []
+            for d in s.ins:
+                if d.src is not None and d.gather:
+                    def _filt_src(g, *p, _fn=d.src[1], _cls=d.src[0]):
+                        out = _fn(g, *p)
+                        if isinstance(out, tuple):
+                            out = [out]
+                        return [c for c in out
+                                if (_cls, _norm_c(c)) in replay_index]
+                    ins2.append(ptg.In(src=(d.src[0], _filt_src,
+                                            d.src[2]),
+                                       guard=d.guard, gather=True))
+                elif d.src is not None:
+                    def _g_src(g, *p, _d=d, _c=cname, _f=fname):
+                        return _d.active(g, p) and \
+                            mode_table.get((_c, tuple(p), _f)) == ("src",)
+                    ins2.append(ptg.In(src=d.src, guard=_g_src))
+                elif d.new is not None:
+                    def _g_new(g, *p, _d=d, _c=cname, _f=fname):
+                        return _d.active(g, p) and \
+                            mode_table.get((_c, tuple(p), _f)) == ("new",)
+                    ins2.append(ptg.In(new=d.new, guard=_g_new))
+                # data-type ins are replaced by the resolver below
+            def _g_tile(g, *p, _c=cname, _f=fname):
+                mm = mode_table.get((_c, tuple(p), _f))
+                return isinstance(mm, tuple) and mm[0] == "tile"
+
+            def _new_tile(g, *p, _c=cname, _f=fname):
+                _m, label, key, where = mode_table[(_c, tuple(p), _f)]
+                return _resolve_tile(label, key, where)
+            ins2.append(ptg.In(new=_new_tile, guard=_g_tile))
+
+            outs2: List[ptg.Out] = []
+            for d in s.outs:
+                if d.data is not None:
+                    outs2.append(ptg.Out(data=d.data, guard=d.guard))
+                    continue
+                dcls, dfn, dflow = d.dst
+                def _filt_dst(g, *p, _fn=dfn, _cls=dcls, _df=dflow):
+                    out = _fn(g, *p)
+                    if isinstance(out, tuple):
+                        out = [out]
+                    return [c for c in out
+                            if (_cls, _norm_c(c)) in replay_index
+                            and mode_table.get(
+                                (_cls, _norm_c(c), _df)) == ("src",)]
+                outs2.append(ptg.Out(dst=(dcls, _filt_dst, dflow),
+                                     guard=d.guard))
+            specs2.append(ptg.FlowSpec(fname, s.access, ins=ins2,
+                                       outs=outs2, tile=s.tile))
+
+        new_tc = rtp.task_class(
+            cname, params=tc.params,
+            space=lambda g, _s=insts: iter(_s),
+            flows=specs2, affinity=tc.affinity)
+        # vtable pieces the builder signature doesn't carry: the bodies
+        # (incarnations) and the already-bound priority/on_complete
+        new_tc.incarnations = list(tc.incarnations)
+        new_tc.priority_fn = tc.priority_fn
+        new_tc.time_estimate = tc.time_estimate
+        new_tc.on_complete = tc.on_complete
+        new_tc.properties = dict(tc.properties)
+    return rtp
+
+
+# ------------------------------------------------------- shadow sources
+
+
+def materialize_shadow(plan: RecoveryPlan,
+                       source: Callable[[str, Tuple], Any]
+                       ) -> Dict[str, Any]:
+    """Build the shadow (version-0 / input-state) tile store the replay
+    pool reads from: one immutable local collection per collection
+    label, holding exactly ``plan.shadow_tiles``. ``source`` is
+    ``(label, key) -> value`` — typically
+    :func:`checkpoint_shadow_source` or an input (re-)loader."""
+    out: Dict[str, Any] = {}
+    for (label, key) in sorted(plan.shadow_tiles):
+        sdc = out.get(label)
+        if sdc is None:
+            sdc = out[label] = LocalCollection(f"{label}@shadow")
+        sdc.write_tile(tuple(key), source(label, key))
+    return out
+
+
+def checkpoint_shadow_source(mgr, step: int, collections: Dict[str, Any]
+                             ) -> Callable[[str, Tuple], Any]:
+    """Shadow source backed by checkpoint ``step``: restores every
+    rank's files for ``collections`` (``{dc.name: dc}``) into a private
+    store once and serves tiles from it. The live collections are
+    untouched — surviving current-version tiles keep their values."""
+    store = {name: LocalCollection(f"{name}@ckpt")
+             for name in collections}
+    mgr.restore(step, store)
+    missing = object()
+
+    def src(label: str, key: Tuple):
+        sdc = store.get(label)
+        val = sdc.data_of(tuple(key)) if sdc is not None else missing
+        if val is None or val is missing:
+            raise RecoveryError(
+                f"checkpoint step {step} has no tile {key} of "
+                f"collection {label!r}")
+        return val
+    return src
+
+
+def adopt_shard(collections: Dict[str, Any], ranks,
+                source: Callable[[str, Tuple], Any],
+                my_rank: Optional[int] = None) -> int:
+    """Restore into the live ``collections`` (``{label: dc}``) every
+    tile owned by ``ranks`` — the shard-adoption step of a replacement
+    (or shrink-mode adopter) rank. With ``my_rank`` given, only tiles
+    the remapped distribution places on that rank are written (each
+    rank adopts its own share). Returns the number of adopted tiles."""
+    ranks = set(int(r) for r in ranks)
+    n = 0
+    for label, dc in sorted(collections.items()):
+        for key in dc.keys():
+            k = tuple(key) if isinstance(key, (tuple, list)) else (key,)
+            if my_rank is not None and dc.rank_of(k) != my_rank:
+                continue
+            if _pre_remap_rank(dc, k) in ranks:
+                dc.write_tile(k, source(label, k))
+                n += 1
+    return n
+
+
+# ----------------------------------------------------- rank remapping
+
+
+def remap_collection_ranks(dc, remap: Dict[int, int]):
+    """Shrink-mode ownership transfer: wrap ``dc.rank_of`` so tiles of
+    a dead rank resolve to their adopter. Must be applied with the SAME
+    remap on EVERY rank (placement is computed independently per rank
+    from rank_of). Idempotent per collection: re-remapping composes on
+    the original."""
+    orig = getattr(dc, "_pre_remap_rank_of", None) or dc.rank_of
+    dc._pre_remap_rank_of = orig
+    full = dict(getattr(dc, "_rank_remap", {}))
+    full.update({int(k): int(v) for k, v in remap.items()})
+    dc._rank_remap = full
+
+    def rank_of(key, _orig=orig, _map=full):
+        r = _orig(key)
+        return _map.get(r, r)
+    dc.rank_of = rank_of
+    return dc
+
+
+def _pre_remap_rank(dc, key) -> int:
+    """The owner a tile had BEFORE any shrink remap — lost-tile identity
+    is defined by the ORIGINAL distribution."""
+    orig = getattr(dc, "_pre_remap_rank_of", None)
+    return orig(key) if orig is not None else dc.rank_of(key)
+
+
+def shrink_remap(nb_ranks: int, dead_ranks) -> Dict[int, int]:
+    """Deterministic adopter assignment for shrink-mode recovery: dead
+    rank i's shard goes to the i-th live rank round-robin — every rank
+    computes the same map locally."""
+    dead = sorted(set(int(r) for r in dead_ranks))
+    live = [r for r in range(nb_ranks) if r not in dead]
+    if not live:
+        raise RecoveryError("no surviving ranks")
+    return {d: live[i % len(live)] for i, d in enumerate(dead)}
+
+
+# ------------------------------------------------ one-call recovery
+
+
+def replay_lost_work(ctx, tp, dead_ranks, source, shrink: bool = True,
+                     adopt: Optional[Dict[str, Any]] = None,
+                     name: Optional[str] = None,
+                     token: Optional[str] = None):
+    """Survivor-side recovery in one call, after ``tp`` aborted because
+    ``dead_ranks`` died: allgather the completed-task records across the
+    live ranks, plan the minimal replay sub-DAG, remap the dead shard to
+    a survivor (``shrink=True``, ULFM-shrink) or keep the original
+    placement for an admitted replacement rank (``shrink=False``,
+    rejoin), restore adopted/lost input tiles from ``source`` (``adopt``
+    = ``{label: collection}``), materialize the shadow snapshot, and
+    register the replay taskpool. Every live rank must make the same
+    call with the same arguments; the caller then waits on the context.
+    Returns ``(replay_taskpool, plan)``."""
+    comm = ctx.comm
+    # in rejoin mode the dead SLOT is live again (the replacement
+    # participates in the exchange, contributing an empty record)
+    exchange_dead = dead_ranks if shrink else ()
+    completed = exchange_completed(comm, tp, exchange_dead, token=token)
+    plan = plan_recovery(tp, dead_ranks, completed)
+    if shrink and ctx.nb_ranks > 1:
+        remap = shrink_remap(ctx.nb_ranks, dead_ranks)
+        for label in sorted(plan.collections):
+            remap_collection_ranks(plan.collections[label], remap)
+    if adopt:
+        adopt_shard(adopt, dead_ranks, source,
+                    my_rank=ctx.my_rank if ctx.nb_ranks > 1 else None)
+    shadow = materialize_shadow(plan, source)
+    rtp = build_replay_taskpool(tp, plan, shadow=shadow, name=name)
+    if comm is not None and ctx.nb_ranks > 1:
+        comm.acknowledge_failure()
+        # expose BEFORE the barrier: a fast rank's replay startup may
+        # cut-fetch from this rank the moment its own pool registers
+        for label in sorted(plan.collections):
+            dc = plan.collections[label]
+            if getattr(dc, "name", None):
+                comm.expose_collection(dc, scope=rtp.name)
+        comm.sync()
+    ctx.add_taskpool(rtp)
+    return rtp, plan
+
+
+# ------------------------------------------------- completed exchange
+
+
+def exchange_completed(comm, tp, dead_ranks, token: Optional[str] = None
+                       ) -> Set[TaskKey]:
+    """Union the survivors' completed-task records (the lineage input of
+    :func:`plan_recovery`) across the live rank set via the engine's
+    recovery exchange. Single-rank / no-comm contexts return the local
+    record directly."""
+    local = {(c, tuple(p)) for (c, p) in tp.completed_tasks}
+    if comm is None or comm.nb_ranks <= 1:
+        return local
+    # the default token carries the dead set: a retried recovery (a
+    # second death failed the first exchange) must not collide with the
+    # failed round's coordinator state or its late result frames
+    dead_tag = "-".join(str(r) for r in sorted(set(dead_ranks)))
+    results = comm.recover_exchange(
+        token or f"completed:{tp.name}:{dead_tag}", sorted(local),
+        dead_ranks)
+    merged: Set[TaskKey] = set()
+    for _rank, items in results.items():
+        merged.update((c, tuple(p)) for (c, p) in items)
+    return merged

@@ -60,6 +60,108 @@ def test_no_partial_step_visible(tmp_path):
     assert mgr.steps() == [2]
 
 
+def test_prune_keep_zero_raises(tmp_path):
+    """keep=0 used to silently delete EVERY step ([:-0] == [:None]);
+    the retention contract now requires keep >= 1."""
+    X = LocalCollection("X", {(0,): 1})
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    for s in (1, 2, 3):
+        mgr.save(s, {"X": X})
+    with pytest.raises(ValueError, match="keep"):
+        mgr.prune(keep=0)
+    with pytest.raises(ValueError):
+        mgr.prune(keep=-1)
+    assert mgr.steps() == [1, 2, 3]          # nothing was deleted
+    mgr.prune(keep=1)
+    assert mgr.steps() == [3]
+
+
+def test_rank_files_sorted_numerically(tmp_path):
+    """rank10 sorts lexicographically before rank2 — is_complete and
+    the restore meta fallback must pick the lowest rank NUMERICALLY."""
+    d = tmp_path / "c"
+    X2 = LocalCollection("X", {(0,): np.float32(2.0)})
+    X10 = LocalCollection("X", {(1,): np.float32(10.0)})
+    m2 = CheckpointManager(str(d), my_rank=2, nb_ranks=2)
+    m10 = CheckpointManager(str(d), my_rank=10, nb_ranks=2)
+    m2.save(1, {"X": X2}, meta={"saver": 2})
+    m10.save(1, {"X": X10}, meta={"saver": 10})
+    reader = CheckpointManager(str(d), my_rank=0, nb_ranks=2)
+    assert reader.is_complete(1)
+    Y = LocalCollection("Y")
+    meta = reader.restore(1, {"X": Y})
+    # the lexicographic bug handed back rank10's meta
+    assert meta == {"saver": 2}
+    assert float(Y.data_of((0,))) == 2.0
+    assert float(Y.data_of((1,))) == 10.0
+
+
+def test_restore_only_rank(tmp_path):
+    """only_rank restores exactly one rank's shard — the replacement
+    rank's adoption path."""
+    d = tmp_path / "c"
+    for r in (0, 1):
+        X = LocalCollection("X", {(r,): np.float32(r + 1)})
+        CheckpointManager(str(d), my_rank=r, nb_ranks=2).save(
+            4, {"X": X}, meta={})
+    Y = LocalCollection("Y")
+    CheckpointManager(str(d), my_rank=1, nb_ranks=2).restore(
+        4, {"X": Y}, only_rank=1)
+    assert Y.data_of((0,)) is None
+    assert float(Y.data_of((1,))) == 2.0
+
+
+def test_jax_device_array_roundtrip(tmp_path):
+    """Collections holding jax device arrays — including one SHARDED
+    over the 8-device test mesh — must round-trip bitwise (np.asarray
+    on a sharded array is the suspect path the satellite names)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    host = np.arange(16 * 16, dtype=np.float32).reshape(16, 16)
+    plain = jnp.asarray(host + 1.0)
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("x",))
+    sharded = jax.device_put(host,
+                             NamedSharding(mesh, P("x", None)))
+    A = TiledMatrix(32, 16, 16, 16, name="A")
+    A.write_tile((0, 0), plain)
+    A.write_tile((1, 0), sharded)
+    X = LocalCollection("X", {(0,): jnp.float32(3.5)})
+    mgr = CheckpointManager(str(tmp_path / "jx"))
+    mgr.save(1, {"A": A, "X": X})
+
+    A2 = TiledMatrix(32, 16, 16, 16, name="A2")
+    X2 = LocalCollection("X2")
+    mgr.restore(1, {"A": A2, "X": X2})
+    np.testing.assert_array_equal(np.asarray(A2.data_of((0, 0))),
+                                  host + 1.0)
+    np.testing.assert_array_equal(np.asarray(A2.data_of((1, 0))), host)
+    assert float(X2.data_of((0,))) == 3.5
+
+
+def test_periodic_async_checkpoints(tmp_path, ctx):
+    """Context.enable_checkpoints: a step lands at every Nth quiesce
+    point, asynchronously, with the step carrying the post-taskpool
+    collection state."""
+    n, w = 8, 1.0 / 3.0
+    X = LocalCollection("X", {(i,): np.float32(i) for i in range(n)})
+    mgr = ctx.enable_checkpoints({"X": X},
+                                 directory=str(tmp_path / "pc"),
+                                 interval=2)
+    for _ in range(4):
+        ctx.add_taskpool(build_stencil_1d(X, n, 1, w))
+        assert ctx.wait(timeout=60)
+        assert ctx.checkpoint_wait(timeout=30)
+    assert mgr.steps() == [2, 4]
+    expect = {i: X.data_of((i,)) for i in range(n)}
+    Y = LocalCollection("Y")
+    meta = mgr.restore(4, {"X": Y})
+    assert meta == {"pools_done": 4}
+    for i in range(n):
+        assert float(Y.data_of((i,))) == float(expect[i])
+
+
 def test_resume_and_continue_stencil(tmp_path, ctx):
     """The canonical loop: run K1 sweeps, checkpoint, 'crash', resume
     into fresh collections, run K2 more — result equals an uninterrupted

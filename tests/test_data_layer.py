@@ -176,3 +176,54 @@ def test_redistribute_dtd_extent_validation(ctx):
     with pytest.raises(ValueError):
         insert_redistribute_dtd(tp, S, D, extent=(10, 2))
     tp.wait()
+
+
+def test_redistribute_dtd_many_fragments_per_tile(ctx, rng):
+    """3x3 source tiles → 10x10 destination tiles: up to 16 source
+    fragments assemble into one destination tile (the >4-fragment path
+    of extreme tile-size ratios was previously untested)."""
+    arr = rng.standard_normal((30, 30)).astype(np.float32)
+    S = TiledMatrix.from_array(arr, 3, 3, name="Sm")
+    D = TiledMatrix(30, 30, 10, 10, name="Dm")
+    tp = dtd.Taskpool(name="redist_frag")
+    ctx.add_taskpool(tp)
+    insert_redistribute_dtd(tp, S, D)
+    tp.wait()
+    np.testing.assert_array_equal(D.to_array(), arr)
+
+
+def test_redistribute_dtd_nondivisible_ratio_with_offsets(ctx, rng):
+    """Non-divisible tile-size ratio (6x6 → 4x4) combined with
+    non-zero, non-tile-aligned src/dst offsets: fragment slices must
+    land exactly despite both grids being phase-shifted."""
+    sarr = rng.standard_normal((18, 24)).astype(np.float32)
+    S = TiledMatrix.from_array(sarr, 6, 6, name="So")
+    D = TiledMatrix(20, 16, 4, 4, name="Do")
+    before = D.to_array()
+    tp = dtd.Taskpool(name="redist_off")
+    ctx.add_taskpool(tp)
+    insert_redistribute_dtd(tp, S, D, src_off=(1, 5), dst_off=(3, 2),
+                            extent=(13, 11))
+    tp.wait()
+    out = D.to_array()
+    np.testing.assert_array_equal(out[3:16, 2:13],
+                                  sarr[1:14, 5:16])
+    mask = np.ones_like(out, dtype=bool)
+    mask[3:16, 2:13] = False
+    np.testing.assert_array_equal(out[mask], before[mask])
+
+
+def test_redistribute_dtd_coarse_to_fine_offsets(ctx, rng):
+    """Fine → coarse with offsets (5x7 → 9x6, fully irregular): every
+    destination tile gathers a different, non-rectangular-count
+    fragment set."""
+    sarr = rng.standard_normal((20, 28)).astype(np.float32)
+    S = TiledMatrix.from_array(sarr, 5, 7, name="Sf")
+    D = TiledMatrix(27, 24, 9, 6, name="Df")
+    tp = dtd.Taskpool(name="redist_irr")
+    ctx.add_taskpool(tp)
+    insert_redistribute_dtd(tp, S, D, src_off=(2, 3), dst_off=(4, 1),
+                            extent=(17, 20))
+    tp.wait()
+    out = D.to_array()
+    np.testing.assert_array_equal(out[4:21, 1:21], sarr[2:19, 3:23])
