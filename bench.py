@@ -50,11 +50,23 @@ if _plat:
     import jax
     jax.config.update("jax_platforms", _plat)
 
-# Persistent XLA compile cache: the panel-fused programs compile in
-# ~100-200 s through the tunnel; cached re-compiles land in seconds.
-from parsec_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
-from parsec_tpu.utils import mca_param  # noqa: E402
-enable_compile_cache()
+# Persistent compile caches (XLA cache + the serialized-executor
+# store): the panel-fused programs compile in ~100-200 s through the
+# tunnel; XLA-cache re-compiles land in seconds, executor-store hits
+# skip trace/lower entirely. Opted in via the jit.cache_dir MCA knob
+# ("auto" → repo .xla_cache) — auto-enabled on first compiled-path use,
+# no manual enable_compile_cache() call needed. Env interaction
+# (utils/compile_cache.py): PARSEC_COMPILE_CACHE=0 is the kill switch,
+# a path in it overrides the knob's directory.
+from parsec_tpu.utils import compile_cache, mca_param  # noqa: E402
+
+
+def _enable_serving_caches(cache_dir: str = "auto") -> None:
+    """Called from every bench entry point (main / --section children /
+    --amort-probe) — NOT at import, so importing bench for its helpers
+    (tests) never flips process-global cache state."""
+    mca_param.set("jit.cache_dir", cache_dir)
+    compile_cache.executor_store()   # resolve now: programs below hit it
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 
@@ -916,6 +928,125 @@ def _section_ptile():
                            round(2.0 * n ** 3 / comp_s / 1e9, 1)}}
 
 
+def _amort_probe_run(path: str, n: int, nb: int, cache_dir: str) -> dict:
+    """One serving process of the compile-amortization probe: build the
+    executor against ``cache_dir``, resolve every program (compile cold
+    / deserialize warm), run once, and report compile counts + seconds.
+
+    ``path="panel"``: the flagship config (left-looking POTRF,
+    trsm_hook=gemm) through the SEGMENTED panel executor —
+    ``start_to_first_flop_s`` is plan + lower + prepare_segments(), the
+    serving-readiness latency the compile-once work targets.
+    ``path="wavefront"``: right-looking POTRF through
+    ``run_tile_dict_segmented`` (per-tile bucketed segments).
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from parsec_tpu.algorithms.potrf import build_potrf, build_potrf_left
+    from parsec_tpu.compiled.panels import PanelExecutor
+    from parsec_tpu.compiled.wavefront import (WavefrontExecutor,
+                                               plan_taskpool)
+    from parsec_tpu.data.matrix import TiledMatrix
+
+    _enable_serving_caches(cache_dir)
+    mca_param.set("potrf.trsm_hook", "gemm")   # flagship config
+    compile_cache.backend_compile_count()      # install counter
+    out = {"path": path, "n": n, "nb": nb}
+
+    if path == "panel":
+        # device-side state BEFORE t0: input generation is the caller's
+        # cost, not the serving path's
+        key = jax.random.PRNGKey(0)
+        R = jax.random.normal(key, (n, n), jnp.float32)
+        state = {"A": R.at[jnp.arange(n), jnp.arange(n)].add(2.0 * n)}
+        jax.block_until_ready(state["A"])
+        c0 = compile_cache.backend_compile_count()
+        s0 = compile_cache.cache_stats()
+        t0 = time.perf_counter()
+        A = TiledMatrix(n, n, nb, nb, name="A")
+        ex = PanelExecutor(plan_taskpool(build_potrf_left(A)))
+        out["n_programs"] = ex.prepare_segments()
+        t_ready = time.perf_counter()
+        res = ex.run_state_segmented(state)
+        jax.block_until_ready(res["A"])
+        t_done = time.perf_counter()
+        out["start_to_first_flop_s"] = round(t_ready - t0, 3)
+        out["run_s"] = round(t_done - t_ready, 3)
+    else:
+        rng = np.random.default_rng(0)
+        R = rng.standard_normal((n, n)).astype(np.float32)
+        host = (0.01 * (R + R.T) + n * np.eye(n, dtype=np.float32))
+        c0 = compile_cache.backend_compile_count()
+        s0 = compile_cache.cache_stats()
+        t0 = time.perf_counter()
+        A = TiledMatrix.from_array(host, nb, nb, name="A")
+        ex = WavefrontExecutor(plan_taskpool(build_potrf(A)))
+        tiles = ex.run_tile_dict_segmented(ex.make_tiles())
+        jax.block_until_ready(list(tiles.values())[0])
+        t_done = time.perf_counter()
+        out["n_programs"] = len(ex._segments)
+        out["start_to_first_flop_s"] = None   # segments compile lazily
+        out["run_s"] = round(t_done - t0, 3)
+    s1 = compile_cache.cache_stats()
+    out["xla_compiles"] = compile_cache.backend_compile_count() - c0
+    out["store_hits"] = s1["store_hits"] - s0["store_hits"]
+    out["store_misses"] = s1["store_misses"] - s0["store_misses"]
+    return out
+
+
+def _amort_child(path: str, n: int, nb: int, cache_dir: str) -> dict:
+    """Run one probe in a FRESH subprocess (cross-process warmness is
+    the claim under test — in-process jit caches must not help)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--amort-probe",
+         path, str(n), str(nb), cache_dir],
+        capture_output=True, text=True, timeout=3000, cwd=_HERE)
+    line = next((ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("PROBE_RESULT ")), None)
+    if line is None:
+        raise RuntimeError(f"probe rc={proc.returncode}: "
+                           f"{proc.stderr[-300:]}")
+    return json.loads(line[len("PROBE_RESULT "):])
+
+
+def _section_compile_amortization():
+    """Compile-once economics of the serving path, measured the way a
+    serving fleet hits it — every probe a fresh process against one
+    shared cache dir (fresh temp dir, so `cold` is honestly cold):
+
+    - cold:    first process ever at (N1, NB) — pays every compile
+    - warm:    second process, same size — must pay ZERO XLA compiles
+    - new_n:   first process at a NEW N2, same (NB, dtype) — heavy
+               bucketed kernels hit, only thin per-N windows compile
+    - new_n_2: second process at N2 — ZERO again
+
+    for the panel-fused flagship config and the wavefront segmented
+    path. The warm/new_n_2 compile counts and the warm
+    start-to-first-FLOP ride the rise-guard."""
+    import tempfile
+    import jax
+    on_tpu = jax.default_backend() == "tpu"
+    d = tempfile.mkdtemp(prefix="parsec_amort_")
+    if on_tpu:
+        pn1, pn2, pnb = 40960, 32768, 1024     # the flagship size
+        wn1, wn2, wnb = 8192, 6144, 512
+    else:
+        pn1, pn2, pnb = 512, 448, 64
+        wn1, wn2, wnb = 256, 320, 64
+    rows = {"cache_dir": d}
+    for tag, path, (n1, n2, nb) in (
+            ("panel", "panel", (pn1, pn2, pnb)),
+            ("wavefront", "wavefront", (wn1, wn2, wnb))):
+        r = {}
+        r["cold"] = _amort_child(path, n1, nb, d)
+        r["warm"] = _amort_child(path, n1, nb, d)
+        r["new_n"] = _amort_child(path, n2, nb, d)
+        r["new_n_2"] = _amort_child(path, n2, nb, d)
+        rows[tag] = r
+    return {"compile_amortization": rows}
+
+
 def _section_recovery():
     """8-rank kill-and-recover (ISSUE 6): a multi-epoch halo-sweep job
     with periodic async checkpoints; a deterministic injected fault
@@ -940,6 +1071,7 @@ SECTIONS = {
     "taskrate": _section_taskrate,
     "bcast": _section_bcast,
     "recovery": _section_recovery,
+    "compile_amortization": _section_compile_amortization,
 }
 
 # result keys each section produces — failures are recorded under these
@@ -955,12 +1087,16 @@ _SECTION_KEYS = {
     "taskrate": ("taskrate",),
     "bcast": ("bcast",),
     "recovery": ("recovery",),
+    "compile_amortization": ("compile_amortization",),
 }
 
 # geqrf stacks three programs (per-tile stress + 94-wave fused + the
 # highest-precision variant) — give it compile headroom on a cold
 # cache; getrf now stacks two (gemm headline + solve variant)
-_SECTION_TIMEOUT = {"geqrf": 3600, "getrf": 3600}
+# compile_amortization runs 8 fresh serving processes (4 panel-flagship
+# + 4 wavefront), the first of which pays the full cold compile
+_SECTION_TIMEOUT = {"geqrf": 3600, "getrf": 3600,
+                    "compile_amortization": 7200}
 
 
 def _run_section(name):
@@ -1021,7 +1157,14 @@ _LATENCY_GUARD_KEYS = ("eager_1k_p50_us", "rdv_1M_p50_us",
                        # (lost-work ppm) is a regression that must
                        # fail loudly, not drift
                        "recovery_time_to_recover_ms",
-                       "recovery_lost_work_ppm")
+                       "recovery_lost_work_ppm",
+                       # compile-once serving: warm processes must stay
+                       # at ZERO XLA compiles and the warm
+                       # start-to-first-FLOP must not creep back up
+                       "amort_panel_warm_compiles",
+                       "amort_panel_new_n_2_compiles",
+                       "amort_panel_warm_start_s",
+                       "amort_wf_warm_compiles")
 
 
 def _flatten_summary(summary: dict) -> dict:
@@ -1156,6 +1299,12 @@ def _compact_summary(result):
         v = x.get(sec, {})
         return v.get(key) if isinstance(v, dict) else None
 
+    def pick2(sec, *keys):
+        v = x.get(sec, {})
+        for k in keys:
+            v = v.get(k) if isinstance(v, dict) else None
+        return v
+
     compact = {
         "metric": result["metric"],
         "value": result["value"],
@@ -1203,6 +1352,24 @@ def _compact_summary(result):
                 if isinstance(pick("recovery", "lost_work_fraction"),
                               (int, float)) else None),
             "recovery_bitwise_check": pick("recovery", "bitwise_check"),
+            "amort_panel_cold_compiles": pick2(
+                "compile_amortization", "panel", "cold", "xla_compiles"),
+            "amort_panel_cold_start_s": pick2(
+                "compile_amortization", "panel", "cold",
+                "start_to_first_flop_s"),
+            "amort_panel_warm_compiles": pick2(
+                "compile_amortization", "panel", "warm", "xla_compiles"),
+            "amort_panel_warm_start_s": pick2(
+                "compile_amortization", "panel", "warm",
+                "start_to_first_flop_s"),
+            "amort_panel_new_n_compiles": pick2(
+                "compile_amortization", "panel", "new_n", "xla_compiles"),
+            "amort_panel_new_n_2_compiles": pick2(
+                "compile_amortization", "panel", "new_n_2",
+                "xla_compiles"),
+            "amort_wf_warm_compiles": pick2(
+                "compile_amortization", "wavefront", "warm",
+                "xla_compiles"),
             "full_detail": "BENCH_DETAIL.json",
         },
     }
@@ -1287,7 +1454,28 @@ def main():
         out = ex.run_state(state)
         return jnp.sum(out["A"]), out
 
-    red = jax.jit(run, donate_argnums=0)
+    # the flagship monolith enters the serialized-executor store keyed
+    # by (plan structure, fuser code, shapes, trace knobs): a warm
+    # process (round N+1, or any serving restart) deserializes instead
+    # of paying the 20-70 s trace+lower+XLA-cache-lookup — compile_s
+    # below records whichever happened; cache_stats tell them apart
+    mkey = ex.monolith_cache_key()
+    cc0 = compile_cache.cache_stats()
+    t0 = time.perf_counter()
+    if mkey is not None:
+        red = compile_cache.cached_jit(
+            run, key=("bench_flagship", mkey),
+            example_args=({"A": jax.ShapeDtypeStruct(
+                (N, N), jnp.float32)},),
+            donate_argnums=0)
+    else:
+        red = jax.jit(run, donate_argnums=0)
+    aot_s = time.perf_counter() - t0
+    cc1 = compile_cache.cache_stats()
+    flagship_cache = {
+        "aot_s": round(aot_s, 2),
+        "store_hit": cc1["store_hits"] > cc0["store_hits"],
+        "store_miss": cc1["store_misses"] > cc0["store_misses"]}
 
     lat_f = jax.jit(lambda x: x + 1.0)
     float(lat_f(jnp.float32(0)))
@@ -1295,7 +1483,7 @@ def main():
     t0 = time.perf_counter()
     tot, out = red(gen_j(jax.random.PRNGKey(0)))
     float(tot)
-    compile_s = time.perf_counter() - t0
+    compile_s = aot_s + time.perf_counter() - t0
     del out
 
     # CH chained passes per sample: one pass is ~0.21 s, within reach of
@@ -1496,7 +1684,8 @@ def main():
     extras = {}
     if os.environ.get("PARSEC_BENCH_EXTRAS", "1") != "0":
         for name in ("hostdtd", "ptile", "gemm", "flash", "geqrf",
-                     "getrf", "ooc", "taskrate", "bcast", "recovery"):
+                     "getrf", "ooc", "taskrate", "bcast", "recovery",
+                     "compile_amortization"):
             extras.update(_run_section(name))
         # host-vs-compiled ratio: both rows fresh in their own child
         try:
@@ -1525,6 +1714,7 @@ def main():
             "target_gflops_65pct_peak": round(target, 2),
             "plan_s": round(plan_s, 2),
             "compile_s": round(compile_s, 2),
+            "flagship_compile_cache": flagship_cache,
             "run_s": round(dt, 4),
             "link_latency_s": round(lat, 4),
             "rel_residual_check": float(f"{err:.3e}"),
@@ -1744,9 +1934,18 @@ def render_parity():
 
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--section":
+        _enable_serving_caches()
         name = sys.argv[2]
         print("SECTION_RESULT " + json.dumps(SECTIONS[name]()))
+    elif len(sys.argv) >= 6 and sys.argv[1] == "--amort-probe":
+        # compile_amortization child: one serving process against a
+        # given cache dir (cold = empty dir, warm = populated)
+        path, n, nb, cache_dir = (sys.argv[2], int(sys.argv[3]),
+                                  int(sys.argv[4]), sys.argv[5])
+        print("PROBE_RESULT " +
+              json.dumps(_amort_probe_run(path, n, nb, cache_dir)))
     elif len(sys.argv) >= 2 and sys.argv[1] == "--parity":
         render_parity()
     else:
+        _enable_serving_caches()
         main()

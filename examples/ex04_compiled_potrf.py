@@ -20,6 +20,12 @@ from parsec_tpu.algorithms import build_potrf
 from parsec_tpu.algorithms.potrf import potrf_flops
 from parsec_tpu.compiled import WavefrontExecutor, plan_taskpool
 from parsec_tpu.data import TiledMatrix
+from parsec_tpu.utils import mca_param
+
+# Compile-once serving: persistent compile caches on (see ex06 /
+# README "Compile-once serving"); a re-run of this example deserializes
+# instead of re-compiling the whole-DAG program.
+mca_param.set("jit.cache_dir", "auto")
 
 
 def main():

@@ -9,15 +9,18 @@ Two builders, matching the reference's two front ends:
 
 from __future__ import annotations
 
+from ..compiled.panels import (SegRead, SegStep, SegWrite, bucket_tiles,
+                               register_panel_kernel)
 from ..dsl import dtd, ptg
 from ..data.matrix import TiledMatrix
 from ..ops.tile_kernels import gemm_tile
-from ..utils import mca_param
+from ..utils import compile_cache, mca_param
 
 mca_param.register(
     "gemm.k_block", 0,
     help="panel-fused GEMM: consecutive k-waves fused into one deep "
          "matmul (0 = the whole k range; 1 = per-wave rank-nb updates)")
+compile_cache.register_trace_knob("gemm.k_block")
 
 
 def build_gemm_ptg(A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
@@ -60,6 +63,7 @@ def build_gemm_ptg(A: TiledMatrix, B: TiledMatrix, C: TiledMatrix,
         return gemm_tile(C_, A_, B_, alpha=_alpha, beta=_beta)
 
     tp.wave_fuser = _make_gemm_wave_fuser(alpha, beta)
+    tp.panel_segment_fuser = _make_gemm_segment_fuser(alpha, beta)
     return tp
 
 
@@ -132,6 +136,80 @@ def _make_gemm_wave_fuser(alpha: float, beta: float):
             return st
 
         return do_kblock
+
+    return fuser
+
+
+@register_panel_kernel("gemm.kblock")
+def _seg_kblock_kernel(in_sds, static):
+    """(Bs (NC,Kb), At (Kb,MC), Ct (NC,MC), w (Kb,), α (), β^nblk ())
+    → αΒsᵂ·At + β^nblk·Ct. The contraction extent is bucketed —
+    extraction zero-masks past the true k-block, so padded lanes add
+    exact zeros; α/β/w ride as traced inputs, keeping ONE kernel per
+    (C shape, contraction bucket, dtype) reused across every k-block
+    of every run at those shapes."""
+    del in_sds, static
+    import jax.numpy as jnp
+    from ..ops.tile_kernels import matmul_precision
+    prec = matmul_precision()
+
+    def fn(Bs, At, Ct, w, alpha_s, beta_pow):
+        acc = jnp.matmul(Bs * w[None, :], At,
+                         preferred_element_type=jnp.float32,
+                         precision=prec)
+        return (alpha_s * acc + beta_pow * Ct).astype(Ct.dtype)
+
+    return fn
+
+
+def _make_gemm_segment_fuser(alpha: float, beta: float):
+    """Segmented (compile-once) lowering of the k-blocked panel GEMM:
+    the same math as :func:`_make_gemm_wave_fuser`, emitted as ONE
+    ``gemm.kblock`` dispatch per block head (non-head waves lower to
+    no steps) over a bucketed contraction extent."""
+
+    def fuser(wave, geoms):
+        import numpy as np
+
+        if sorted(g.tc.name for g in wave) != ["GEMM"]:
+            return None
+        (grp,) = wave
+        ks = {t[2] for t in grp.tasks}
+        if len(ks) != 1:
+            return None
+        k = ks.pop()
+        g = grp.tc.tp.g
+        ga, gb, gc = g.A.name, g.B.name, g.C.name
+        gA, gB, gC = geoms[ga], geoms[gb], geoms[gc]
+        want = {(m, n) for m in range(gC.mt) for n in range(gC.nt)}
+        if {(m, n) for (m, n, _k) in grp.tasks} != want:
+            return None
+        KT = gA.nt
+        KB = int(mca_param.get("gemm.k_block", 0)) or KT
+        if k % KB:
+            return []           # folded into its block's head wave
+        k0, k1 = k, min(k + KB, KT)
+        nblk = k1 - k0
+        bt = bucket_tiles(nblk, KT - k0)
+        NC, MC = gC.nb * gC.nt, gC.mb * gC.mt
+        w = np.ones(bt * gB.mb, np.float32)
+        if beta != 1.0 and nblk > 1:
+            w[:nblk * gB.mb] = np.repeat(
+                beta ** np.arange(nblk - 1, -1, -1, dtype=np.float32),
+                gB.mb)
+        return [SegStep(
+            kernel="gemm.kblock",
+            reads=(SegRead("state", gb, 0, k0 * gB.mb,
+                           NC, nblk * gB.mb, NC, bt * gB.mb),
+                   SegRead("state", ga, k0 * gA.nb, 0,
+                           nblk * gA.nb, MC, bt * gA.nb, MC),
+                   SegRead("state", gc, 0, 0, NC, MC, NC, MC),
+                   SegRead("const", "w", value=w),
+                   SegRead("const", "alpha",
+                           value=np.float32(alpha)),
+                   SegRead("const", "beta_pow",
+                           value=np.float32(beta ** nblk))),
+            writes=(SegWrite("state", gc, 0, 0, NC, MC),))]
 
     return fuser
 

@@ -28,7 +28,7 @@ from ..dsl import ptg
 from ..data.matrix import TiledMatrix
 from ..ops.tile_kernels import (gemm_tile, getrf_nopiv_tile,
                                 trsm_lower_unit, trsm_upper_right)
-from ..utils import mca_param
+from ..utils import compile_cache, mca_param
 
 # Compiled-path panel-TRSM kernel for the fused LU — the POTRF
 # trsm_hook ported to BOTH LU solve stages (the structural delta vs the
@@ -47,6 +47,7 @@ mca_param.register("getrf.trsm_hook", "inherit",
                         "MXU matmuls via lu_inv_tile; squares the "
                         "factors' condition-number contribution) | "
                         "inherit (follow potrf.trsm_hook)")
+compile_cache.register_trace_knob("getrf.trsm_hook")
 
 
 def _trsm_inv_mode() -> bool:

@@ -15,12 +15,14 @@ on the host runtime AND on the compiled wavefront/SPMD executors.
 
 from __future__ import annotations
 
+from ..compiled.panels import (SegRead, SegStep, SegWrite, bucket_tiles,
+                               register_panel_kernel)
 from ..dsl import ptg
 from ..data.matrix import TiledMatrix
 from ..ops.tile_kernels import (gemm_tile, potrf_tile, potrf_tile_blocked,
                                 syrk_tile, trsm_tile,
                                 trsm_tiles_gemm, trsm_tiles_wide)
-from ..utils import mca_param
+from ..utils import compile_cache, mca_param
 
 # The compiled path's batched kernels. "solve" (default) is the exact
 # wide triangular solve — reference numerics (dplasma TRSM). "gemm"
@@ -41,6 +43,10 @@ mca_param.register("potrf.trsm_hook", "solve",
 mca_param.register("potrf.blocked_tile_chol", 1,
                    help="use the matmul-rich blocked in-tile Cholesky in "
                         "the compiled path (0 = XLA cholesky)")
+# both knobs pick the kernels traced into compiled programs — every
+# shared/persistent compile-cache key must cover their values
+compile_cache.register_trace_knob("potrf.trsm_hook")
+compile_cache.register_trace_knob("potrf.blocked_tile_chol")
 
 
 def build_potrf(A: TiledMatrix) -> ptg.Taskpool:
@@ -478,6 +484,7 @@ def build_potrf_left(A: TiledMatrix) -> ptg.Taskpool:
         return {"C": trsm_tile(C, L)}
 
     tp.wave_fuser = _potrf_left_wave_fuser
+    tp.panel_segment_fuser = _potrf_left_segment_fuser
     tp.requires_fuser = True     # compiled per-tile executors can't feed
     #                              the UPDATE body's collection reads
     return tp
@@ -606,5 +613,176 @@ def _potrf_left_wave_fuser(wave, geoms):
             return st
 
         return do_trsm
+
+    return None
+
+
+# ---------------------------------------------------------------------------
+# segmented panel lowering (compile-once serving)
+# ---------------------------------------------------------------------------
+# The monolith fusers above bake k into static slices of the full Aᵀ
+# array: the whole-DAG program is specific to N and its compile time is
+# linear in waves. The segment lowering expresses the SAME math as
+# named kernels over extracted panels whose shapes are rounded up to
+# the bucket lattice (compiled.panels.bucket_tiles) — each kernel is
+# keyed by (NB, bucket, dtype, trsm_hook/chol knobs), INDEPENDENT of N,
+# so a new problem size at a served NB re-uses every compiled bucket
+# and the persistent store makes the second process compile nothing.
+# Padding is exact: extraction zero-masks past the true extents (zero
+# rows contribute nothing to the update matmul; zero RHS columns solve
+# to zero) and write-back masks to the true window.
+
+def _seg_mm():
+    import jax.numpy as jnp
+    from ..ops.tile_kernels import matmul_precision
+    prec = matmul_precision()
+
+    def mm(a, b):
+        return jnp.matmul(a, b, preferred_element_type=jnp.float32,
+                          precision=prec)
+
+    return jnp, mm
+
+
+@register_panel_kernel("potrf_left.update")
+def _seg_update_kernel(in_sds, static):
+    """(U (Kb,nb), S (Kb,Wb), Drow (nb,Wb)) → rowk = Drow − UᵀS."""
+    del in_sds, static
+    jnp, mm = _seg_mm()
+
+    def fn(U, S, Drow):
+        return Drow - mm(U.T, S)
+
+    return fn
+
+
+@register_panel_kernel("potrf_left.diag")
+def _seg_diag_kernel(in_sds, static):
+    """(rowk (nb, Wb)) → (Lᵀ write, L carry[, L⁻¹ carry]): symmetrized
+    diag chol of the panel head; the inverse carry exists only under
+    potrf.trsm_hook=gemm (key covered by the trace-knob snapshot)."""
+    del static
+    (rowk_sds,) = in_sds
+    nb = rowk_sds.shape[0]
+    jnp, mm = _seg_mm()
+    solve_mode = mca_param.get("potrf.trsm_hook", "solve") == "solve"
+
+    def tile_chol(blk):
+        if mca_param.get("potrf.blocked_tile_chol", 1):
+            return potrf_tile_blocked(blk)
+        return potrf_tile(blk)
+
+    def fn(rowk):
+        from ..ops.tile_kernels import tri_inv_tile
+        diag = rowk[:, :nb]
+        diag = 0.5 * (diag + diag.T)
+        L = tile_chol(diag.astype(jnp.float32))
+        if solve_mode:
+            return L.T.astype(rowk.dtype), L
+        return L.T.astype(rowk.dtype), L, tri_inv_tile(L)
+
+    return fn
+
+
+@register_panel_kernel("potrf_left.trsm")
+def _seg_trsm_kernel(in_sds, static):
+    """(L (nb,nb)[, inv], rest-or-rowk (nb, W)) → solved panel. static
+    ``skip``: 1 when the panel input is the rowk carry (diag in its
+    first nb columns, skipped), 0 when it is the k=0 state read."""
+    (skip,) = static
+    nb = in_sds[0].shape[0]
+    jnp, mm = _seg_mm()
+    solve_mode = mca_param.get("potrf.trsm_hook", "solve") == "solve"
+
+    if solve_mode:
+        def fn(L, panel):
+            import jax
+            rest = panel[:, nb:] if skip else panel
+            return jax.scipy.linalg.solve_triangular(
+                L.astype(jnp.float32), rest.astype(jnp.float32),
+                lower=True)
+    else:
+        def fn(L, inv, panel):
+            del L
+            rest = panel[:, nb:] if skip else panel
+            return mm(inv, rest)
+
+    return fn
+
+
+def _potrf_left_segment_fuser(wave, geoms):
+    """Lower one left-looking POTRF wave to bucketed SegSteps
+    (compiled.panels segmented contract). Wave-shape recognition is
+    identical to the monolith fuser; the emitted steps express the same
+    math over bucketed panels with masked reads/writes."""
+    (geom,) = geoms.values()      # single-collection DAG
+    names = sorted(g.tc.name for g in wave)
+    nb, NT = geom.nb, geom.nt
+    name = geom.name
+    solve_mode = mca_param.get("potrf.trsm_hook", "solve") == "solve"
+
+    def wb(tiles):               # bucketed element width of `tiles`
+        return bucket_tiles(tiles, NT) * nb
+
+    if names == ["UPDATE"]:
+        (grp,) = wave
+        ks = {t[1] for t in grp.tasks}
+        if len(ks) != 1:
+            return None
+        k = ks.pop()
+        ms = sorted(t[0] for t in grp.tasks)
+        lo, hi = ms[0], ms[-1] + 1
+        if ms != list(range(lo, hi)) or lo != k or hi != NT:
+            return None
+        r0, W = k * nb, (NT - k) * nb
+        Kb, Wb = wb(k), wb(NT - k)
+        return [SegStep(
+            kernel="potrf_left.update",
+            reads=(SegRead("state", name, 0, r0, r0, nb, Kb, nb),
+                   SegRead("state", name, 0, r0, r0, W, Kb, Wb),
+                   SegRead("state", name, r0, r0, nb, W, nb, Wb)),
+            writes=(SegWrite("carry", "_rowk"),))]
+
+    if names == ["POTRF"]:
+        (grp,) = wave
+        if len(grp.tasks) != 1:
+            return None
+        (k,) = grp.tasks[0]
+        r0 = k * nb
+        carries = (SegWrite("carry", "_L"),) if solve_mode else \
+            (SegWrite("carry", "_L"), SegWrite("carry", "_inv"))
+        if k == 0:
+            reads = (SegRead("state", name, 0, 0, nb, nb, nb, nb),)
+        else:
+            reads = (SegRead("carry", "_rowk"),)
+        return [SegStep(
+            kernel="potrf_left.diag", reads=reads,
+            writes=(SegWrite("state", name, r0, r0, nb, nb),) + carries)]
+
+    if names == ["TRSM"]:
+        (grp,) = wave
+        ks = {t[1] for t in grp.tasks}
+        if len(ks) != 1:
+            return None
+        k = ks.pop()
+        ms = sorted(t[0] for t in grp.tasks)
+        lo, hi = ms[0], ms[-1] + 1
+        if ms != list(range(lo, hi)) or lo != k + 1 or hi != NT:
+            return None
+        r0 = k * nb
+        rest_w = (NT - k - 1) * nb
+        if k == 0:
+            panel = SegRead("state", name, 0, nb, nb, rest_w,
+                            nb, wb(NT - 1))
+            skip = 0
+        else:
+            panel = SegRead("carry", "_rowk")
+            skip = 1
+        reads = (SegRead("carry", "_L"), panel) if solve_mode else \
+            (SegRead("carry", "_L"), SegRead("carry", "_inv"), panel)
+        return [SegStep(
+            kernel="potrf_left.trsm", reads=reads, static=(skip,),
+            writes=(SegWrite("state", name, r0, (k + 1) * nb,
+                             nb, rest_w),))]
 
     return None

@@ -11,10 +11,19 @@ PTG taskpool's whole DAG into XLA programs instead:
   HBM-resident tile store. Single-chip performance path.
 - :mod:`spmd`: the same wavefront plan sharded over a jax.sharding.Mesh —
   owner-computes over block-cyclic collections with XLA collectives
-  carrying inter-rank dependencies over ICI (replaces remote_dep_mpi.c).
+  carrying inter-rank dependencies over ICI (replaces remote_dep_mpi.c);
+  its :func:`~spmd.compile_with_plan` is the preferential-pjit
+  compilation front end every mesh program goes through.
+- :mod:`panels`: wave-fused dense lowering (the flagship form) with the
+  compile-once segmented path — bucketed shape-polymorphic panel
+  kernels shared across N, executors, and processes via the persistent
+  executor cache (utils/compile_cache.py).
 """
 
-from .wavefront import WavefrontPlan, plan_taskpool, WavefrontExecutor
+from .wavefront import (WavefrontPlan, plan_taskpool, WavefrontExecutor,
+                        plan_structure_fingerprint)
+from .panels import PanelExecutor, bucket_tiles
 from . import spmd
+from .spmd import compile_with_plan
 from .ring_attention import (ring_attention, ulysses_attention,
                              dense_attention)

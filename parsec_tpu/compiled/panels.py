@@ -45,17 +45,44 @@ the ``{name: PanelGeometry}`` dict; single-collection fusers unpack
 their one entry. Return None to
 reject a wave (the executor then refuses, naming it — no silent
 fallback; a hybrid would reintroduce the copies this path avoids).
+
+Compile-once serving (the segmented panel path)
+-----------------------------------------------
+
+Whole-DAG jit of the fused program is the fastest *runtime* form but
+its compile time is linear in waves and specific to N — every new
+problem size is a fresh multi-second lowering (PARITY compile-time
+table). The **segmented** path restores PaRSEC's compile-per-task-class
+economy: a taskpool may additionally register a ``panel_segment_fuser``
+that lowers each wave to :class:`SegStep` descriptors — named *panel
+kernels* over extracted panels whose shapes are rounded up to a small
+**bucket lattice** (:func:`bucket_tiles`: exact up to 16 tiles, then
+multiples of 2^(⌊log₂t⌋−3) → ≤12.5% padding per dim, O(16·log NT)
+buckets; grids of ≤16 tiles never pad at all).
+Padding is exact-by-construction: extraction zero-masks beyond the true
+extent, write-back masks to the true extent (and shifts windows clamped
+at the array edge), so padded lanes carry zeros through the math.
+
+The heavy kernels are keyed by (kernel, NB, bucket shape, dtype, body
+hooks/trace knobs) — **independent of N** — and enter the shared
+in-process jit store and the persistent executor store
+(``utils/compile_cache.py``): a new N at an already-served (NB, dtype)
+re-uses every already-compiled bucket, and a second run (or second
+process) pays zero XLA compiles. Only the thin extract/write programs
+are keyed per state shape (they are slice+mask copies, cheap to
+compile, and they persist too).
 """
 
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
-from typing import Any, Callable, Dict, List, Set, Tuple
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
-from .wavefront import WavefrontPlan
+from .wavefront import WavefrontPlan, plan_structure_fingerprint
+from ..utils import compile_cache
 from ..utils.debug import debug_verbose
 
 
@@ -78,6 +105,141 @@ class PanelGeometry:
     def cols(self, j: int) -> slice:
         """Row range of D covering block-column j of A."""
         return slice(j * self.nb, (j + 1) * self.nb)
+
+
+# ---------------------------------------------------------------------------
+# bucket lattice (segmented panel path)
+# ---------------------------------------------------------------------------
+
+def bucket_tiles(t: int, cap: int) -> int:
+    """Round a tile count up to the bucket lattice, capped at ``cap``
+    (the dimension's grid extent — buckets never exceed the store).
+
+    Lattice: exact for t ≤ 16, then multiples of 2^(⌊log₂t⌋−3)
+    ({18,20,...,32, 36,40,...,64, 72,...} — ≤16 points per octave) —
+    padding overhead ≤ 12.5% per dimension, O(16·log NT) distinct
+    buckets, and the lattice points are absolute (N-independent) so a
+    smaller problem at the same NB lands entirely on already-compiled
+    buckets (modulo its own cap point)."""
+    if t >= cap:
+        return cap
+    q = 1 << max(0, t.bit_length() - 1 - 3)
+    return min(((t + q - 1) // q) * q, cap)
+
+
+@dataclass(frozen=True, eq=False)
+class SegRead:
+    """One kernel input: a masked bucketed window of a state array
+    (``src="state"``), a carry produced by an earlier step
+    (``src="carry"``), or a lowering-time constant (``src="const"``).
+    Offsets/extents are element units; ``rows_b/cols_b`` are the
+    bucketed extents actually extracted (≥ true, zero-masked)."""
+    src: str
+    name: str
+    r0: int = 0
+    c0: int = 0
+    rows: int = 0
+    cols: int = 0
+    rows_b: int = 0
+    cols_b: int = 0
+    value: Any = None          # src="const" payload (host array/scalar)
+
+
+@dataclass(frozen=True, eq=False)
+class SegWrite:
+    """One kernel output destination: a masked window of a state array
+    (only ``[r0:r0+rows, c0:c0+cols]`` is written, whatever the padded
+    value shape) or a named carry."""
+    dst: str
+    name: str
+    r0: int = 0
+    c0: int = 0
+    rows: int = 0
+    cols: int = 0
+
+
+@dataclass(frozen=True, eq=False)
+class SegStep:
+    """One dispatch of a registered panel kernel: gather ``reads``,
+    call the kernel, route outputs to ``writes`` (position-matched).
+    ``static`` is extra kernel-builder config baked into the cache
+    key (must be canonical primitives)."""
+    kernel: str
+    reads: Tuple[SegRead, ...]
+    writes: Tuple[SegWrite, ...]
+    static: Tuple = field(default=())
+
+
+_PANEL_KERNELS: Dict[str, Callable] = {}
+
+
+def register_panel_kernel(name: str):
+    """Register a panel-kernel builder: ``builder(in_sds, static) ->
+    pure fn(*arrays) -> array | tuple``. ``in_sds`` are the (bucketed)
+    input ShapeDtypeStructs. Builders may read trace-affecting MCA
+    knobs at build time — register those via
+    :func:`~..utils.compile_cache.register_trace_knob` so the cache key
+    covers them."""
+    def deco(builder):
+        _PANEL_KERNELS[name] = builder
+        return builder
+    return deco
+
+
+def _build_extract(rows_b: int, cols_b: int, clamp_r: bool,
+                   clamp_c: bool):
+    """Masked bucketed window read: ``(D, r0, c0, rows, cols) ->
+    (rows_b, cols_b)`` with zeros beyond the true extent. When the
+    window can run off the array edge (static ``clamp_*`` decided at
+    lowering from the descriptor), the slice start is clamped and the
+    payload rolled back into place — dynamic_slice would otherwise
+    silently shift the window."""
+    def ext(D, r0, c0, rows, cols):
+        import jax.numpy as jnp
+        from jax import lax
+        ra, ca = r0, c0
+        if clamp_r:
+            ra = jnp.minimum(r0, D.shape[0] - rows_b)
+        if clamp_c:
+            ca = jnp.minimum(c0, D.shape[1] - cols_b)
+        raw = lax.dynamic_slice(D, (ra, ca), (rows_b, cols_b))
+        if clamp_r:
+            raw = jnp.roll(raw, -(r0 - ra), axis=0)
+        if clamp_c:
+            raw = jnp.roll(raw, -(c0 - ca), axis=1)
+        rmask = jnp.arange(rows_b) < rows
+        cmask = jnp.arange(cols_b) < cols
+        return jnp.where(rmask[:, None] & cmask[None, :], raw,
+                         jnp.zeros((), D.dtype))
+    return ext
+
+
+def _build_write(rows_b: int, cols_b: int, clamp_r: bool, clamp_c: bool):
+    """Masked bucketed window write: only ``[r0:r0+rows, c0:c0+cols]``
+    of D changes; padded lanes of V are discarded. D is donated — the
+    update is in-place under XLA aliasing."""
+    def wr(D, V, r0, c0, rows, cols):
+        import jax.numpy as jnp
+        from jax import lax
+        ra, ca = r0, c0
+        if clamp_r:
+            ra = jnp.minimum(r0, D.shape[0] - rows_b)
+        if clamp_c:
+            ca = jnp.minimum(c0, D.shape[1] - cols_b)
+        ro, co = r0 - ra, c0 - ca
+        cur = lax.dynamic_slice(D, (ra, ca), (rows_b, cols_b))
+        Vr = V.astype(D.dtype)
+        if clamp_r:
+            Vr = jnp.roll(Vr, ro, axis=0)
+        if clamp_c:
+            Vr = jnp.roll(Vr, co, axis=1)
+        ri = jnp.arange(rows_b)
+        ci = jnp.arange(cols_b)
+        rmask = (ri >= ro) & (ri < ro + rows)
+        cmask = (ci >= co) & (ci < co + cols)
+        blended = jnp.where(rmask[:, None] & cmask[None, :], Vr, cur)
+        return lax.dynamic_update_slice(D, blended, (ra, ca))
+    return wr
 
 
 class PanelExecutor:
@@ -138,7 +300,58 @@ class PanelExecutor:
         debug_verbose(3, "panels", "lowered %s: %d waves onto %d "
                       "transposed dense arrays", plan.taskpool.name,
                       len(self._wave_fns), len(self.geoms))
-        self.jitted = self.jax.jit(self.run_state, donate_argnums=0)
+        # segmented (compile-once) path, lowered lazily on first use
+        self._segment_fuser = getattr(plan.taskpool,
+                                      "panel_segment_fuser", None)
+        self._seg_steps: Optional[List[SegStep]] = None
+        self._jitted = None
+
+    @property
+    def supports_segments(self) -> bool:
+        return self._segment_fuser is not None
+
+    # -- whole-DAG jit (shared + persistent) ------------------------------
+    # jit caches by FUNCTION OBJECT: a fresh jax.jit(self.run_state) per
+    # executor used to re-trace (and re-lower, and re-XLA) the whole
+    # program for every rebuild of an identical plan. The monolith now
+    # routes through the shared keyed store: equal (plan structure,
+    # fuser code, shapes, trace knobs) → one trace per process and a
+    # serialized executable across processes.
+    @property
+    def jitted(self) -> Callable:
+        if self._jitted is None:
+            key = self.monolith_cache_key()
+            if key is None:      # unstable fingerprint: per-instance jit
+                self._jitted = self.jax.jit(self.run_state,
+                                            donate_argnums=0)
+            else:
+                self._jitted = compile_cache.cached_jit(
+                    self.run_state, key=key,
+                    example_args=(self.state_shapes(),),
+                    donate_argnums=0)
+        return self._jitted
+
+    def state_shapes(self) -> Dict[str, Any]:
+        """Abstract (ShapeDtypeStruct) state as :meth:`make_state`
+        builds it — the AOT lowering input."""
+        import jax
+        return {name: jax.ShapeDtypeStruct(
+            (g.nb * g.nt, g.mb * g.mt),
+            np.dtype(self.plan.collections[name].dtype))
+            for name, g in self.geoms.items()}
+
+    def monolith_cache_key(self) -> Optional[Tuple]:
+        """Semantic cache key of the whole-DAG fused program, or None
+        when some ingredient has no stable fingerprint."""
+        fuser = getattr(self.plan.taskpool, "wave_fuser", None)
+        f_ok, f_fp = compile_cache.function_fingerprint(fuser)
+        p_ok, p_fp = plan_structure_fingerprint(self.plan)
+        if not (f_ok and p_ok):
+            return None
+        shapes = tuple(sorted(
+            (name, tuple(s.shape), str(s.dtype))
+            for name, s in self.state_shapes().items()))
+        return ("panel_monolith", f_fp, p_fp, shapes)
 
     # -- pure dense execution --------------------------------------------
     def run_state(self, state: Dict[str, Any]) -> Dict[str, Any]:
@@ -177,11 +390,156 @@ class PanelExecutor:
             for (i, j) in sorted(self._written[name]):
                 dc.write_tile((i, j), host[g.cols(j), g.rows(i)].T)
 
-    def run(self, jit: bool = True) -> float:
+    # -- segmented execution (compile-once serving) -----------------------
+
+    def segments(self) -> List[SegStep]:
+        """Lower every wave through the taskpool's
+        ``panel_segment_fuser`` (lazily, cached). Raises when the
+        taskpool registers none or a wave is rejected — no silent
+        fallback to the linear-in-waves monolith."""
+        if self._seg_steps is not None:
+            return self._seg_steps
+        if self._segment_fuser is None:
+            raise ValueError(
+                f"taskpool {self.plan.taskpool.name!r} registers no "
+                "panel_segment_fuser; use the whole-DAG fused form "
+                "(run/jitted) or the tile-dict segmented executor")
+        steps: List[SegStep] = []
+        for w, wave in enumerate(self.plan.waves):
+            lowered = self._segment_fuser(wave, self.geoms)
+            if lowered is None:
+                names = [(g.tc.name, len(g.tasks)) for g in wave]
+                raise ValueError(
+                    f"wave {w} not segment-fusable by "
+                    f"{self.plan.taskpool.name!r}: {names}")
+            steps.extend(lowered)
+        self._seg_steps = steps
+        debug_verbose(3, "panels", "segment-lowered %s: %d waves -> %d "
+                      "steps", self.plan.taskpool.name,
+                      len(self.plan.waves), len(steps))
+        return steps
+
+    @staticmethod
+    def _window_fn(D_sds, val_sds, rd_or_wr, tag):
+        """Shared-cache entry for one extract/write program. Keyed by
+        (state shape, bucket shape, clamp flags) — these are the only
+        per-N programs of the segmented path (thin slice+mask copies);
+        the heavy kernels are N-independent."""
+        import jax
+        clamp_r = rd_or_wr.r0 + val_sds.shape[0] > D_sds.shape[0]
+        clamp_c = rd_or_wr.c0 + val_sds.shape[1] > D_sds.shape[1]
+        i32 = jax.ShapeDtypeStruct((), np.int32)
+        key = (tag, tuple(D_sds.shape), str(D_sds.dtype),
+               tuple(val_sds.shape), clamp_r, clamp_c)
+        if tag == "panel_write":
+            fn = _build_write(*val_sds.shape, clamp_r, clamp_c)
+            ex = (D_sds, val_sds, i32, i32, i32, i32)
+            return compile_cache.cached_jit(fn, key=key, example_args=ex,
+                                            donate_argnums=0)
+        fn = _build_extract(*val_sds.shape, clamp_r, clamp_c)
+        ex = (D_sds, i32, i32, i32, i32)
+        return compile_cache.cached_jit(fn, key=key, example_args=ex)
+
+    def _kernel_fn(self, step: SegStep, in_sds: Tuple) -> Callable:
+        builder = _PANEL_KERNELS.get(step.kernel)
+        if builder is None:
+            raise KeyError(f"unregistered panel kernel {step.kernel!r}")
+        sig = tuple((tuple(s.shape), str(s.dtype)) for s in in_sds)
+        key = ("panel_kernel", step.kernel, sig, step.static)
+        return compile_cache.cached_jit(builder(in_sds, step.static),
+                                        key=key, example_args=in_sds)
+
+    def _seg_walk(self, state, dispatch: bool):
+        """Shared walker for :meth:`run_state_segmented` (dispatch=True,
+        state = device arrays) and :meth:`prepare_segments`
+        (dispatch=False, state = ShapeDtypeStructs — resolves/compiles
+        every program without running, propagating carry shapes with
+        eval_shape). One walker so warm-up and execution can never
+        resolve different cache keys."""
+        import jax
+        state = dict(state)
+        carries: Dict[str, Any] = {}
+        i4 = (np.int32(0),) * 4
+        for step in self.segments():
+            ins = []
+            for rd in step.reads:
+                if rd.src == "carry":
+                    ins.append(carries[rd.name])
+                elif rd.src == "const":
+                    v = np.asarray(rd.value)
+                    ins.append(jax.ShapeDtypeStruct(v.shape, v.dtype)
+                               if not dispatch else v)
+                else:
+                    D = state[rd.name]
+                    D_sds = jax.ShapeDtypeStruct(D.shape, D.dtype)
+                    v_sds = jax.ShapeDtypeStruct(
+                        (rd.rows_b, rd.cols_b), D.dtype)
+                    fn = self._window_fn(D_sds, v_sds, rd, "panel_extract")
+                    if dispatch:
+                        ins.append(fn(D, np.int32(rd.r0), np.int32(rd.c0),
+                                      np.int32(rd.rows), np.int32(rd.cols)))
+                    else:
+                        ins.append(v_sds)
+            in_sds = tuple(
+                x if isinstance(x, jax.ShapeDtypeStruct) else
+                jax.ShapeDtypeStruct(x.shape, x.dtype) for x in ins)
+            kfn = self._kernel_fn(step, in_sds)
+            if dispatch:
+                outs = kfn(*ins)
+            else:
+                builder = _PANEL_KERNELS[step.kernel]
+                outs = jax.eval_shape(builder(in_sds, step.static),
+                                      *in_sds)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            if len(outs) != len(step.writes):
+                raise ValueError(
+                    f"panel kernel {step.kernel!r} returned {len(outs)} "
+                    f"outputs for {len(step.writes)} writes")
+            for wr, val in zip(step.writes, outs):
+                if wr.dst == "carry":
+                    carries[wr.name] = val
+                    continue
+                D = state[wr.name]
+                D_sds = jax.ShapeDtypeStruct(D.shape, D.dtype)
+                v_sds = jax.ShapeDtypeStruct(val.shape, val.dtype)
+                fn = self._window_fn(D_sds, v_sds, wr, "panel_write")
+                if dispatch:
+                    state[wr.name] = fn(D, val, np.int32(wr.r0),
+                                        np.int32(wr.c0), np.int32(wr.rows),
+                                        np.int32(wr.cols))
+                else:
+                    state[wr.name] = D_sds     # shape unchanged
+        return {name: state[name] for name in self.geoms}
+
+    def run_state_segmented(self, state: Dict[str, Any]
+                            ) -> Dict[str, Any]:
+        """state → state through cached per-(kernel, bucket) programs
+        dispatched wave-by-wave. Same collection-level results as
+        :meth:`run_state`; compile cost bounded by distinct buckets
+        (not waves) and shared across N, executors, and — with the
+        persistent store — processes. JAX async dispatch pipelines the
+        per-step calls."""
+        return self._seg_walk(state, dispatch=True)
+
+    def prepare_segments(self) -> int:
+        """Resolve (compile or load) every program the segmented run
+        will dispatch, without touching data — the serving warm-up.
+        Returns the number of distinct cached programs in the walk."""
+        n0 = compile_cache.jit_store_size()
+        self._seg_walk(self.state_shapes(), dispatch=False)
+        return compile_cache.jit_store_size() - n0
+
+    # -- host-driven run --------------------------------------------------
+
+    def run(self, jit: bool = True, segmented: bool = False) -> float:
         t0 = time.perf_counter()
         state = self.make_state()
-        fn = self.jitted if jit else self.run_state
-        out = fn(state)
+        if segmented:
+            out = self.run_state_segmented(state)
+        else:
+            fn = self.jitted if jit else self.run_state
+            out = fn(state)
         for v in out.values():
             v.block_until_ready()
         dt = time.perf_counter() - t0

@@ -13,13 +13,30 @@ Owner-computes refinement: distributed collections emit rank-grouped
 slot orders (TiledMatrix.tile_index), so sharding the slot axis places
 each tile's slot on (or near) its owner device and the partitioner's
 collectives carry only true dataflow.
+
+Preferential-pjit front end (compile-once serving)
+--------------------------------------------------
+
+:func:`compile_with_plan` is the single compilation entry for mesh
+programs (the Titanax ``compile_step_with_plan`` helper shape):
+explicit in/out shardings → a pjit-compiled program; a mesh without
+shardings → a ``shard_map`` data-parallel fallback (the function must
+then be shard-local — per-slot independent); neither → plain jit.
+Whatever the branch, the product enters the same shared jit store and
+persistent executor cache as the single-chip executors
+(``utils/compile_cache.py``), keyed by mesh axes/devices + sharding
+specs on top of the caller's key — so a serving process re-lowers a
+mesh program exactly once per (program, mesh, sharding, shapes) and a
+second process pays only deserialization.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
+
+from ..utils import compile_cache
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "tiles"):
@@ -50,22 +67,161 @@ def shard_stores(stores: Dict[str, Any], mesh, axis: str = "tiles"):
     return out
 
 
+# ---------------------------------------------------------------------------
+# preferential-pjit compilation helper
+# ---------------------------------------------------------------------------
+
+def _mesh_repr(mesh) -> Tuple:
+    if mesh is None:
+        return ()
+    return (tuple(mesh.axis_names), tuple(mesh.devices.shape),
+            tuple(int(d.id) for d in mesh.devices.flat))
+
+
+def _sharding_repr(s) -> Any:
+    """Canonical key form of a sharding pytree (NamedShardings /
+    PartitionSpecs / None leaves, possibly nested in dicts/tuples)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def leaf(x):
+        if x is None:
+            return "none"
+        if isinstance(x, NamedSharding):
+            return ("named", _mesh_repr(x.mesh), tuple(repr(p)
+                                                       for p in x.spec))
+        if isinstance(x, PartitionSpec):
+            return ("pspec", tuple(repr(p) for p in x))
+        return repr(x)
+
+    return jax.tree_util.tree_map(
+        leaf, s, is_leaf=lambda x: x is None or
+        isinstance(x, (NamedSharding, PartitionSpec)))
+
+
+def compile_with_plan(fn: Callable, *, mesh=None, in_shardings=None,
+                      out_shardings=None, in_specs=None, out_specs=None,
+                      donate_argnums=(), example_args: Tuple = None,
+                      key: Tuple = (), fn_key=None) -> Callable:
+    """Compile ``fn`` for a device mesh, preferring ``pjit`` when the
+    caller knows its shardings (SNIPPETS [2], Titanax
+    ``compile_step_with_plan``):
+
+    - ``in_shardings`` AND ``out_shardings`` given → pjit (``jax.jit``
+      with shardings): XLA partitions the program, inserting the
+      collectives true dataflow needs. Giving only one of the two is an
+      error — a half-specified contract silently replicates the other
+      side.
+    - no shardings but a ``mesh`` → ``shard_map`` fallback for pure
+      data-parallel map-style execution over ``in_specs``/``out_specs``
+      (default: shard the leading axis of every argument over the
+      mesh's first axis). ``fn`` must be shard-local.
+    - neither → plain jit.
+
+    Every branch enters the shared jit store / persistent executor
+    cache keyed by (``fn``'s identity, caller key, branch, mesh,
+    sharding specs) — a rebuilt front end for an already-served program
+    never re-traces, and a second process deserializes instead of
+    compiling. ``fn``'s identity defaults to its code fingerprint;
+    pass ``fn_key`` when ``fn`` is a bound method / closure whose
+    *instance state* shapes the trace (the fingerprint cannot see it)
+    and the caller can name that state (e.g. a plan fingerprint).
+    Functions that are neither stably fingerprintable nor covered by a
+    caller ``fn_key`` are compiled directly and NOT cached — silent
+    cross-function sharing (or pinning a per-request object graph in
+    the never-evicted store) is worse than a re-trace.
+    """
+    import jax
+
+    have_in = in_shardings is not None
+    have_out = out_shardings is not None
+    if have_in != have_out:
+        raise ValueError(
+            "compile_with_plan requires BOTH in_shardings and "
+            "out_shardings when using pjit; pass neither to use the "
+            "shard_map fallback")
+    if fn_key is None:
+        ok, fp = compile_cache.function_fingerprint(fn)
+        if ok and getattr(fn, "__self__", None) is None:
+            fn_key = ("fp", fp)
+    shareable = fn_key is not None
+    if have_in:
+        wrapper = lambda f: jax.jit(               # noqa: E731
+            f, in_shardings=in_shardings, out_shardings=out_shardings,
+            donate_argnums=donate_argnums)
+        if not shareable:
+            return wrapper(fn)
+        full_key = ("pjit", fn_key, key, _mesh_repr(mesh),
+                    _sharding_repr(in_shardings),
+                    _sharding_repr(out_shardings), tuple(donate_argnums)
+                    if not isinstance(donate_argnums, int)
+                    else donate_argnums)
+        return compile_cache.cached_jit(
+            fn, key=full_key, example_args=example_args,
+            jit_wrapper=wrapper)
+    if mesh is not None:
+        from .ring_attention import _shard_map
+        from jax.sharding import PartitionSpec as P
+        sm = _shard_map()
+        axis = mesh.axis_names[0]
+        ispec = in_specs if in_specs is not None else P(axis)
+        ospec = out_specs if out_specs is not None else P(axis)
+        mapped = sm(fn, mesh=mesh, in_specs=ispec, out_specs=ospec)
+        if not shareable:
+            return jax.jit(mapped, donate_argnums=donate_argnums)
+        full_key = ("shard_map", fn_key, key, _mesh_repr(mesh),
+                    _sharding_repr(ispec), _sharding_repr(ospec))
+        return compile_cache.cached_jit(
+            mapped, key=full_key, example_args=example_args,
+            donate_argnums=donate_argnums)
+    if not shareable:
+        return jax.jit(fn, donate_argnums=donate_argnums)
+    return compile_cache.cached_jit(
+        fn, key=("jit", fn_key, key), example_args=example_args,
+        donate_argnums=donate_argnums)
+
+
 def run_sharded(executor, mesh=None, n_devices: Optional[int] = None,
                 axis: str = "tiles") -> Dict[str, Any]:
-    """Execute the plan with mesh-sharded stores: one jitted XLA program
-    for the whole DAG, collectives inserted by the partitioner.
+    """Execute the plan with mesh-sharded stores: one pjit-compiled XLA
+    program for the whole DAG, collectives inserted by the partitioner.
+
+    Goes through :func:`compile_with_plan` with explicit in/out
+    ``NamedSharding``s (the preferential-pjit path), so the program
+    lands in the shared/persistent executor cache keyed by (plan, mesh,
+    shardings, shapes) and is reused across runs and processes.
 
     Returns the (unsharded, unpadded) result stores and writes tiles back
     to the plan's collections.
     """
     import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     if mesh is None:
         mesh = make_mesh(n_devices, axis)
     stores = executor.make_stores()
     orig_sizes = {k: v.shape[0] for k, v in stores.items()}
     sharded = shard_stores(stores, mesh, axis)
-    fn = jax.jit(executor.run_arrays)
+
+    sharding = NamedSharding(mesh, P(axis))
+    shardings = {name: sharding for name in sharded}
+    sds = {name: jax.ShapeDtypeStruct(v.shape, v.dtype)
+           for name, v in sharded.items()}
+    from .wavefront import plan_structure_fingerprint
+    ok, plan_fp = plan_structure_fingerprint(executor.plan)
+    fps = sorted({executor._body_fp(grp.tc) or "unstable"
+                  for wave in executor.plan.waves for grp in wave})
+    stable = ok and "unstable" not in fps
+    # run_arrays is a bound method: its trace depends on the plan, so
+    # the fn identity is the plan+body fingerprint — and when THAT is
+    # unstable, fn_key stays None and compile_with_plan compiles
+    # without caching (a cached entry would pin the executor and its
+    # tile data in the never-evicted store under a one-shot id key)
+    fn = compile_with_plan(
+        executor.run_arrays, mesh=mesh, in_shardings=(shardings,),
+        out_shardings=shardings,
+        example_args=(sds,) if stable else None,
+        fn_key=("run_sharded", plan_fp, tuple(fps)) if stable else None)
     out = fn(sharded)
     for v in out.values():
         v.block_until_ready()

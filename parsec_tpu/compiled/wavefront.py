@@ -28,6 +28,7 @@ that need value-passing (those run on the host runtime instead).
 
 from __future__ import annotations
 
+import hashlib
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -37,6 +38,7 @@ import numpy as np
 from ..core.task import DeviceType, FlowAccess, Task
 from ..core.taskpool import DataRef
 from ..dsl.ptg import PTGTaskClass, Taskpool as PTGTaskpool
+from ..utils import compile_cache
 from ..utils.debug import debug_verbose
 
 
@@ -75,6 +77,64 @@ class WavefrontPlan:
     @property
     def n_waves(self) -> int:
         return len(self.waves)
+
+
+def plan_structure_fingerprint(plan: "WavefrontPlan"
+                               ) -> Tuple[bool, str]:
+    """``(stable, digest)`` over everything of a plan that shapes a
+    traced program: collection geometry/dtypes, the full wave/group
+    structure with slot indices, and reshape-spec identities. Equal
+    digests ⇒ equal traces (given equal bodies/fusers, fingerprinted
+    separately) — the key that lets rebuilt executors share jitted
+    callables instead of re-tracing per function object."""
+    h = hashlib.sha256()
+    stable = True
+    for name in sorted(plan.collections):
+        dc = plan.collections[name]
+        h.update(repr((name, dc.mb, dc.nb, dc.mt, dc.nt,
+                       str(np.dtype(dc.dtype)),
+                       bool(getattr(dc, "scratch", False)))).encode())
+    for w, wave in enumerate(plan.waves):
+        for grp in wave:
+            h.update(repr((w, grp.tc.name, tuple(grp.tasks))).encode())
+            for (nm, idx) in grp.in_slots:
+                h.update(nm.encode())
+                h.update(np.ascontiguousarray(idx).tobytes())
+            for (nm, idx) in grp.out_slots:
+                h.update(nm.encode())
+                h.update(np.ascontiguousarray(idx).tobytes())
+            for s in grp.in_specs:
+                if s is None:
+                    h.update(b"nospec")
+                    continue
+                h.update(repr(getattr(s, "key", None)).encode())
+                ok, fp = compile_cache.function_fingerprint(s.fn)
+                stable = stable and ok
+                h.update(fp.encode())
+    h.update(repr((plan.n_tasks, plan.has_value_flows,
+                   plan.has_reshapes)).encode())
+    return stable, h.hexdigest()
+
+
+def class_body_fingerprint(tc: PTGTaskClass, device_type: DeviceType
+                           ) -> Tuple[bool, str]:
+    """``(stable, digest)`` of the bodies a compiled executor may trace
+    for ``tc``: the chore hook plus its batched reformulations."""
+    chore = tc.chore_for(device_type) or tc.chore_for(DeviceType.CPU)
+    if chore is None:
+        return False, f"nobody:{tc.name}"
+    parts, stable = [tc.name], True
+    for fn in (chore.hook, chore.batch_hook, chore.batch_body):
+        if fn is None:
+            parts.append("none")
+            continue
+        ok, fp = compile_cache.function_fingerprint(fn)
+        stable = stable and ok
+        parts.append(fp)
+    parts.append(repr(tuple(getattr(chore, "batch_hook_shared", None)
+                            or ())))
+    return stable, hashlib.sha256(
+        "\x00".join(parts).encode()).hexdigest()
 
 
 def _flow_tile(tc: PTGTaskClass, fname: str, locals) -> Tuple[Any, Tuple]:
@@ -415,9 +475,55 @@ class WavefrontExecutor:
         self.device_type = device_type
         self._vmapped: Dict[str, Callable] = {}
         self._segments: Dict[Tuple, Callable] = {}
-        # jit once: a fresh jax.jit wrapper per run() would recompile the
-        # whole-DAG program on every call (jit caches by function object)
-        self.jitted = self.jax.jit(self.run_arrays)
+        # body fingerprints (per class, memoized): the segment/whole-DAG
+        # caches are shared through the module-level keyed store in
+        # compile_cache — jit caches by FUNCTION OBJECT, so the old
+        # per-instance jax.jit wrappers re-traced the same programs on
+        # every executor rebuilt from an equal plan. Classes whose
+        # bodies have no stable fingerprint fall back to per-instance
+        # caching (never to silent cross-instance sharing).
+        self._body_fps: Dict[str, Optional[str]] = {}
+        self._plan_fp: Optional[str] = None
+        self._jitted = None
+
+    def _body_fp(self, tc: PTGTaskClass) -> Optional[str]:
+        fp = self._body_fps.get(tc.name, "")
+        if fp == "":
+            ok, digest = class_body_fingerprint(tc, self.device_type)
+            fp = digest if ok else None
+            self._body_fps[tc.name] = fp
+        return fp
+
+    @property
+    def jitted(self) -> Callable:
+        """The whole-DAG jitted ``run_arrays`` — shared across
+        executors built from structurally-equal plans (and persisted
+        when the executor store is enabled), keyed by the plan
+        fingerprint + every class's body fingerprint + store shapes."""
+        if self._jitted is not None:
+            return self._jitted
+        if self._plan_fp is None:
+            ok, digest = plan_structure_fingerprint(self.plan)
+            self._plan_fp = digest if ok else None
+        fps = [self._body_fp(grp.tc) for wave in self.plan.waves
+               for grp in wave]
+        if self._plan_fp is None or any(f is None for f in fps):
+            self._jitted = self.jax.jit(self.run_arrays)
+            return self._jitted
+        import jax
+        shapes = tuple(sorted(
+            (name, len(self.plan.slot_maps[name]) + 1, dc.mb, dc.nb,
+             str(np.dtype(dc.dtype)))
+            for name, dc in self.plan.collections.items()))
+        sds = {name: jax.ShapeDtypeStruct(
+            (len(self.plan.slot_maps[name]) + 1, dc.mb, dc.nb),
+            np.dtype(dc.dtype))
+            for name, dc in self.plan.collections.items()}
+        key = ("wf_monolith", self._plan_fp, tuple(sorted(set(fps))),
+               shapes, self.bucket, self.device_type.name)
+        self._jitted = compile_cache.cached_jit(
+            self.run_arrays, key=key, example_args=(sds,))
+        return self._jitted
 
     # -- body lookup ------------------------------------------------------
     def _raw_body(self, tc: PTGTaskClass) -> Callable:
@@ -658,7 +764,25 @@ class WavefrontExecutor:
                            for s, x in zip(_specs, ins)]
                 return tuple(self._normalize_outs(_tc, _b(*ins)))
 
-            fn = self.jax.jit(seg)
+            # shared across executors (and processes, via the store)
+            # when the class's bodies fingerprint stably: rebuilding an
+            # executor for the same (class, bucket) never re-traces.
+            # Spec fns enter through sig keys only, so require stable
+            # fingerprints for them too; else stay per-instance.
+            body_fp = self._body_fp(grp.tc)
+            spec_ok = all(
+                s is None or
+                compile_cache.function_fingerprint(s.apply)[0]
+                for s in specs)
+            if body_fp is not None and spec_ok:
+                import jax
+                sds = tuple(jax.ShapeDtypeStruct((batch, mb, nb), dt)
+                            for (mb, nb, dt) in shapes)
+                fn = compile_cache.cached_jit(
+                    seg, key=("wf_segment", body_fp, key),
+                    example_args=sds if sds else None)
+            else:
+                fn = self.jax.jit(seg)
             self._segments[key] = fn
         return fn
 

@@ -88,18 +88,33 @@ class TPUDevice(Device):
                       self.jax_device, self.platform)
 
     def _jitted(self, task: Task, chore: Chore) -> Callable:
+        # per-device first-level lookup stays ONE dict hit (this runs
+        # per task on the dispatch hot path — the PR 3 overhead budget);
+        # the (tc_id, taskpool_id, id(chore)) key guards id() reuse of
+        # a GC'd pool's chore. Jit-cache unification happens at BUILD
+        # time only: on a miss, bodies with a stable code fingerprint
+        # fetch their wrapper from the process-wide compile_cache store,
+        # so a new taskpool, a new Context, or a second TPUDevice for
+        # the same body never re-traces. Unstable fingerprints stay
+        # per-instance — never shared on an id()-grade identity.
         key = (task.task_class.tc_id, task.taskpool.taskpool_id, id(chore))
         fn = self._jit_cache.get(key)
         if fn is None:
             with self._cache_lock:
                 fn = self._jit_cache.get(key)
                 if fn is None:
+                    from ..utils import compile_cache
                     body = chore.hook
-                    # bodies take (task, *tiles); the task argument is
-                    # host-side metadata — close over it as static
-                    jit_body = self.jax.jit(
-                        lambda *tiles, _b=body: _b(None, *tiles))
-                    fn = jit_body
+                    stable, fp = compile_cache.function_fingerprint(body)
+                    if stable:
+                        fn = compile_cache.cached_jit(
+                            lambda *tiles, _b=body: _b(None, *tiles),
+                            key=("tpu_body", fp), persist=False)
+                    else:
+                        # bodies take (task, *tiles); the task argument
+                        # is host-side metadata — closed over as static
+                        fn = self.jax.jit(
+                            lambda *tiles, _b=body: _b(None, *tiles))
                     self._jit_cache[key] = fn
         return fn
 
@@ -273,13 +288,39 @@ class TPUDevice(Device):
         convention) instead of vmap — vmapped cholesky/triangular
         solves lower poorly on TPU (measured ~90 ms/batch where the
         wide-solve reformulation is ~1 ms)."""
-        # taskpool_id in the key (like _jitted): id(chore) of a
-        # GC'd pool's chore can be reused and would silently serve the
-        # old pool's jitted body; bsig distinguishes woven-body variants
-        # of one batch_body chore (different value payloads/precision)
+        # per-device first-level lookup stays one dict hit per batch;
+        # taskpool_id guards id(chore) reuse after GC (a recycled id
+        # would silently serve the old pool's jitted body); bsig
+        # distinguishes woven-body variants of one batch_body chore
+        # (different value payloads/precision). Jit-cache unification
+        # happens at build time: on a miss, when every involved body
+        # fingerprints stably, the batched dispatcher comes from the
+        # process-wide compile_cache store keyed by code fingerprints
+        # (+ bsig/sig/bucket) — equal bodies across taskpools,
+        # contexts, and device modules trace once.
         key = (tp_id, tc.tc_id, id(chore), bsig, sig, Bp, use_hook)
         fn = self._vmap_cache.get(key)
         if fn is None:
+            from ..utils import compile_cache
+            shared_key = None
+            parts = []
+            for f in ((chore.batch_hook if use_hook else None),
+                      (body_override if body_override is not None
+                       else None if use_hook else chore.hook),
+                      chore.batch_body):
+                if f is None:
+                    parts.append("none")
+                    continue
+                ok, fp = compile_cache.function_fingerprint(f)
+                if not ok:
+                    parts = None
+                    break
+                parts.append(fp)
+            if parts is not None:
+                shared_key = ("tpu_vmap", tuple(parts),
+                              repr(getattr(chore, "batch_hook_shared",
+                                           None) or ()), bsig, sig, Bp,
+                              use_hook, tc.name)
             body = chore.batch_hook if use_hook else \
                 (body_override or chore.hook)
             mask = tuple(s is not None for s in sig)
@@ -331,7 +372,11 @@ class TPUDevice(Device):
 
                 return self.jax.vmap(one)(*stacked)
 
-            fn = self.jax.jit(batched)
+            if shared_key is not None:
+                fn = compile_cache.cached_jit(batched, key=shared_key,
+                                              persist=False)
+            else:
+                fn = self.jax.jit(batched)
             with self._cache_lock:
                 self._vmap_cache[key] = fn
         return fn

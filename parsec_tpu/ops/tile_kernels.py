@@ -12,13 +12,16 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..utils import mca_param
+from ..utils import compile_cache, mca_param
 
 # On TPU, f32 matmuls default to bf16 MXU passes (~1e-2 relative error).
 # "highest" runs the 6-pass f32 emulation — DPLASMA-grade accuracy at a
 # throughput cost; "default" is the TPU-native speed setting.
 mca_param.register("ops.matmul_precision", "default",
                    help="MXU precision for tile matmuls: default|high|highest")
+# these knobs choose what gets TRACED into compiled tile kernels —
+# every shared/persistent compile-cache key snapshots them
+compile_cache.register_trace_knob("ops.matmul_precision")
 
 
 def matmul_precision():
@@ -87,6 +90,7 @@ def potrf_tile(A):
 mca_param.register("ops.tri_base", 256,
                    help="base block size for matmul-rich triangular "
                         "kernels (tri_inv_tile / potrf_tile_blocked)")
+compile_cache.register_trace_knob("ops.tri_base")
 
 
 def tri_inv_tile(L, base: int = 0):
@@ -390,6 +394,7 @@ mca_param.register("ops.panel_qr", "cholqr2",
                    help="panel QR kernel for the fused GEQRF path: "
                         "cholqr2 (all-matmul, needs full column rank) | "
                         "xla (jnp.linalg.qr, slower, more robust)")
+compile_cache.register_trace_knob("ops.panel_qr")
 
 
 def panel_qr_tile(Pt):

@@ -224,3 +224,61 @@ def test_trimmed_median():
     # upper-middle bias
     assert bench._trimmed_median([500.0, 510.0, 520.0, 900.0]) == 515.0
     assert bench._trimmed_median([1.0, 2.0]) == 1.5
+
+
+def test_amort_probe_zero_recompile_smoke(tmp_path):
+    """Tier-1 CPU-sized smoke of the compile_amortization serving
+    claim, through the bench's own probe path: a warm serve (simulated
+    fresh process: in-process jit store cleared, persistent store kept)
+    pays ZERO XLA compiles and reports full store hits."""
+    from parsec_tpu.utils import compile_cache as cc
+    from parsec_tpu.utils import mca_param
+    import jax
+
+    bench = _load_bench()
+    prev = jax.config.jax_compilation_cache_dir
+    d = str(tmp_path / "amort")
+    try:
+        cc.reset_in_process_cache()          # honest cold, any ordering
+        cold = bench._amort_probe_run("panel", 192, 64, d)
+        assert cold["xla_compiles"] > 0
+        assert cold["store_misses"] == cold["n_programs"]
+        cc.reset_in_process_cache()          # "second process"
+        warm = bench._amort_probe_run("panel", 192, 64, d)
+        assert warm["xla_compiles"] == 0, warm
+        assert warm["store_hits"] == warm["n_programs"]
+        assert warm["store_misses"] == 0
+    finally:
+        # the probe sets process-global knobs (it normally runs in its
+        # own subprocess) — restore them for the rest of the suite
+        mca_param.unset("jit.cache_dir")
+        mca_param.unset("potrf.trsm_hook")
+        cc.disable_compile_cache()
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_amort_section_registered():
+    """compile_amortization is a first-class section: registry, error
+    keys, and the compact-summary/guard keys stay wired together."""
+    bench = _load_bench()
+    assert "compile_amortization" in bench.SECTIONS
+    assert bench._SECTION_KEYS["compile_amortization"] == (
+        "compile_amortization",)
+    assert "amort_panel_warm_compiles" in bench._LATENCY_GUARD_KEYS
+    assert "amort_panel_new_n_2_compiles" in bench._LATENCY_GUARD_KEYS
+    # the summary carries the guarded keys (the guard parses the NEXT
+    # round's prior from the summary — an absent key is unguardable)
+    result = _fat_result()
+    result["detail"]["extra_configs"]["compile_amortization"] = {
+        "panel": {"cold": {"xla_compiles": 46,
+                           "start_to_first_flop_s": 2.1},
+                  "warm": {"xla_compiles": 0,
+                           "start_to_first_flop_s": 0.2},
+                  "new_n": {"xla_compiles": 28},
+                  "new_n_2": {"xla_compiles": 0}},
+        "wavefront": {"warm": {"xla_compiles": 7}}}
+    compact = json.loads(bench._compact_summary(result))
+    assert compact["detail"]["amort_panel_warm_compiles"] == 0
+    assert compact["detail"]["amort_panel_new_n_2_compiles"] == 0
+    assert compact["detail"]["amort_panel_warm_start_s"] == 0.2
+    assert compact["detail"]["amort_wf_warm_compiles"] == 7
