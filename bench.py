@@ -1024,6 +1024,7 @@ def _section_compile_amortization():
     for the panel-fused flagship config and the wavefront segmented
     path. The warm/new_n_2 compile counts and the warm
     start-to-first-FLOP ride the rise-guard."""
+    import shutil
     import tempfile
     import jax
     on_tpu = jax.default_backend() == "tpu"
@@ -1035,15 +1036,21 @@ def _section_compile_amortization():
         pn1, pn2, pnb = 512, 448, 64
         wn1, wn2, wnb = 256, 320, 64
     rows = {"cache_dir": d}
-    for tag, path, (n1, n2, nb) in (
-            ("panel", "panel", (pn1, pn2, pnb)),
-            ("wavefront", "wavefront", (wn1, wn2, wnb))):
-        r = {}
-        r["cold"] = _amort_child(path, n1, nb, d)
-        r["warm"] = _amort_child(path, n1, nb, d)
-        r["new_n"] = _amort_child(path, n2, nb, d)
-        r["new_n_2"] = _amort_child(path, n2, nb, d)
-        rows[tag] = r
+    try:
+        for tag, path, (n1, n2, nb) in (
+                ("panel", "panel", (pn1, pn2, pnb)),
+                ("wavefront", "wavefront", (wn1, wn2, wnb))):
+            r = {}
+            r["cold"] = _amort_child(path, n1, nb, d)
+            r["warm"] = _amort_child(path, n1, nb, d)
+            r["new_n"] = _amort_child(path, n2, nb, d)
+            r["new_n_2"] = _amort_child(path, n2, nb, d)
+            rows[tag] = r
+    finally:
+        # the dir is purpose-built so "cold" is honestly cold and never
+        # reused; on TPU it holds multi-GB of serialized flagship
+        # executables per round — leaking it fills the disk
+        shutil.rmtree(d, ignore_errors=True)
     return {"compile_amortization": rows}
 
 
@@ -1060,6 +1067,19 @@ def _section_recovery():
     return {"recovery": measure_recovery()}
 
 
+def _section_serving():
+    """Mixed-tenant serving bench (ISSUE 8): continuous-batching decode
+    under an open-loop load from weighted tenants on a 2-rank mesh —
+    clean phase, then a faulty phase with one poison-body tenant and a
+    SIGKILL'd rank (both quarantined as per-taskpool failure units
+    while the well-behaved tenants keep serving bitwise-correct), then
+    a load-shedding overload probe. Records requests/s, per-tenant
+    p50/p99, shed count, quarantine count and the isolation check
+    (faulty p99 within 2x of clean)."""
+    from parsec_tpu.serving.serving_bench import measure_serving
+    return {"serving": measure_serving()}
+
+
 SECTIONS = {
     "hostdtd": _section_hostdtd,
     "ptile": _section_ptile,
@@ -1072,6 +1092,7 @@ SECTIONS = {
     "bcast": _section_bcast,
     "recovery": _section_recovery,
     "compile_amortization": _section_compile_amortization,
+    "serving": _section_serving,
 }
 
 # result keys each section produces — failures are recorded under these
@@ -1088,6 +1109,7 @@ _SECTION_KEYS = {
     "bcast": ("bcast",),
     "recovery": ("recovery",),
     "compile_amortization": ("compile_amortization",),
+    "serving": ("serving",),
 }
 
 # geqrf stacks three programs (per-tile stress + 94-wave fused + the
@@ -1149,7 +1171,10 @@ _GFLOPS_GUARD_KEYS = ("value", "gemm_panel_fused_gflops",
                       "precision_gflops",
                       # tasks/sec is higher-is-better like the GFLOPS
                       # rows, so the same >10%-drop guard applies
-                      "tasks_per_sec")
+                      "tasks_per_sec",
+                      # serving sustained requests/s rides the same
+                      # drop guard
+                      "serving_requests_per_sec")
 _LATENCY_GUARD_KEYS = ("eager_1k_p50_us", "rdv_1M_p50_us",
                        "device_64k_p50_us", "bcast_1M_p50_us",
                        # recovery rows ride the same rise-guard: a
@@ -1164,7 +1189,10 @@ _LATENCY_GUARD_KEYS = ("eager_1k_p50_us", "rdv_1M_p50_us",
                        "amort_panel_warm_compiles",
                        "amort_panel_new_n_2_compiles",
                        "amort_panel_warm_start_s",
-                       "amort_wf_warm_compiles")
+                       "amort_wf_warm_compiles",
+                       # serving: the well-behaved tenants' p99 under a
+                       # faulty mixed-tenant load must not creep up
+                       "serving_p99_ms")
 
 
 def _flatten_summary(summary: dict) -> dict:
@@ -1232,7 +1260,16 @@ def _compare_captures(cur: dict, prior: dict, gflops_drop: float = 0.10,
     for key in _LATENCY_GUARD_KEYS:
         c, p = cur.get(key), prior.get(key)
         if not isinstance(c, (int, float)) or \
-                not isinstance(p, (int, float)) or p <= 0:
+                not isinstance(p, (int, float)) or p < 0:
+            continue
+        if p == 0:
+            # zero-baseline rows (the compile-count keys whose healthy
+            # value IS 0): a relative rise can never fire, so any
+            # nonzero current value fires absolutely — otherwise the
+            # "warm stays at ZERO compiles" guard is structurally dead
+            if c > 0:
+                rises.append(f"{key}: {p:.1f} -> {c:.1f} "
+                             "(zero-baseline regression)")
             continue
         if (c - p) / p > latency_rise:
             rises.append(f"{key}: {p:.1f} -> {c:.1f} us "
@@ -1352,6 +1389,13 @@ def _compact_summary(result):
                 if isinstance(pick("recovery", "lost_work_fraction"),
                               (int, float)) else None),
             "recovery_bitwise_check": pick("recovery", "bitwise_check"),
+            "serving_requests_per_sec": pick("serving",
+                                             "requests_per_sec"),
+            "serving_p99_ms": pick("serving", "p99_ms"),
+            "serving_p99_ratio": pick("serving", "p99_ratio_worst"),
+            "serving_shed": pick("serving", "shed_count"),
+            "serving_quarantined": pick("serving", "quarantine_count"),
+            "serving_isolation": pick("serving", "isolation_check"),
             "amort_panel_cold_compiles": pick2(
                 "compile_amortization", "panel", "cold", "xla_compiles"),
             "amort_panel_cold_start_s": pick2(

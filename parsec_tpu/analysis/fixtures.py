@@ -187,6 +187,34 @@ def build_dangling_output() -> ptg.Taskpool:
     return tp
 
 
+@_fixture(rules=("waw-hazard",))
+def build_serving_quarantine() -> ptg.Taskpool:
+    """Serving fixture: the taskpool shape a misbehaving tenant submits
+    — two decode "requests" whose steps both write the SAME shared KV
+    tile with no ordering edge (a WAW hazard; a correct tenant keys KV
+    tiles per request). This is exactly what the registration-time lint
+    gate (``analysis.lint=error``) refuses, quarantining the tenant
+    before any of its tasks run; the CLI self-check additionally
+    renders this report via ``LintReport.to_dot()`` — the quarantine
+    evidence an operator gets for a refused tenant."""
+    kv = _store(2)
+    tp = ptg.Taskpool("tenant_decode", KV=kv)
+    for req in ("DEC_A", "DEC_B"):
+        tp.task_class(
+            req, params=("t",), space=lambda g: ((t,) for t in range(2)),
+            flows=[ptg.FlowSpec(
+                "K", ptg.RW,
+                ins=[ptg.In(data=lambda g, t: (g.KV, (0,)),
+                            guard=lambda g, t: t == 0),
+                     ptg.In(src=(req, lambda g, t: (t - 1,), "K"),
+                            guard=lambda g, t: t > 0)],
+                outs=[ptg.Out(dst=(req, lambda g, t: (t + 1,), "K"),
+                              guard=lambda g, t: t < 1),
+                      ptg.Out(data=lambda g, t: (g.KV, (0,)),
+                              guard=lambda g, t: t == 1)])])
+    return tp
+
+
 def self_check() -> Tuple[int, list]:
     """Lint every fixture and verify the expected rules fire with
     messages naming the task class, flow and coordinates; verify the
@@ -226,4 +254,17 @@ def self_check() -> Tuple[int, list]:
             continue
         shown = next(f for f in report.findings if f.rule in rules)
         lines.append(f"ok   {name}: {shown}")
+        if name == "serving_quarantine":
+            # the quarantined-tenant DAG must RENDER: the operator-facing
+            # evidence for a lint-refused tenant is the DOT report with
+            # the hazard edge marked
+            dot = report.to_dot()
+            if not (dot.lstrip().startswith("digraph")
+                    and "waw-hazard" in dot):
+                failures += 1
+                lines.append(f"FAIL {name}: to_dot() did not render the "
+                             "hazard DAG")
+            else:
+                lines.append(f"ok   {name}: to_dot() renders "
+                             f"({len(dot)} bytes, hazard edge marked)")
     return failures, lines

@@ -73,6 +73,10 @@ mca_param.register("comm.rejoin", 0,
                         "SocketCommEngine(..., rejoin=True) can adopt "
                         "the dead rank's slot (ULFM-style shrink/"
                         "respawn); 0 = a dead rank stays dead")
+mca_param.register("comm.rejoin_timeout", 60.0,
+                   help="seconds wait_rejoin blocks for a replacement "
+                        "rank before raising (the survivor-side "
+                        "rendezvous bound before recovery replay)")
 mca_param.register("comm.thread_multiple", 0,
                    help="MPI_THREAD_MULTIPLE analog (parsec_param_comm_"
                         "thread_multiple, remote_dep.h:166): worker "
@@ -370,12 +374,24 @@ class SocketCommEngine(CommEngine):
             warning("comm", "rank %d: rank %d rejoined the mesh",
                     self.rank, peer)
 
-    def wait_rejoin(self, rank: int, timeout: float = 60.0) -> bool:
+    def wait_rejoin(self, rank: int,
+                    timeout: Optional[float] = None) -> bool:
         """Block until a replacement for dead ``rank`` has been
-        admitted (survivor-side rendezvous before planning replay)."""
+        admitted (survivor-side rendezvous before planning replay).
+        ``timeout`` defaults to the ``comm.rejoin_timeout`` MCA knob;
+        expiry raises a :class:`TimeoutError` naming the knob so a
+        too-slow respawner is diagnosable instead of a bare False
+        propagating into a confusing replay failure."""
+        if timeout is None:
+            timeout = float(mca_param.get("comm.rejoin_timeout", 60.0))
         with self._rejoin_lock:
             evt = self._rejoin_evts.setdefault(rank, threading.Event())
-        return evt.wait(timeout)
+        if not evt.wait(timeout):
+            raise TimeoutError(
+                f"rank {self.rank}: no replacement for dead rank {rank} "
+                f"within {timeout:.1f}s — raise the comm.rejoin_timeout "
+                "MCA knob if the respawner needs longer")
+        return True
 
     def acknowledge_failure(self) -> None:
         self._peer_failure = None
@@ -998,16 +1014,29 @@ class SocketCommEngine(CommEngine):
         self._peer_failure = exc
         if self._barrier_waiting:
             self._barrier_release.set()
-        # abort active taskpools so ctx.wait raises instead of hanging
+        # abort active taskpools so ctx.wait raises instead of hanging.
+        # Serving isolation (ROADMAP item 4): a pool whose rank_scope
+        # excludes the dead peer cannot have tasks, tiles or edges on
+        # it — it keeps running, so one tenant's dead rank is a
+        # per-taskpool failure unit, not a context-wide fail-stop.
+        # scope None (the default) preserves the pre-serving behavior:
+        # every pool aborts.
         ctx = self._context
         pools = []
+        spared = 0
         if ctx is not None:
             with ctx._lock:
-                pools = list(ctx._active_taskpools)
+                for tp in ctx._active_taskpools:
+                    scope = getattr(tp, "rank_scope", None)
+                    if scope is not None and peer not in scope:
+                        spared += 1
+                        continue
+                    pools.append(tp)
         affected = bool(pools or doomed)
         if affected or self._barrier_waiting:
-            warning("comm", "%s — aborting %d taskpool(s), failing %d "
-                    "pending get(s)", exc, len(pools), len(doomed))
+            warning("comm", "%s — aborting %d taskpool(s) (%d scoped "
+                    "pool(s) unaffected), failing %d pending get(s)",
+                    exc, len(pools), spared, len(doomed))
         else:
             # nothing in flight (e.g. teardown race before _stop is
             # set locally): record quietly
@@ -1639,12 +1668,17 @@ class SocketCommEngine(CommEngine):
     def taskpool_registered(self, tp):
         if self._peer_failure is not None:
             # the mesh is already broken: a taskpool with remote deps
-            # would wait forever on the dead peer — fail it up front.
-            # False tells Context.add_taskpool to stop (no startup
-            # tasks, no on_enqueue) so nothing launches into the dead
-            # mesh and termination doesn't fire a second time
-            tp.abort(ConnectionError(str(self._peer_failure)))
-            return False
+            # would wait forever on the dead peer — fail it up front,
+            # UNLESS its rank_scope avoids every dead rank (serving:
+            # rank-local tenant pools keep launching while a broken
+            # tenant's ranks are down). False tells Context.add_taskpool
+            # to stop (no startup tasks, no on_enqueue) so nothing
+            # launches into the dead mesh and termination doesn't fire
+            # a second time
+            scope = getattr(tp, "rank_scope", None)
+            if scope is None or scope & set(self._dead_peers):
+                tp.abort(ConnectionError(str(self._peer_failure)))
+                return False
         # deliver ON THE COMM THREAD: a parked activation may have a
         # segment stream mid-reassembly there — delivering inline from
         # this (user) thread would race _on_data_seg/_finish_stream

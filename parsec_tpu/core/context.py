@@ -182,6 +182,9 @@ class Context:
         self._work_evt = threading.Event()
         self.grapher = None          # profiling.grapher hook
         self.trace = None            # profiling trace hook
+        self.serving = None          # serving.runtime.ServingRuntime
+        #                              (attached by serving.enable /
+        #                              first Context.submit)
         self.dfsan = None            # analysis.dfsan race sanitizer (PINS
         #                              module sets it; None = zero overhead)
         # PINS modules selected by the `pins` MCA param; must come after
@@ -313,6 +316,35 @@ class Context:
         dc.write_tile(key, staged)
         return staged
 
+    def submit(self, tp: Taskpool, tenant=None,
+               deadline_s: Optional[float] = None,
+               weight: Optional[float] = None,
+               rank_scope=None, hbm_bytes: int = 0):
+        """Serving-mode taskpool submission: route ``tp`` through the
+        multi-tenant serving runtime (admission control, weighted-fair
+        scheduling, per-submission deadline with cancellation, tenant
+        quarantine, overload shedding) and return a
+        :class:`~parsec_tpu.serving.runtime.Submission` handle. A
+        runtime with default knobs is attached on first use; call
+        :func:`parsec_tpu.serving.enable` first to configure tenants
+        and watermarks explicitly. Raises
+        :class:`~parsec_tpu.serving.runtime.AdmissionRejected` (window/
+        HBM/overload shed) or :class:`~parsec_tpu.serving.runtime.
+        TenantQuarantined` instead of parking unboundedly."""
+        if self.serving is None:
+            from ..serving.runtime import ServingRuntime
+            with self._lock:
+                # compare-and-set under the context lock: two client
+                # threads racing the first submit must share ONE
+                # runtime, or tenant windows/quarantines split across
+                # two disconnected tenant tables
+                if self.serving is None:
+                    ServingRuntime(self)     # attaches as self.serving
+        return self.serving.submit(tp, tenant=tenant,
+                                   deadline_s=deadline_s, weight=weight,
+                                   rank_scope=rank_scope,
+                                   hbm_bytes=hbm_bytes)
+
     def test(self) -> bool:
         """parsec_context_test analog: True iff all taskpools completed."""
         with self._lock:
@@ -340,6 +372,8 @@ class Context:
 
     def fini(self) -> None:
         """parsec_fini analog: drain and stop the workers."""
+        if self.serving is not None:
+            self.serving.shutdown()
         if self._ckpt is not None:
             # let an in-flight async save land — a torn final step would
             # be discarded by the atomic protocol, but the work is paid
@@ -411,7 +445,12 @@ class Context:
                 self._active_taskpools.remove(tp)
             except ValueError:
                 pass
-            if tp.error is not None and tp not in self._aborted:
+            if tp.error is not None and tp not in self._aborted and \
+                    not getattr(tp, "error_owned", False):
+                # error_owned: the serving runtime reports this pool's
+                # failure to ITS submitter (quarantine + Submission.wait)
+                # — a failed tenant must not poison an unrelated
+                # caller's Context.wait
                 self._aborted.append(tp)
             quiesced = not self._active_taskpools
             self._cv.notify_all()
@@ -509,6 +548,14 @@ class Context:
                     backoff = min(backoff * 2, backoff_max)
                     continue
             backoff = backoff_min
+            if task.taskpool.cancelled:
+                # cancelled pool (deadline expiry / Submission.cancel):
+                # drop instead of executing — covers the bypass slot and
+                # every scheduler; the decrement keeps the idempotent
+                # termdet counters consistent (a cancelled pool already
+                # force-terminated, refires are absorbed)
+                task.taskpool.addto_nb_tasks(-1)
+                continue
             es.stats["selected"] += 1
             try:
                 self._task_progress(es, task)

@@ -55,6 +55,12 @@ class SuccessorRef:
                                      # resolved before the value fans out
 
 
+class CancelledError(RuntimeError):
+    """A taskpool was cancelled (deadline expiry or explicit
+    Submission.cancel) — distinct from a body failure so serving-side
+    waiters can tell 'your deadline passed' from 'your code crashed'."""
+
+
 @dataclass
 class DataRef:
     """A terminal output dependency: write a value back to a collection
@@ -337,6 +343,24 @@ class Taskpool:
         self.error: Optional[BaseException] = None
         self._complete_evt = threading.Event()
         self.priority = 0
+        # cancellation (serving deadlines, Context.submit): when set,
+        # queued-but-not-running tasks are DROPPED at select time
+        # (scheduler/worker loop) instead of executed; in-flight tasks
+        # drain through the normal completion path. Set via cancel().
+        self.cancelled = False
+        # multi-tenant serving metadata. fair_weight drives the wfq
+        # scheduler's stride (sched/fair.py); tenant_name attributes
+        # per-tenant PINS accounting; rank_scope restricts which peer
+        # deaths can fail this pool (comm engines abort only pools
+        # whose scope contains the dead rank — None = every rank, the
+        # pre-serving fail-stop behavior).
+        self.fair_weight: float = 1.0
+        self.tenant_name: Optional[str] = None
+        self.rank_scope: Optional[frozenset] = None
+        # True when a supervisor (the serving runtime) owns this pool's
+        # error reporting: a failure then never lands in the context's
+        # aborted list, so other callers' Context.wait stays clean
+        self.error_owned = False
         # lineage record: (class name, locals) of every locally-completed
         # task (runtime.lineage) — after a peer death the survivors'
         # union of these is the completed-set input of
@@ -415,6 +439,20 @@ class Taskpool:
         if self.error is None:
             self.error = exc
         self._on_terminated()
+
+    def cancel(self, exc: Optional[BaseException] = None) -> None:
+        """Cancel this taskpool (serving deadlines / Context.submit):
+        not-yet-running tasks are dropped at select time (the
+        ``cancelled`` flag — schedulers and the worker loop decrement
+        ``nb_tasks`` instead of executing), in-flight tasks drain
+        through the normal completion path, and waiters are released
+        now via the abort machinery. Termination is idempotent (PR 6),
+        so draining tasks re-firing termdet cannot poison a later wait
+        on a DIFFERENT pool — cancellation is a per-taskpool failure
+        unit."""
+        self.cancelled = True
+        self.abort(exc if exc is not None
+                   else CancelledError(f"taskpool {self.name} cancelled"))
 
     @property
     def completed(self) -> bool:

@@ -71,3 +71,9 @@ class LocalCollection(DataCollection):
     def keys(self):
         with self._lock:
             return list(self._store.keys())
+
+    def drop_tile(self, key) -> None:
+        """Forget the tile at ``key`` (no-op when absent) — long-lived
+        serving collections reclaim finished requests' tiles."""
+        with self._lock:
+            self._store.pop(key, None)

@@ -199,6 +199,16 @@ class Taskpool(CoreTaskpool):
         self._window = int(mca_param.get("dtd.window_size", 4096))
         self._threshold = int(mca_param.get("dtd.threshold_size", 2048))
         self._closed = False
+        # multi-tenant serving hooks (serving/runtime.py). ``admission``
+        # is called with (taskpool, n_rows) BEFORE rows are inserted —
+        # it applies the tenant's cross-pool window: park briefly for
+        # backpressure, or raise AdmissionRejected when the tenant's
+        # queue depth / HBM reservation is exceeded (explicit rejection
+        # instead of unbounded parking). ``on_retire`` fires once per
+        # admitted row leaving flight (local completion or remote-shell
+        # handoff) so the tenant window drains.
+        self.admission = None
+        self.on_retire: Optional[Callable[["Taskpool"], None]] = None
         # per-stage overhead accounting (runtime.stage_timers /
         # profiling `overhead` module): wall time spent in insert_task
         # on the inserting thread(s)
@@ -454,6 +464,8 @@ class Taskpool(CoreTaskpool):
         timed = self.context is not None and self.context.stage_timers
         t0 = time.perf_counter() if timed else None
         self._check_insertable()
+        if self.admission is not None:
+            self.admission.admit(self, 1)
         tc = self._task_class_for(fn, self._shape_of(args), device,
                                   pure=pure)
         task = self._insert_one(tc, args, priority, None, None)
@@ -487,11 +499,20 @@ class Taskpool(CoreTaskpool):
         out: List[Optional[Task]] = []
         if not rows:
             return out
+        if self.admission is not None:
+            self.admission.admit(self, len(rows))
         shape0 = self._shape_of(rows[0])
         tc0 = self._task_class_for(fn, shape0, device, pure=pure)
         ready: List[Task] = []
         tile_cache: Dict[Any, _Tile] = {}
         for args in rows:
+            if self.error is not None:
+                # the pool failed mid-batch (poison body, peer death):
+                # flush what is already ready, then surface the abort to
+                # the inserter instead of feeding a dead pool
+                if ready:
+                    self.context.schedule(None, ready)
+                self._check_insertable()
             shape = self._shape_of(args)
             tc = tc0 if shape == shape0 else \
                 self._task_class_for(fn, shape, device, pure=pure)
@@ -551,7 +572,15 @@ class Taskpool(CoreTaskpool):
     def _throttle(self) -> None:
         """Sliding-window inserter throttle. The pre-check is lock-free
         (GIL-atomic int read) so an un-throttled insert never touches the
-        condition variable here."""
+        condition variable here.
+
+        Failure wakeup: an abort (poison body, peer death) sets
+        ``_closed`` and notifies under this CV (``_on_terminated``), so
+        a parked inserter is released EVENT-DRIVEN — and then raises the
+        pool's error instead of silently resuming inserts into a dead
+        pool. Waiter registration and the completer's notify share the
+        CV lock, so no wakeup can be lost; the residual timeout is a
+        belt-and-braces bound, not the exit mechanism."""
         if self._inflight < self._window:
             return
         with self._inflight_cv:
@@ -560,9 +589,12 @@ class Taskpool(CoreTaskpool):
             self._throttle_waiters += 1
             try:
                 while self._inflight > self._threshold and not self._closed:
-                    self._inflight_cv.wait(timeout=0.05)
+                    self._inflight_cv.wait(timeout=0.25)
             finally:
                 self._throttle_waiters -= 1
+        if self.error is not None:
+            raise RuntimeError(
+                f"taskpool {self.name} aborted: {self.error}") from self.error
 
     def _insert_one(self, tc: TaskClass, args, priority: int,
                     ready_out: Optional[List[Task]],
@@ -577,6 +609,10 @@ class Taskpool(CoreTaskpool):
             target_rank = self._placement(args)
             if target_rank != my_rank:
                 self._insert_shell(seq, target_rank, args, priority)
+                if self.on_retire is not None:
+                    # a shell never enters local flight: retire the
+                    # admitted row now so the tenant window drains
+                    self.on_retire(self)
                 return None
 
         task = Task(self, tc, (seq,), priority=priority)
@@ -828,9 +864,12 @@ class Taskpool(CoreTaskpool):
             # notify only when an inserter is actually parked in the
             # window throttle (or the pool is draining) — notify_all per
             # completion is pure overhead on the release hot path; the
-            # throttle's 50 ms poll bounds a lost race harmlessly
+            # waiter registers under this CV before waiting, so the
+            # conditional notify cannot lose a wakeup
             if self._throttle_waiters or self._closed:
                 self._inflight_cv.notify_all()
+        if self.on_retire is not None:
+            self.on_retire(self)
         return refs
 
     # -------------------------------------------------------------- drain

@@ -26,7 +26,8 @@ from ..utils.debug import debug_verbose
 mca_param.register("pins", "",
                    help="comma-separated PINS modules to install at init "
                         "(task_profiler, print_steals, alperf, "
-                        "iterators_checker, counters, overhead, dfsan)")
+                        "iterators_checker, counters, overhead, tenant, "
+                        "dfsan)")
 
 
 class PinsModule:
@@ -336,6 +337,57 @@ class OverheadProfiler(PinsModule):
         return agg
 
 
+class TenantAccounting(PinsModule):
+    """Per-tenant service accounting for the multi-tenant serving
+    runtime (ROADMAP item 4): executed tasks and cumulative body
+    seconds attributed to each taskpool's ``tenant_name`` (pools
+    outside the serving runtime land under ``(untenanted)``), merged
+    with the wfq scheduler's per-pool selection counters when that
+    scheduler is installed — the evidence that makes starvation
+    measurable rather than anecdotal."""
+
+    name = "tenant"
+
+    def install(self, context) -> "TenantAccounting":
+        super().install(context)
+        self._lock = threading.Lock()
+        self._rows: Dict[str, Dict[str, float]] = defaultdict(
+            lambda: {"tasks": 0, "body_s": 0.0})
+        self._sub(PinsEvent.EXEC_BEGIN, self._begin)
+        self._sub(PinsEvent.EXEC_END, self._end)
+        return self
+
+    @staticmethod
+    def _tenant_of(task) -> str:
+        return getattr(task.taskpool, "tenant_name", None) or \
+            "(untenanted)"
+
+    def _begin(self, es, task) -> None:
+        task.prof["tenant_t0"] = time.perf_counter()
+
+    def _end(self, es, task) -> None:
+        t0 = task.prof.pop("tenant_t0", None)
+        dt = 0.0 if t0 is None else time.perf_counter() - t0
+        with self._lock:
+            row = self._rows[self._tenant_of(task)]
+            row["tasks"] += 1
+            row["body_s"] += dt
+
+    def report(self) -> Dict[str, Any]:
+        with self._lock:
+            out = {"tenants": {k: dict(v) for k, v in self._rows.items()}}
+        sched = self.context.scheduler
+        if hasattr(sched, "pool_stats"):
+            # fold wfq's selection/backlog view in per tenant
+            for row in sched.pool_stats().values():
+                ten = row.get("tenant") or "(untenanted)"
+                t = out["tenants"].setdefault(ten, {"tasks": 0,
+                                                    "body_s": 0.0})
+                t["selected"] = t.get("selected", 0) + row["selected"]
+                t["pending"] = t.get("pending", 0) + row["pending"]
+        return out
+
+
 _MODULES = {
     "task_profiler": TaskProfiler,
     "print_steals": PrintSteals,
@@ -343,6 +395,7 @@ _MODULES = {
     "iterators_checker": IteratorsChecker,
     "counters": Counters,
     "overhead": OverheadProfiler,
+    "tenant": TenantAccounting,
 }
 
 

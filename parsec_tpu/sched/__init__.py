@@ -16,9 +16,11 @@ from .local_queues import LFQScheduler, LLScheduler, LLPScheduler, \
     PBQScheduler, LTQScheduler, LHQScheduler
 from .global_queues import APScheduler, IPScheduler, GDScheduler, \
     SPQScheduler, RNDScheduler
+from .fair import WFQScheduler
 from ..utils import mca_param
 
 _MODULES = {
+    "wfq": WFQScheduler,   # weighted-fair across taskpools (serving)
     "lfq": LFQScheduler,   # local flat queues + hierarchical steal
     "lhq": LHQScheduler,   # local hierarchical queues
     "ltq": LTQScheduler,   # local tree queues

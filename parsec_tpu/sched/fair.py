@@ -1,0 +1,163 @@
+"""Weighted-fair scheduler across live taskpools (``sched=wfq``).
+
+The reference schedulers arbitrate between THREADS (steal orders); every
+taskpool's tasks land in the same queues, so one tenant inserting faster
+than another simply owns the runtime — the starvation mode ROADMAP item 4
+names. This module arbitrates between TASKPOOLS: each live pool keeps its
+own FIFO ring and the selector runs stride scheduling (Waldspurger-style)
+over them — pool p is charged ``STRIDE1 / weight(p)`` virtual time per
+selected task, and select() always picks the backlogged pool with the
+least virtual time. Long-run service is proportional to
+``Taskpool.fair_weight`` regardless of insertion rates, and a freshly
+backlogged pool joins at the current virtual floor (start-time fairness:
+it cannot retro-claim idle time and monopolize the streams).
+
+Starvation is measurable, not anecdotal: per-pool counters (enqueued /
+selected / pending / virtual pass, plus the last-selected wall clock) are
+exported via :meth:`WFQScheduler.pool_stats` and surfaced by the
+``tenant`` PINS module and ``bench.py --section serving``.
+
+One global lock serializes the queue set. That is the right trade for the
+serving shape this scheduler exists for — many concurrent tenants whose
+task bodies dwarf the pop — and keeps selection O(live pools). The
+throughput-bench schedulers (lfq & co) remain the default elsewhere.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional, Sequence
+
+from .base import Scheduler
+from ..core.task import Task
+
+#: virtual-time quantum charged to a weight-1.0 pool per selected task
+_STRIDE1 = 1 << 20
+
+
+class _PoolQueue:
+    __slots__ = ("dq", "vpass", "enqueued", "selected", "last_selected_t")
+
+    def __init__(self, vfloor: float):
+        self.dq = deque()
+        self.vpass = vfloor
+        self.enqueued = 0
+        self.selected = 0
+        self.last_selected_t = 0.0
+
+
+class WFQScheduler(Scheduler):
+    """Weighted-fair (stride) selection across live taskpools."""
+
+    name = "wfq"
+
+    def install(self, context) -> None:
+        super().install(context)
+        self._lock = threading.Lock()
+        self._queues: Dict[object, _PoolQueue] = {}   # taskpool -> queue
+        # global virtual clock: the vpass the last selection served at.
+        # Non-decreasing (select always takes the minimum pass), and it
+        # PERSISTS across idle instants — a pool created or rejoining
+        # after the queues momentarily drained joins HERE, not at 0,
+        # which would let it monopolize selection until it caught up
+        # with the long-lived pools' accumulated vpass.
+        self._vclock = 0.0
+
+    def flow_init(self, es) -> None:
+        es.sched_obj = None          # no per-stream structure
+
+    def _vfloor_locked(self) -> float:
+        """Join point for pools becoming backlogged: the global virtual
+        clock (see install) — never 0-reset by an idle instant."""
+        return self._vclock
+
+    def schedule(self, es, tasks: Sequence[Task], distance: int = 0) -> None:
+        with self._lock:
+            floor = self._vfloor_locked()
+            for t in tasks:
+                q = self._queues.get(t.taskpool)
+                if q is None:
+                    q = self._queues[t.taskpool] = _PoolQueue(floor)
+                elif not q.dq:
+                    # idle pool rejoining: forfeit accumulated lag so it
+                    # cannot burst past active pools (start-time fairness)
+                    q.vpass = max(q.vpass, floor)
+                q.dq.append(t)
+                q.enqueued += 1
+
+    def _drop_cancelled_locked(self, tp, q: _PoolQueue) -> None:
+        n = len(q.dq)
+        q.dq.clear()
+        del self._queues[tp]
+        for _ in range(n):
+            # idempotent-termination contract: the cancelled pool already
+            # force-terminated; these decrements only drain its counters
+            tp.addto_nb_tasks(-1)
+
+    def select(self, es) -> Optional[Task]:
+        with self._lock:
+            # a persistent serving context sees thousands of pools over
+            # its lifetime: drop the bookkeeping of finished ones here
+            # (empty queue + terminated pool) or _queues grows forever
+            done = [tp for tp, q in self._queues.items()
+                    if not q.dq and (tp.completed or tp.cancelled)]
+            for tp in done:
+                del self._queues[tp]
+            while True:
+                best_tp, best_q = None, None
+                for tp, q in self._queues.items():
+                    if not q.dq:
+                        continue
+                    if tp.cancelled:
+                        self._drop_cancelled_locked(tp, q)
+                        break        # dict mutated: rescan
+                    if best_q is None or q.vpass < best_q.vpass:
+                        best_tp, best_q = tp, q
+                else:
+                    if best_q is None:
+                        return None
+                    task = best_q.dq.popleft()
+                    if best_q.vpass > self._vclock:
+                        self._vclock = best_q.vpass
+                    w = max(float(getattr(best_tp, "fair_weight", 1.0)),
+                            1e-6)
+                    best_q.vpass += _STRIDE1 / w
+                    best_q.selected += 1
+                    best_q.last_selected_t = time.monotonic()
+                    return task
+
+    def pending_tasks(self) -> int:
+        with self._lock:
+            return sum(len(q.dq) for q in self._queues.values())
+
+    def pool_stats(self) -> Dict[str, Dict[str, float]]:
+        """Per-pool service accounting keyed by taskpool name — the
+        starvation evidence (selected vs enqueued vs pending, and how
+        stale the pool's last service is)."""
+        now = time.monotonic()
+        out: Dict[str, Dict[str, float]] = {}
+        with self._lock:
+            for tp, q in self._queues.items():
+                key = tp.name
+                if key in out:
+                    # Taskpool names are not unique: suffix collisions
+                    # so no pool's starvation row shadows another's
+                    key = f"{tp.name}#{tp.taskpool_id}"
+                out[key] = {
+                    "tenant": getattr(tp, "tenant_name", None),
+                    "weight": float(getattr(tp, "fair_weight", 1.0)),
+                    "enqueued": q.enqueued,
+                    "selected": q.selected,
+                    "pending": len(q.dq),
+                    "vpass": q.vpass,
+                    "since_selected_s": (
+                        round(now - q.last_selected_t, 6)
+                        if q.last_selected_t else None),
+                }
+        return out
+
+    def remove(self, context) -> None:
+        with self._lock:
+            self._queues.clear()

@@ -103,10 +103,20 @@ class _LocalQueueScheduler(Scheduler):
         return q.pop_back()
 
     def select(self, es) -> Optional[Task]:
-        t = self._pop_local(es.sched_obj)
-        if t is not None:
+        while True:
+            t = self._pop_local(es.sched_obj)
+            if t is None:
+                t = self._steal_and_system(es)
+            if t is not None and \
+                    getattr(getattr(t, "taskpool", None), "cancelled",
+                            False):
+                # cancelled pool (serving deadline): drop and keep
+                # selecting — the decrement drains the already-
+                # terminated pool's idempotent termdet counters
+                # (getattr: fidelity harnesses feed bare fake tasks)
+                t.taskpool.addto_nb_tasks(-1)
+                continue
             return t
-        return self._steal_and_system(es)
 
     def _steal_and_system(self, es) -> Optional[Task]:
         """Steal from VP peers (topology-fixed order, precomputed

@@ -1,0 +1,24 @@
+"""Multi-tenant serving runtime (ROADMAP item 4).
+
+PaRSEC assumes one application driving one context; this package turns a
+persistent :class:`~parsec_tpu.core.context.Context` into a shared
+service: many client threads submit taskpools concurrently through
+``Context.submit`` while the runtime enforces per-tenant admission
+windows with backpressure (grown from the PR 3 DTD insertion throttle),
+weighted-fair selection across live taskpools (``sched=wfq``),
+per-submission deadlines with cancellation, tenant quarantine on
+failure (poison bodies, lint-gate refusals, rank death), and open-loop
+load shedding under overload — so no tenant can wedge, starve, or crash
+another.
+
+The proving workload is the Orca-style continuous-batching transformer
+decode loop in :mod:`.decode` (KV cache as a tiled collection under the
+HBM budget manager, per-request decode steps as DTD insertions), benched
+by ``bench.py --section serving`` via :mod:`.serving_bench`.
+"""
+
+from .runtime import (AdmissionRejected, DeadlineExceeded, ServingRuntime,
+                      Submission, Tenant, TenantQuarantined, enable)
+
+__all__ = ["AdmissionRejected", "DeadlineExceeded", "ServingRuntime",
+           "Submission", "Tenant", "TenantQuarantined", "enable"]

@@ -1,0 +1,460 @@
+"""Continuous-batching transformer decode over DTD insertions.
+
+The workload the north star implies (ROADMAP item 4, Orca-style
+iteration-level scheduling): each live request advances one decode step
+per iteration; steps are DTD task insertions whose INOUT chain on the
+request's state tile serializes its own steps while steps of DIFFERENT
+requests (and different tenants' pools) interleave freely under the
+weighted-fair scheduler — the runtime's dataflow tracking IS the
+continuous batcher.
+
+- **KV cache as a tiled collection**: per (request, tile-index) tiles of
+  ``(2, kv_tile, D)`` packed keys+values in a
+  :class:`KVCacheCollection`; device-resident tiles are registered with
+  the context's HBM budget manager (``device.hbm_budget_mb``) with
+  next-use hints, so under memory pressure the plan-informed (Belady)
+  ranking evicts the coldest cache tiles and a finished request's tiles
+  are dropped outright.
+- **Decode steps as DTD insertions**: step *t* reads the full prior
+  cache (INPUT tiles), appends its (k, v) into the tail tile (INOUT)
+  and rewrites the state vector (INOUT); the shared step kernel
+  (:func:`_step_kernel`) is also what the bitwise reference replays, so
+  "bitwise-correct under faults" is checked against the exact float32
+  op sequence, not a tolerance.
+- **Long contexts**: prompt prefill builds the whole prompt's KV cache
+  and first state with ONE compiled attention call —
+  :func:`~parsec_tpu.compiled.ring_attention.ring_attention` over a
+  mesh when one is given (sequence-sharded ppermute ring), the dense
+  jnp fold otherwise.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..data.collection import LocalCollection
+from ..dsl import dtd
+
+
+class PoisonBody(ValueError):
+    """Deliberate task-body failure injected by a misbehaving tenant
+    (the serving bench's poison traffic)."""
+
+
+@dataclass
+class DecodeConfig:
+    d_model: int = 32
+    n_heads: int = 2
+    kv_tile: int = 8          # (k, v) pairs per cache tile
+    seed: int = 7
+
+    @property
+    def d_head(self) -> int:
+        return self.d_model // self.n_heads
+
+
+class DecodeModel:
+    """Deterministic float32 decode-step weights."""
+
+    def __init__(self, cfg: DecodeConfig):
+        rng = np.random.default_rng(cfg.seed)
+        D = cfg.d_model
+
+        def w(shape):
+            return (rng.standard_normal(shape) * 0.25 /
+                    math.sqrt(shape[0])).astype(np.float32)
+
+        self.cfg = cfg
+        self.Wq, self.Wk, self.Wv, self.Wo = (w((D, D)) for _ in range(4))
+        self.W1 = w((D, 2 * D))
+        self.W2 = w((2 * D, D))
+
+    def init_state(self, rid: int) -> np.ndarray:
+        rng = np.random.default_rng(self.cfg.seed * 1_000_003 + rid)
+        return rng.standard_normal(self.cfg.d_model).astype(np.float32)
+
+
+def _ffn_tail(x: np.ndarray, ctx_vec: np.ndarray,
+              model: DecodeModel) -> np.ndarray:
+    """Output projection + residual FFN + tanh over one position's
+    attention context — shared by the stepwise decode kernel and the
+    compiled prompt prefill so both land on the same float32 tail."""
+    o = ctx_vec @ model.Wo
+    h1 = x + o
+    h2 = h1 + np.maximum(h1 @ model.W1, np.float32(0.0)) @ model.W2
+    return np.tanh(h2)
+
+
+def _attend(x: np.ndarray, K: np.ndarray, V: np.ndarray,
+            model: DecodeModel) -> np.ndarray:
+    """One decode attention + FFN step over the cached (K, V) rows —
+    float32 throughout, fixed op order (the bitwise contract both the
+    task body and the reference replay)."""
+    cfg = model.cfg
+    H, dh = cfg.n_heads, cfg.d_head
+    q = (x @ model.Wq).reshape(H, dh)
+    Kh = K.reshape(K.shape[0], H, dh)
+    Vh = V.reshape(V.shape[0], H, dh)
+    ctx = np.empty((H, dh), dtype=np.float32)
+    scale = np.float32(1.0 / math.sqrt(dh))
+    for h in range(H):
+        s = (Kh[:, h, :] @ q[h]) * scale
+        m = np.float32(s.max())
+        e = np.exp(s - m, dtype=np.float32)
+        w = e / np.float32(e.sum())
+        ctx[h] = w @ Vh[:, h, :]
+    return _ffn_tail(x, ctx.reshape(H * dh), model)
+
+
+def _step_kernel(x: np.ndarray, prevs: List[np.ndarray],
+                 tail: np.ndarray, slot: int, model: DecodeModel):
+    """Shared decode-step kernel: append (k, v) of ``x`` at ``slot`` of
+    the tail tile, attend over the full cache, return (new state, new
+    tail). Functional: the tail is copied, never mutated in place
+    (snapshot readers of the prior version stay valid — the DTD
+    functional-body contract)."""
+    k = x @ model.Wk
+    v = x @ model.Wv
+    tail = tail.copy()
+    tail[0, slot] = k
+    tail[1, slot] = v
+    if prevs:
+        K = np.concatenate([p[0] for p in prevs] + [tail[0, :slot + 1]],
+                           axis=0)
+        V = np.concatenate([p[1] for p in prevs] + [tail[1, :slot + 1]],
+                           axis=0)
+    else:
+        K = tail[0, :slot + 1]
+        V = tail[1, :slot + 1]
+    return _attend(x, K, V, model), tail
+
+
+def _decode_body(state, tail, *rest):
+    """DTD task body of one decode step. ``rest`` = the request's prior
+    (full) KV tiles, then the per-step meta dict (ValueArg)."""
+    prevs, meta = list(rest[:-1]), rest[-1]
+    t = meta["t"]
+    if meta.get("poison_at") is not None and t == meta["poison_at"]:
+        raise PoisonBody(
+            f"poison body: request {meta['req']} step {t}")
+    return _step_kernel(state, prevs, tail, meta["slot"], meta["model"])
+
+
+def _done_body(state, meta):
+    """Completion sentinel: an INPUT-only reader of the request's state
+    tile, RAW-chained behind the final decode step — so it runs
+    strictly AFTER the runtime wrote the final step's outputs back to
+    the collections. Recording completion from the final step's own
+    body would fire BEFORE its write-back, racing any cleanup."""
+    done = meta.get("on_done")
+    if done is not None:
+        done(meta["req"], state)
+
+
+def _prompt_of(model: DecodeModel, rid: int, prompt_len: int) -> np.ndarray:
+    rng = np.random.default_rng(model.cfg.seed * 7_919 + rid)
+    return rng.standard_normal(
+        (prompt_len, model.cfg.d_model)).astype(np.float32)
+
+
+def _prefill_request(model: DecodeModel, rid: int, prompt_len: int,
+                     mesh=None):
+    """Prompt prefill for one request: K/V of every prompt position
+    (packed into whole leading KV tiles by the caller) and the initial
+    decode state — the LAST position's attention context from ONE
+    compiled attention call (:func:`prefill_attention`: ring over a
+    mesh, dense otherwise) folded through the shared FFN tail. Returns
+    ``(x0, K, V)`` as float32 numpy; deterministic per (model, rid,
+    backend), so the reference replay reproduces it bitwise."""
+    cfg = model.cfg
+    if prompt_len % cfg.kv_tile:
+        raise ValueError(
+            f"prompt_len {prompt_len} must be a multiple of kv_tile "
+            f"{cfg.kv_tile} (whole prefilled cache tiles)")
+    prompt = _prompt_of(model, rid, prompt_len)
+    K = prompt @ model.Wk
+    V = prompt @ model.Wv
+    ctx_rows = prefill_attention(model, prompt, mesh=mesh, causal=True)
+    x0 = _ffn_tail(prompt[-1], ctx_rows[-1], model)
+    return x0, K, V
+
+
+def _packed_tiles(model: DecodeModel, K: np.ndarray,
+                  V: np.ndarray) -> List[np.ndarray]:
+    cfg = model.cfg
+    kt = cfg.kv_tile
+    tiles = []
+    for j in range(K.shape[0] // kt):
+        tile = np.zeros((2, kt, cfg.d_model), dtype=np.float32)
+        tile[0] = K[j * kt:(j + 1) * kt]
+        tile[1] = V[j * kt:(j + 1) * kt]
+        tiles.append(tile)
+    return tiles
+
+
+def reference_decode(model: DecodeModel, rid: int, n_steps: int,
+                     prompt_len: int = 0, mesh=None) -> np.ndarray:
+    """Single-threaded replay of ``n_steps`` decode steps for request
+    ``rid`` (after an optional prompt prefill) through the SAME kernels
+    the engine runs — the bitwise oracle."""
+    cfg = model.cfg
+    if prompt_len:
+        x, K, V = _prefill_request(model, rid, prompt_len, mesh=mesh)
+        tiles = _packed_tiles(model, K, V)
+    else:
+        x = model.init_state(rid)
+        tiles: List[np.ndarray] = []
+    for t in range(prompt_len, prompt_len + n_steps):
+        j, slot = divmod(t, cfg.kv_tile)
+        if slot == 0:
+            tiles.append(np.zeros((2, cfg.kv_tile, cfg.d_model),
+                                  dtype=np.float32))
+        x, tiles[j] = _step_kernel(x, tiles[:j], tiles[j], slot, model)
+    return x
+
+
+# --------------------------------------------------------------- prefill
+def prefill_attention(model: DecodeModel, prompt: np.ndarray,
+                      mesh=None, causal: bool = True) -> np.ndarray:
+    """Long-context prompt prefill: one compiled attention call over the
+    whole prompt ``(S, D)`` — ring attention (sequence-sharded ppermute
+    ring, ``compiled/ring_attention.py``) when a mesh is given, the
+    dense jnp fold otherwise. Returns the attention output ``(S, D)``
+    as float32 numpy."""
+    import jax.numpy as jnp
+    from ..compiled.ring_attention import dense_attention, ring_attention
+    cfg = model.cfg
+    S = prompt.shape[0]
+    H, dh = cfg.n_heads, cfg.d_head
+    Q = (prompt @ model.Wq).reshape(S, H, dh)
+    K = (prompt @ model.Wk).reshape(S, H, dh)
+    V = (prompt @ model.Wv).reshape(S, H, dh)
+    if mesh is not None:
+        out = ring_attention(jnp.asarray(Q), jnp.asarray(K),
+                             jnp.asarray(V), mesh, causal=causal)
+    else:
+        out = dense_attention(jnp.asarray(Q), jnp.asarray(K),
+                              jnp.asarray(V), causal=causal)
+    return np.asarray(out, dtype=np.float32).reshape(S, H * dh)
+
+
+# ------------------------------------------------------------ collections
+class KVCacheCollection(LocalCollection):
+    """Dict-backed KV cache whose device-resident tiles are registered
+    with the HBM budget manager: every write refreshes the tile's
+    next-use hint (a live request touches its whole cache again next
+    step), so the Belady ranking evicts the longest-idle cache tiles
+    first and :meth:`drop_request` releases a finished request's tiles
+    outright. Host (numpy) tiles pass through untracked."""
+
+    def __init__(self, name: str, hbm=None):
+        super().__init__(name)
+        self.hbm = hbm
+        self._clock = 0
+
+    def _mkey(self, key):
+        return (id(self), tuple(key))
+
+    def write_tile(self, key, value) -> None:
+        super().write_tile(key, value)
+        hbm = self.hbm
+        if hbm is None or not isinstance(value, hbm.jax.Array):
+            return
+        self._clock += 1
+
+        def _spill(_k, host, dc=self, key=key):
+            LocalCollection.write_tile(dc, key, host)
+
+        try:
+            hbm.put(self._mkey(key), value, next_use=self._clock + 1,
+                    spill=_spill)
+        except MemoryError:
+            pass                      # tile bigger than the whole budget
+
+    def drop_request(self, rid: int) -> None:
+        """Release a finished request's cache: HBM-manager entries AND
+        the host tiles (a persistent serving engine would otherwise
+        grow by one request's KV forever)."""
+        for key in self.keys():
+            if key[0] == rid:
+                if self.hbm is not None:
+                    self.hbm.drop(self._mkey(key))
+                self.drop_tile(key)
+
+
+# ---------------------------------------------------------------- engine
+@dataclass
+class PendingRequest:
+    rid: int
+    n_steps: int
+    submitted_t: float
+    prompt_len: int = 0
+    mesh: object = None
+    done_evt: threading.Event = field(default_factory=threading.Event)
+    finished_t: Optional[float] = None
+    result: Optional[np.ndarray] = None
+
+    def latency_s(self) -> Optional[float]:
+        return (self.finished_t - self.submitted_t
+                if self.finished_t is not None else None)
+
+
+class DecodeEngine:
+    """Continuous-batching decode front end for ONE tenant.
+
+    ``start()`` submits a persistent DTD pool through the serving
+    runtime; ``request()`` inserts a request's decode steps (admission
+    control applies per insert — :class:`~.runtime.AdmissionRejected`
+    propagates to the caller); completion is detected per request by
+    the final step's body callback, so per-request latency is
+    end-to-end through the runtime, not a wrapper around wait()."""
+
+    def __init__(self, ctx, name: str, cfg: Optional[DecodeConfig] = None,
+                 tenant=None, model: Optional[DecodeModel] = None,
+                 **submit_kwargs):
+        self.ctx = ctx
+        self.name = name
+        self.cfg = cfg or DecodeConfig()
+        self.model = model or DecodeModel(self.cfg)
+        self.tenant = tenant
+        self.submit_kwargs = submit_kwargs
+        self.state = LocalCollection(f"{name}_state")
+        self.kv = KVCacheCollection(f"{name}_kv", hbm=ctx.hbm)
+        self.tp = None
+        self.submission = None
+        self.pending: Dict[int, PendingRequest] = {}
+        self._lock = threading.Lock()
+
+    def start(self) -> "DecodeEngine":
+        self.tp = dtd.Taskpool(f"{self.name}_decode")
+        self.submission = self.ctx.submit(self.tp, tenant=self.tenant,
+                                          **self.submit_kwargs)
+        return self
+
+    def _on_done(self, rid: int, h: np.ndarray) -> None:
+        # record only — tile cleanup happens in release(): this runs
+        # INSIDE the final step's body, before the runtime writes the
+        # step's outputs back, so dropping tiles here would race the
+        # completion write-back
+        with self._lock:
+            req = self.pending.get(rid)
+        if req is not None:
+            req.finished_t = time.monotonic()
+            req.result = h
+            req.done_evt.set()
+
+    def request(self, rid: int, n_steps: int,
+                poison_at: Optional[int] = None,
+                prompt_len: int = 0, mesh=None) -> PendingRequest:
+        """Admit one request and insert its decode steps. With
+        ``prompt_len`` (a multiple of ``kv_tile``) the prompt's
+        attention runs as ONE compiled prefill call (ring attention
+        over ``mesh`` when given, dense otherwise) that SEEDS the
+        request's KV cache tiles and initial state; the stepwise decode
+        then attends over prompt + generated positions."""
+        cfg, model = self.cfg, self.model
+        req = PendingRequest(rid, n_steps, time.monotonic(),
+                             prompt_len=prompt_len, mesh=mesh)
+        with self._lock:
+            self.pending[rid] = req
+        if prompt_len:
+            x0, K, V = _prefill_request(model, rid, prompt_len,
+                                        mesh=mesh)
+            prefilled = _packed_tiles(model, K, V)
+        else:
+            x0, prefilled = model.init_state(rid), []
+        self.state.write_tile((rid,), x0)
+        for j, tile in enumerate(prefilled):
+            self.kv.write_tile((rid, j), tile)
+        t0 = prompt_len
+        n_tiles = (t0 + n_steps + cfg.kv_tile - 1) // cfg.kv_tile
+        for j in range(len(prefilled), n_tiles):
+            self.kv.write_tile((rid, j), np.zeros(
+                (2, cfg.kv_tile, cfg.d_model), dtype=np.float32))
+        rows = []
+        for t in range(t0, t0 + n_steps):
+            j, slot = divmod(t, cfg.kv_tile)
+            args = [dtd.TileArg(self.state, (rid,), dtd.INOUT),
+                    dtd.TileArg(self.kv, (rid, j), dtd.INOUT)]
+            args += [dtd.TileArg(self.kv, (rid, jj), dtd.INPUT)
+                     for jj in range(j)]
+            args.append(dtd.ValueArg({
+                "req": rid, "t": t, "slot": slot,
+                "model": model, "poison_at": poison_at}))
+            rows.append(args)
+        try:
+            self.tp.insert_tasks(_decode_body, rows)
+            # completion sentinel (see _done_body): post-write-back
+            self.tp.insert_task(
+                _done_body, dtd.TileArg(self.state, (rid,), dtd.INPUT),
+                dtd.ValueArg({"req": rid, "on_done": self._on_done}))
+        except Exception:
+            # rejected insert (admission window, quarantine, aborted
+            # pool): release the tiles written above too, or every
+            # rejected rid of an open-loop stream leaks one state +
+            # n_tiles KV tiles into the persistent collections
+            with self._lock:
+                self.pending.pop(rid, None)
+            self.kv.drop_request(rid)
+            self.state.drop_tile((rid,))
+            raise
+        return req
+
+    def drain(self, timeout: float = 60.0,
+              prune: bool = True) -> List[PendingRequest]:
+        """Wait for every pending request; returns the finished ones
+        (requests of an aborted/cancelled pool stay unfinished). With
+        ``prune`` (default) the finished requests are released — their
+        state/KV tiles and bookkeeping are reclaimed, which is what
+        keeps a persistent engine's footprint bounded under an
+        open-loop stream; results stay on the returned handles for
+        verification."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            reqs = list(self.pending.values())
+        for req in reqs:
+            left = max(0.0, deadline - time.monotonic())
+            req.done_evt.wait(left)
+            if self.tp is not None and self.tp.error is not None:
+                break
+        finished = [r for r in reqs if r.done_evt.is_set()]
+        if prune:
+            for r in finished:
+                self.release(r)
+        return finished
+
+    def release(self, req: PendingRequest) -> None:
+        """Reclaim one collected request: pending-table entry, state
+        tile, and KV cache tiles (host + HBM-manager entries).
+        ``req.result`` survives for verification."""
+        with self._lock:
+            self.pending.pop(req.rid, None)
+        self.kv.drop_request(req.rid)
+        self.state.drop_tile((req.rid,))
+
+    def verify(self, req: PendingRequest) -> bool:
+        """Bitwise check of a finished request against the reference
+        replay (same float32 kernels — prefill included — same op
+        order)."""
+        ref = reference_decode(self.model, req.rid, req.n_steps,
+                               prompt_len=req.prompt_len, mesh=req.mesh)
+        return req.result is not None and \
+            req.result.shape == ref.shape and \
+            bool(np.all(req.result == ref))
+
+    def close(self) -> None:
+        """Drain and retire the engine's pool (aborted pools count as
+        already drained)."""
+        tp = self.tp
+        if tp is None or tp.completed:
+            return
+        try:
+            tp.wait()
+        except RuntimeError:
+            pass                      # aborted/cancelled pools: done

@@ -257,6 +257,62 @@ def test_amort_probe_zero_recompile_smoke(tmp_path):
         jax.config.update("jax_compilation_cache_dir", prev)
 
 
+def test_zero_baseline_latency_rows_fire_absolutely():
+    """The compile-count guard keys are 0 in every healthy capture —
+    a relative rise can never fire on a zero prior, so any nonzero
+    current value must fire absolutely (the 'warm stays at ZERO
+    compiles' guard would otherwise be structurally dead)."""
+    bench = _load_bench()
+    prior = {"amort_panel_warm_compiles": 0.0, "rdv_1M_p50_us": 3600.0}
+    out = bench._compare_captures(
+        {"amort_panel_warm_compiles": 46.0, "rdv_1M_p50_us": 3600.0},
+        prior)
+    assert "amort_panel_warm_compiles" in out["latency_regression"]
+    assert "zero-baseline" in out["latency_regression"]
+    # 0 -> 0 stays quiet
+    assert bench._compare_captures(
+        {"amort_panel_warm_compiles": 0.0}, prior) == {}
+
+
+def test_serving_section_registered():
+    """--section serving is a first-class section: registry, compact
+    summary and both regression guards stay wired together (ISSUE 8
+    bench contract: requests/s rides throughput_regression, p99 rides
+    the latency rise-guard)."""
+    bench = _load_bench()
+    assert "serving" in bench.SECTIONS
+    assert bench._SECTION_KEYS["serving"] == ("serving",)
+    assert "serving_requests_per_sec" in bench._GFLOPS_GUARD_KEYS
+    assert "serving_p99_ms" in bench._LATENCY_GUARD_KEYS
+    result = _fat_result()
+    result["detail"]["extra_configs"]["serving"] = {
+        "requests_per_sec": 55.7, "p99_ms": 13.7,
+        "p99_ratio_worst": 0.92, "shed_count": 20,
+        "quarantine_count": 2, "isolation_check": "OK"}
+    compact = json.loads(bench._compact_summary(result))
+    d = compact["detail"]
+    assert d["serving_requests_per_sec"] == 55.7
+    assert d["serving_p99_ms"] == 13.7
+    assert d["serving_p99_ratio"] == 0.92
+    assert d["serving_shed"] == 20
+    assert d["serving_quarantined"] == 2
+    assert d["serving_isolation"] == "OK"
+
+
+def test_serving_guard_rows_fire_in_both_directions():
+    bench = _load_bench()
+    prior = {"serving_requests_per_sec": 50.0, "serving_p99_ms": 10.0}
+    out = bench._compare_captures(
+        {"serving_requests_per_sec": 40.0, "serving_p99_ms": 13.0},
+        prior)
+    assert "serving_requests_per_sec" in out["throughput_regression"]
+    assert "serving_p99_ms" in out["latency_regression"]
+    # within-band changes stay quiet
+    assert bench._compare_captures(
+        {"serving_requests_per_sec": 49.0, "serving_p99_ms": 10.5},
+        prior) == {}
+
+
 def test_amort_section_registered():
     """compile_amortization is a first-class section: registry, error
     keys, and the compact-summary/guard keys stay wired together."""

@@ -1,0 +1,678 @@
+"""Multi-tenant serving runtime (ISSUE 8 / ROADMAP item 4).
+
+Covers: weighted-fair (stride) selection across taskpools, per-tenant
+admission windows with backpressure and explicit rejection, deadline
+cancellation that cannot poison other tenants, quarantine on poison
+bodies and lint-gate refusals, overload shedding, the tenant PINS
+accounting, the waiter-wakeup-on-failure regression (a poison body must
+release a parked inserter in < 1 s), and the tier-1 CPU smoke of the
+continuous-batching decode scenario with two tenants."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import parsec_tpu as parsec
+from parsec_tpu import serving
+from parsec_tpu.core.taskpool import CancelledError, Taskpool
+from parsec_tpu.core.task import Task
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl import dtd, ptg
+from parsec_tpu.sched.fair import WFQScheduler
+from parsec_tpu.serving.decode import (DecodeConfig, DecodeEngine,
+                                       reference_decode)
+from parsec_tpu.serving.runtime import (AdmissionRejected,
+                                        DeadlineExceeded,
+                                        TenantQuarantined)
+from parsec_tpu.utils import mca_param
+
+
+@pytest.fixture
+def sctx():
+    """A serving context on the weighted-fair scheduler."""
+    c = parsec.init(nb_cores=4, scheduler="wfq")
+    rt = serving.enable(c)
+    c.start()
+    yield c, rt
+    parsec.fini(c)
+
+
+# ---------------------------------------------------------------------------
+# wfq scheduler unit tests (no context)
+# ---------------------------------------------------------------------------
+
+def _fake_pool(name, weight):
+    tp = Taskpool(name)
+    tp.fair_weight = weight
+    return tp
+
+
+def _fake_tasks(tp, n):
+    from parsec_tpu.core.taskpool import TaskClass
+    tc = TaskClass("T", 0, params=(), flows=[])
+    return [Task(tp, tc, (i,)) for i in range(n)]
+
+
+def test_wfq_weighted_service_proportions():
+    """With saturated backlogs, selection counts track weights 4:1."""
+    sched = WFQScheduler()
+    sched.install(context=None)
+    hi, lo = _fake_pool("hi", 4.0), _fake_pool("lo", 1.0)
+    sched.schedule(None, _fake_tasks(hi, 100))
+    sched.schedule(None, _fake_tasks(lo, 100))
+    picks = {"hi": 0, "lo": 0}
+    for _ in range(50):
+        t = sched.select(None)
+        picks[t.taskpool.name] += 1
+    assert picks["hi"] == 40 and picks["lo"] == 10, picks
+
+
+def test_wfq_idle_pool_rejoins_at_floor():
+    """A pool that was idle cannot burn banked virtual time to
+    monopolize the streams when it rejoins (start-time fairness)."""
+    sched = WFQScheduler()
+    sched.install(context=None)
+    a, b = _fake_pool("a", 1.0), _fake_pool("b", 1.0)
+    sched.schedule(None, _fake_tasks(a, 200))
+    for _ in range(100):
+        assert sched.select(None).taskpool is a
+    # b arrives late with equal weight: from here service alternates
+    # instead of b draining its whole backlog first
+    sched.schedule(None, _fake_tasks(b, 10))
+    picks = [sched.select(None).taskpool.name for _ in range(20)]
+    assert picks.count("b") == 10 and picks.count("a") == 10, picks
+
+
+def test_wfq_newcomer_after_idle_instant_joins_at_clock():
+    """Regression: the virtual floor must survive an idle instant — a
+    pool created right after the queues momentarily drain joins at the
+    global virtual clock, not at 0 (which would let it monopolize
+    selection until it caught up with long-lived pools)."""
+    sched = WFQScheduler()
+    sched.install(context=None)
+    a = _fake_pool("a", 1.0)
+    sched.schedule(None, _fake_tasks(a, 50))
+    for _ in range(50):
+        sched.select(None)
+    assert sched.select(None) is None        # fully idle instant
+    b = _fake_pool("b", 1.0)
+    sched.schedule(None, _fake_tasks(b, 50))  # newcomer
+    sched.schedule(None, _fake_tasks(a, 50))  # veteran rejoins
+    picks = [sched.select(None).taskpool.name for _ in range(20)]
+    # fair alternation, not 20 straight 'b's burning a's banked vpass
+    assert picks.count("b") <= 11, picks
+
+
+def test_wfq_drops_cancelled_pool_queue():
+    sched = WFQScheduler()
+    sched.install(context=None)
+    a, b = _fake_pool("a", 1.0), _fake_pool("b", 1.0)
+    a.monitor = _CountingMonitor()
+    sched.schedule(None, _fake_tasks(a, 5))
+    sched.schedule(None, _fake_tasks(b, 3))
+    a.cancelled = True
+    got = [sched.select(None) for _ in range(4)]
+    assert all(t is not None and t.taskpool is b for t in got[:3])
+    assert got[3] is None
+    assert a.monitor.delta == -5          # counters drained on drop
+    assert sched.pending_tasks() == 0
+
+
+class _CountingMonitor:
+    def __init__(self):
+        self.delta = 0
+
+    def addto_nb_tasks(self, d):
+        self.delta += d
+
+
+def test_wfq_pool_stats_expose_starvation_counters():
+    sched = WFQScheduler()
+    sched.install(context=None)
+    hi = _fake_pool("hi", 2.0)
+    hi.tenant_name = "tenA"
+    sched.schedule(None, _fake_tasks(hi, 4))
+    sched.select(None)
+    st = sched.pool_stats()["hi"]
+    assert st["tenant"] == "tenA"
+    assert st["enqueued"] == 4 and st["selected"] == 1
+    assert st["pending"] == 3
+    assert st["since_selected_s"] is not None
+
+
+# ---------------------------------------------------------------------------
+# admission windows + backpressure
+# ---------------------------------------------------------------------------
+
+def test_admission_hard_window_rejects(sctx):
+    ctx, rt = sctx
+    ten = rt.tenant("hard", weight=1.0, window=16)
+    store = LocalCollection("s", {(i,): 0.0 for i in range(64)})
+    tp = dtd.Taskpool("hardpool")
+    ctx.submit(tp, tenant=ten)
+    gate = threading.Event()
+    with pytest.raises(AdmissionRejected, match="serving.tenant_window"):
+        tp.insert_tasks(lambda x: gate.wait(5.0) or x,
+                        [[dtd.TileArg(store, (i,), dtd.INOUT)]
+                         for i in range(64)])
+    gate.set()
+    assert ten.stats["rejected"] == 1
+
+
+def test_admission_backpressure_parks_then_proceeds(sctx):
+    """Inserts past the soft threshold park and resume when completions
+    drain the window — backpressure, not rejection."""
+    ctx, rt = sctx
+    ten = rt.tenant("soft", weight=1.0, window=64)   # soft = 32
+    store = LocalCollection("s", {(i,): 0.0 for i in range(40)})
+    tp = dtd.Taskpool("softpool")
+    ctx.submit(tp, tenant=ten)
+    gate = threading.Event()
+
+    def body(x):
+        gate.wait(10.0)
+        return x + 1.0
+
+    # 34 in flight: EXISTING depth > soft 32, so the next insert parks
+    tp.insert_tasks(body, [[dtd.TileArg(store, (i,), dtd.INOUT)]
+                           for i in range(34)])
+    done = {}
+
+    def late_insert():
+        t0 = time.monotonic()
+        tp.insert_tasks(body, [[dtd.TileArg(store, (34 + i,), dtd.INOUT)]
+                               for i in range(6)])
+        done["dt"] = time.monotonic() - t0
+
+    th = threading.Thread(target=late_insert)
+    th.start()
+    time.sleep(0.3)
+    assert "dt" not in done          # parked in backpressure
+    gate.set()
+    th.join(10.0)
+    assert done["dt"] >= 0.25
+    tp.wait()
+    assert all(store.data_of((i,)) == 1.0 for i in range(40))
+
+
+def test_admission_big_batch_on_idle_tenant_admits():
+    """A single batch larger than the soft threshold but inside the
+    hard window admits immediately on an idle tenant — an idle tenant
+    has nothing in flight to retire, so parking it could only ever
+    exit via the timeout (post-review regression)."""
+    mca_param.set("sched", "wfq")
+    try:
+        ctx = parsec.init(nb_cores=2)
+        rt = serving.enable(ctx)
+        ctx.start()
+        ten = rt.tenant("bigbatch", weight=1.0, window=64)  # soft = 32
+        store = LocalCollection("s", {(i,): 0.0 for i in range(40)})
+        tp = dtd.Taskpool("bigpool")
+        ctx.submit(tp, tenant=ten)
+        t0 = time.monotonic()
+        tp.insert_tasks(lambda x: x + 1.0,
+                        [[dtd.TileArg(store, (i,), dtd.INOUT)]
+                         for i in range(40)])       # 40 > soft, < hard
+        assert time.monotonic() - t0 < 1.0          # no timeout stall
+        tp.wait()
+        assert all(store.data_of((i,)) == 1.0 for i in range(40))
+        parsec.fini(ctx)
+    finally:
+        mca_param.unset("sched")
+
+
+def test_admission_backpressure_timeout_rejects(sctx):
+    ctx, rt = sctx
+    mca_param.set("serving.backpressure_timeout_s", 0.3)
+    try:
+        ten = rt.tenant("bp", weight=1.0, window=64)   # soft = 32
+        store = LocalCollection("s", {(i,): 0.0 for i in range(48)})
+        tp = dtd.Taskpool("bppool")
+        ctx.submit(tp, tenant=ten)
+        gate = threading.Event()
+
+        def body(x):
+            gate.wait(10.0)
+            return x
+
+        tp.insert_tasks(body, [[dtd.TileArg(store, (i,), dtd.INOUT)]
+                               for i in range(34)])   # depth > soft 32
+        t0 = time.monotonic()
+        with pytest.raises(AdmissionRejected,
+                           match="backpressure park exceeded"):
+            tp.insert_tasks(body,
+                            [[dtd.TileArg(store, (34 + i,), dtd.INOUT)]
+                             for i in range(10)])
+        assert 0.25 <= time.monotonic() - t0 < 3.0
+        gate.set()
+        tp.wait()
+    finally:
+        mca_param.unset("serving.backpressure_timeout_s")
+
+
+def test_hbm_reservation_cap_rejects(sctx):
+    ctx, rt = sctx
+    ten = rt.tenant("mem", weight=1.0, hbm_bytes=1 << 20)
+    sub1 = ctx.submit(dtd.Taskpool("m1"), tenant=ten,
+                      hbm_bytes=700 * 1024)
+    with pytest.raises(AdmissionRejected, match="HBM reservation"):
+        ctx.submit(dtd.Taskpool("m2"), tenant=ten, hbm_bytes=700 * 1024)
+    # the live reservation releases with the pool
+    sub1.tp.wait()
+    ctx.submit(dtd.Taskpool("m3"), tenant=ten,
+               hbm_bytes=700 * 1024).tp.wait()
+
+
+def test_max_pools_cap_rejects(sctx):
+    ctx, rt = sctx
+    ten = rt.tenant("caps", weight=1.0, max_pools=2)
+    ctx.submit(dtd.Taskpool("c1"), tenant=ten)
+    ctx.submit(dtd.Taskpool("c2"), tenant=ten)
+    with pytest.raises(AdmissionRejected, match="serving.tenant_max_pools"):
+        ctx.submit(dtd.Taskpool("c3"), tenant=ten)
+
+
+# ---------------------------------------------------------------------------
+# deadlines + cancellation
+# ---------------------------------------------------------------------------
+
+def test_deadline_cancels_and_releases(sctx):
+    ctx, rt = sctx
+    ten = rt.tenant("dl", weight=1.0)
+    other = rt.tenant("ok", weight=1.0)
+    store = LocalCollection("s", {(i,): 0.0 for i in range(64)})
+    tp = dtd.Taskpool("deadlined")
+    sub = ctx.submit(tp, tenant=ten, deadline_s=0.25)
+    gate = threading.Event()
+    tp.insert_tasks(lambda x: gate.wait(10.0) or x,
+                    [[dtd.TileArg(store, (i,), dtd.INOUT)]
+                     for i in range(64)])
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded):
+        sub.wait(timeout=10.0)
+    assert time.monotonic() - t0 < 5.0
+    gate.set()
+    # cancellation is NOT a quarantine offense and NOT a poison for
+    # other tenants: the tenant keeps submitting, the sibling's pool
+    # runs to completion, and the plain Context.wait stays clean
+    assert ten.quarantined is None
+    s2 = LocalCollection("s2", {("x",): 0.0})
+    tp2 = dtd.Taskpool("after_deadline")
+    ctx.submit(tp2, tenant=other)
+    tp2.insert_task(lambda x: x + 2.0, dtd.TileArg(s2, ("x",), dtd.INOUT))
+    tp2.wait()
+    assert s2.data_of(("x",)) == 2.0
+    assert ctx.wait(timeout=10.0)       # no poisoned abort surfaces
+    assert rt.stats["deadline_cancelled"] == 1
+    # the cancelled pool's window residue was reconciled
+    assert ten.inflight == 0
+
+
+def test_explicit_cancel_reports_cancelled_error(sctx):
+    ctx, rt = sctx
+    store = LocalCollection("s", {(i,): 0.0 for i in range(32)})
+    tp = dtd.Taskpool("victim")
+    sub = ctx.submit(tp, tenant="cancels")
+    gate = threading.Event()
+    tp.insert_tasks(lambda x: gate.wait(10.0) or x,
+                    [[dtd.TileArg(store, (i,), dtd.INOUT)]
+                     for i in range(32)])
+    assert sub.cancel() is True
+    assert sub.cancel() is False         # idempotent
+    gate.set()
+    with pytest.raises(CancelledError):
+        sub.wait(timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
+# quarantine
+# ---------------------------------------------------------------------------
+
+def test_poison_body_quarantines_tenant_sibling_survives(sctx):
+    ctx, rt = sctx
+    bad = rt.tenant("bad", weight=1.0)
+    good = rt.tenant("good", weight=2.0)
+    ebad = DecodeEngine(ctx, "bad", tenant=bad).start()
+    egood = DecodeEngine(ctx, "good", tenant=good).start()
+    ebad.request(0, 6, poison_at=2)
+    egood.request(0, 9)
+    deadline = time.monotonic() + 20
+    while bad.quarantined is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert bad.quarantined is not None
+    with pytest.raises(TenantQuarantined):
+        DecodeEngine(ctx, "bad2", tenant=bad).start()
+    done = egood.drain(20.0)
+    assert len(done) == 1 and egood.verify(done[0])
+    # a rejected request must not leak its pre-written tiles into the
+    # quarantined engine's persistent collections (the refusal surfaces
+    # as the aborted-pool error or TenantQuarantined — both RuntimeError)
+    kv_before = len(ebad.kv.keys())
+    with pytest.raises(RuntimeError):
+        ebad.request(7, 4)
+    assert len(ebad.kv.keys()) == kv_before
+    assert ebad.state.data_of((7,)) is None
+    # quarantine release restores service
+    rt.release_quarantine(bad)
+    e2 = DecodeEngine(ctx, "bad3", tenant=bad).start()
+    r = e2.request(1, 4)
+    assert r.done_evt.wait(20.0) and e2.verify(r)
+    assert rt.stats["quarantined"] == 1
+
+
+def test_lint_gate_refusal_quarantines(sctx):
+    """A tenant whose submission trips the analysis.lint=error gate is
+    refused BEFORE any task runs, and quarantined."""
+    from parsec_tpu.analysis.fixtures import FIXTURES
+    from parsec_tpu.analysis.lint import HazardError
+    ctx, rt = sctx
+    builder, _rules = FIXTURES["serving_quarantine"]
+    mca_param.set("analysis.lint", "error")
+    try:
+        with pytest.raises(HazardError):
+            ctx.submit(builder(), tenant="linty")
+    finally:
+        mca_param.unset("analysis.lint")
+    ten = rt.tenants()["linty"]
+    assert ten.quarantined is not None
+    with pytest.raises(TenantQuarantined):
+        ctx.submit(dtd.Taskpool("refused"), tenant="linty")
+
+
+# ---------------------------------------------------------------------------
+# overload shedding
+# ---------------------------------------------------------------------------
+
+def test_load_shedder_rejects_lowest_weight(sctx):
+    ctx, rt = sctx
+    mca_param.set("serving.shed_watermark", 8)
+    try:
+        hi = rt.tenant("hi", weight=4.0)
+        lo = rt.tenant("lo", weight=1.0)
+        store = LocalCollection("s", {(i,): 0.0 for i in range(64)})
+        tp = dtd.Taskpool("flood")
+        ctx.submit(tp, tenant=hi)
+        gate = threading.Event()
+        tp.insert_tasks(lambda x: gate.wait(10.0) or x,
+                        [[dtd.TileArg(store, (i,), dtd.INOUT)]
+                         for i in range(64)])
+        assert ctx.scheduler.pending_tasks() > 8
+        with pytest.raises(AdmissionRejected, match="overload shed"):
+            ctx.submit(dtd.Taskpool("lo1"), tenant=lo)
+        # the TOP-weight tenant is never shed
+        ctx.submit(dtd.Taskpool("hi2"), tenant=hi)
+        gate.set()
+        tp.wait()
+        assert rt.stats["shed"] == 1
+        assert lo.stats["shed"] == 1
+    finally:
+        mca_param.unset("serving.shed_watermark")
+
+
+def test_load_shedder_overhead_watermark():
+    """The second shedding trigger: the measured per-task runtime
+    overhead (PR 3 stage timers) crossing serving.shed_overhead_us."""
+    mca_param.set("runtime.stage_timers", 1)
+    mca_param.set("serving.shed_overhead_us", 0.001)   # any overhead trips
+    mca_param.set("sched", "wfq")
+    try:
+        ctx = parsec.init(nb_cores=2)
+        rt = serving.enable(ctx)
+        ctx.start()
+        hi = rt.tenant("hi", weight=4.0)
+        lo = rt.tenant("lo", weight=1.0)
+        store = LocalCollection("s", {("x",): 0.0})
+        tp = dtd.Taskpool("warm")
+        ctx.submit(tp, tenant=hi)
+        for _ in range(20):                 # accumulate measured overhead
+            tp.insert_task(lambda x: x + 1.0,
+                           dtd.TileArg(store, ("x",), dtd.INOUT))
+        tp.wait()
+        assert rt._overload_reason() is not None
+        with pytest.raises(AdmissionRejected,
+                           match="serving.shed_overhead_us"):
+            ctx.submit(dtd.Taskpool("lo1"), tenant=lo)
+        parsec.fini(ctx)
+    finally:
+        mca_param.unset("runtime.stage_timers")
+        mca_param.unset("serving.shed_overhead_us")
+        mca_param.unset("sched")
+
+
+# ---------------------------------------------------------------------------
+# satellite: waiter wakeup on failure
+# ---------------------------------------------------------------------------
+
+def test_poison_body_releases_parked_inserter_fast(ctx):
+    """Regression (ISSUE 8 satellite): a task-body exception that fails
+    the pool must release a throttle-parked insert_tasks caller in
+    under a second — and release it WITH the error, not let it keep
+    feeding a dead pool."""
+    mca_param.set("dtd.window_size", 16)
+    mca_param.set("dtd.threshold_size", 8)
+    try:
+        store = LocalCollection("s", {("x",): 0})
+        tp = dtd.Taskpool("poisonpark")
+        ctx.add_taskpool(tp)
+        gate = threading.Event()
+
+        def blocked(x):
+            return x + 1
+
+        def poison(x):
+            gate.wait(20.0)
+            raise ValueError("poison body")
+
+        # poison heads the chain: every later insert RAW-chains behind
+        # it, so the window can ONLY drain through the abort — the
+        # throttle release under test is the failure wakeup, not a
+        # completion racing it
+        tp.insert_task(poison, dtd.TileArg(store, ("x",), dtd.INOUT))
+        for _ in range(14):                    # inflight 15 < window 16
+            tp.insert_task(blocked, dtd.TileArg(store, ("x",), dtd.INOUT))
+        rel = {}
+
+        def inserter():
+            t0 = time.monotonic()
+            try:
+                tp.insert_tasks(
+                    blocked, [[dtd.TileArg(store, ("x",), dtd.INOUT)]
+                              for _ in range(8)])
+                rel["outcome"] = "returned"
+            except RuntimeError as exc:
+                rel["outcome"] = "raised"
+                rel["msg"] = str(exc)
+            rel["dt"] = time.monotonic() - rel.get("fired", t0)
+
+        th = threading.Thread(target=inserter)
+        th.start()
+        time.sleep(0.4)                        # inserter is parked
+        assert "outcome" not in rel
+        rel["fired"] = time.monotonic()
+        gate.set()                             # poison raises now
+        th.join(5.0)
+        assert rel.get("outcome") == "raised", rel
+        assert "poison body" in rel.get("msg", "")
+        assert rel["dt"] < 1.0, rel            # event-driven, no poll exit
+        # ...and wait_completed waiters were unblocked immediately too
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="poison body"):
+            tp.wait_completed(timeout=5.0)
+        assert time.monotonic() - t0 < 0.5
+    finally:
+        mca_param.unset("dtd.window_size")
+        mca_param.unset("dtd.threshold_size")
+
+
+# ---------------------------------------------------------------------------
+# satellite: comm.rejoin_timeout knob
+# ---------------------------------------------------------------------------
+
+def test_wait_rejoin_timeout_knob_named_in_error():
+    """The rejoin rendezvous bound is the comm.rejoin_timeout MCA knob
+    (was a hard-coded 60.0), and expiry raises an error NAMING the
+    knob instead of returning a bare False."""
+    from parsec_tpu.comm.socket_engine import SocketCommEngine
+    assert float(mca_param.get("comm.rejoin_timeout", -1)) == 60.0
+    eng = object.__new__(SocketCommEngine)   # wait_rejoin only touches
+    eng.rank = 0                             # the rejoin event table
+    eng._rejoin_lock = threading.Lock()
+    eng._rejoin_evts = {}
+    mca_param.set("comm.rejoin_timeout", 0.05)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="comm.rejoin_timeout"):
+            eng.wait_rejoin(3)               # knob default applies
+        assert 0.04 <= time.monotonic() - t0 < 2.0
+    finally:
+        mca_param.unset("comm.rejoin_timeout")
+    # an explicit timeout argument still wins, and an admitted rank
+    # returns True promptly
+    eng._rejoin_evts[5] = evt = threading.Event()
+    evt.set()
+    assert eng.wait_rejoin(5, timeout=0.01) is True
+
+
+# ---------------------------------------------------------------------------
+# per-tenant PINS accounting
+# ---------------------------------------------------------------------------
+
+def test_tenant_pins_module_attributes_service():
+    mca_param.set("pins", "tenant")
+    mca_param.set("sched", "wfq")
+    try:
+        ctx = parsec.init(nb_cores=2)
+        rt = serving.enable(ctx)
+        ctx.start()
+        ea = DecodeEngine(ctx, "pa", tenant=rt.tenant("pa", weight=2.0))
+        ea.start()
+        r = ea.request(0, 5)
+        assert r.done_evt.wait(20.0)
+        mod = next(m for m in ctx.pins_modules if m.name == "tenant")
+        rows = mod.report()["tenants"]
+        assert rows["pa"]["tasks"] == 6      # 5 steps + done sentinel
+        assert rows["pa"]["body_s"] >= 0.0
+        assert rows["pa"]["selected"] >= 6   # wfq counters folded in
+        parsec.fini(ctx)
+    finally:
+        mca_param.unset("pins")
+        mca_param.unset("sched")
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: two tenants x tiny decode through the whole stack
+# ---------------------------------------------------------------------------
+
+def test_serving_smoke_two_tenants():
+    """CPU smoke of the serving loop (ISSUE 8 satellite): 2 tenants x
+    tiny continuous-batching decode steps, weighted-fair scheduler,
+    end-to-end through Context.submit — bitwise-correct and well under
+    the 30 s budget."""
+    t_start = time.monotonic()
+    mca_param.set("sched", "wfq")
+    try:
+        ctx = parsec.init(nb_cores=4)
+        rt = serving.enable(ctx)
+        ctx.start()
+        cfg = DecodeConfig(d_model=16, n_heads=2, kv_tile=4)
+        ea = DecodeEngine(ctx, "smokeA", cfg=cfg,
+                          tenant=rt.tenant("A", weight=3.0)).start()
+        eb = DecodeEngine(ctx, "smokeB", cfg=cfg,
+                          tenant=rt.tenant("B", weight=1.0)).start()
+        for rid in range(4):
+            ea.request(rid, 6)
+            eb.request(rid, 6)
+        fa, fb = ea.drain(20.0), eb.drain(20.0)
+        assert len(fa) == 4 and len(fb) == 4
+        assert all(ea.verify(r) for r in fa)
+        assert all(eb.verify(r) for r in fb)
+        # drained requests are RELEASED: a persistent engine's
+        # footprint stays bounded under an open-loop stream
+        assert not ea.pending and not ea.kv.keys() and not ea.state.keys()
+        # reference replay really is the independent oracle
+        assert np.all(fa[0].result ==
+                      reference_decode(ea.model, fa[0].rid, 6))
+        rep = rt.report()
+        assert rep["stats"]["submitted"] == 2
+        assert set(rep["pools"]) >= {"smokeA_decode", "smokeB_decode"}
+        ea.close()
+        eb.close()
+        parsec.fini(ctx)
+    finally:
+        mca_param.unset("sched")
+    assert time.monotonic() - t_start < 30.0
+
+
+# ---------------------------------------------------------------------------
+# long-context prefill through compiled ring attention
+# ---------------------------------------------------------------------------
+
+def test_decode_with_prompt_prefill_seeds_cache_bitwise():
+    """The prompt prefill actually SEEDS the request: decode output
+    depends on the prompt, and the engine run is bitwise-equal to the
+    reference replay of prefill + steps."""
+    mca_param.set("sched", "wfq")
+    try:
+        ctx = parsec.init(nb_cores=2)
+        serving.enable(ctx)
+        ctx.start()
+        cfg = DecodeConfig(d_model=16, n_heads=2, kv_tile=4)
+        eng = DecodeEngine(ctx, "pf", tenant="pf").start()
+        req = eng.request(0, 5, prompt_len=8)
+        assert req.done_evt.wait(20.0)
+        assert eng.verify(req)
+        # the prompt must influence the result (prefill is not a no-op)
+        bare = reference_decode(eng.model, 0, 5, prompt_len=0)
+        assert not np.all(req.result == bare)
+        # whole prompt tiles must be enforced
+        with pytest.raises(ValueError, match="multiple of kv_tile"):
+            eng.request(1, 5, prompt_len=6)
+        parsec.fini(ctx)
+    finally:
+        mca_param.unset("sched")
+
+
+def test_prefill_ring_matches_dense():
+    """The long-context prompt prefill gives the same attention output
+    through the ring (sequence-sharded over the 8-device mesh) as
+    through the dense fold."""
+    import jax
+    from jax.sharding import Mesh
+    from parsec_tpu.serving.decode import DecodeModel, prefill_attention
+    model = DecodeModel(DecodeConfig(d_model=16, n_heads=2))
+    rng = np.random.default_rng(3)
+    prompt = rng.standard_normal((64, 16)).astype(np.float32)
+    dense = prefill_attention(model, prompt, mesh=None)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
+    ring = prefill_attention(model, prompt, mesh=mesh)
+    assert dense.shape == (64, 16)
+    np.testing.assert_allclose(ring, dense, rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# PTG pools under serving (weights + error ownership, no DTD hooks)
+# ---------------------------------------------------------------------------
+
+def test_ptg_pool_serving_submission(sctx):
+    ctx, rt = sctx
+    store = LocalCollection("p", {(i,): float(i) for i in range(4)})
+    tp = ptg.Taskpool("ptgsub", N=4, S=store)
+    tp.task_class(
+        "T", params=("i",),
+        space=lambda g: ((i,) for i in range(g.N)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, i: (g.S, (i,)))],
+            outs=[ptg.Out(data=lambda g, i: (g.S, (i,)))])])
+
+    @tp.task_class_by_name("T").body(batchable=False)
+    def t_body(task, X):
+        return np.float32(X * 2.0)
+
+    sub = ctx.submit(tp, tenant="ptg", weight=2.5)
+    assert tp.fair_weight == 2.5 and tp.tenant_name == "ptg"
+    assert tp.error_owned
+    sub.wait(timeout=20.0)
+    assert all(store.data_of((i,)) == 2.0 * i for i in range(4))

@@ -1,0 +1,168 @@
+"""Cross-taskpool isolation (ISSUE 8 satellite): two concurrent
+taskpools where one hits an ``analysis.lint=error`` hazard and one is
+fault-injected (``comm.fault_inject=kill``) — the sibling pool must
+finish BITWISE-correct. Upgrades PR 6's single-pool guarantees to
+multi-pool: the failure unit is the taskpool, not the context."""
+
+import multiprocessing as mp
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from parsec_tpu import serving
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.dsl import dtd
+from parsec_tpu.comm.pingpong import _free_port_base
+from parsec_tpu.serving.serving_bench import (_DistVec, _build_dist_chain,
+                                              _peer_main)
+from parsec_tpu.utils import mca_param
+
+mp_only = pytest.mark.skipif(
+    os.environ.get("PARSEC_SKIP_MP") == "1",
+    reason="multiprocess tests disabled")
+
+
+def _sibling_math(n: int, rounds: int) -> np.ndarray:
+    """Float32 oracle of the sibling DTD chain below."""
+    x = np.arange(n, dtype=np.float32)
+    for _ in range(rounds):
+        x = np.float32(1.0009765625) * x + np.float32(0.125)
+    return x
+
+
+def _insert_sibling_round(tp, store, n):
+    for i in range(n):
+        tp.insert_task(
+            lambda x: np.float32(1.0009765625) * x + np.float32(0.125),
+            dtd.TileArg(store, (i,), dtd.INOUT))
+
+
+def test_lint_refused_pool_leaves_sibling_bitwise_correct(ctx):
+    """Single-rank half of the satellite: pool L is refused by the
+    registration-time lint gate while sibling pool S is mid-flight —
+    S finishes bitwise-correct and the context stays usable."""
+    from parsec_tpu.analysis.fixtures import FIXTURES
+    from parsec_tpu.analysis.lint import HazardError
+    n, rounds = 8, 20
+    store = LocalCollection("sib", {(i,): np.float32(i)
+                                    for i in range(n)})
+    sib = dtd.Taskpool("sibling")
+    ctx.add_taskpool(sib)
+    _insert_sibling_round(sib, store, n)
+    builder, _ = FIXTURES["serving_quarantine"]
+    mca_param.set("analysis.lint", "error")
+    try:
+        with pytest.raises(HazardError):
+            ctx.add_taskpool(builder())
+    finally:
+        mca_param.unset("analysis.lint")
+    for _ in range(rounds - 1):
+        _insert_sibling_round(sib, store, n)
+    sib.wait()
+    got = np.array([store.data_of((i,)) for i in range(n)],
+                   dtype=np.float32)
+    assert np.all(got == _sibling_math(n, rounds))
+
+
+@mp_only
+def test_killed_rank_leaves_scoped_sibling_bitwise_correct():
+    """Multirank half: rank 0 serves a rank-local sibling DTD pool
+    (rank_scope={0}) while a mesh-scoped pool spans both ranks; rank 1
+    SIGKILLs itself mid-load (comm.fault_inject=kill). The mesh pool
+    aborts and quarantines its tenant; the sibling finishes
+    bitwise-correct."""
+    from parsec_tpu.comm.socket_engine import SocketCommEngine
+    from parsec_tpu.core import context as ctx_mod
+
+    nb_ranks, n, rounds, chain_rounds = 2, 8, 30, 60
+    mca_param.set("runtime.stage_reads", "0")
+    mca_param.set("comm.stage_recv", "0")
+    mca_param.set("sched", "wfq")
+    mpx = mp.get_context("spawn")
+    q = mpx.Queue()
+    base_port = _free_port_base(nb_ranks)
+    peer = mpx.Process(target=_peer_main,
+                       args=(1, nb_ranks, base_port, chain_rounds,
+                             0.002, 30, q))   # kill after 30 tasks
+    peer.start()
+    engine = SocketCommEngine(0, nb_ranks, base_port=base_port)
+    ctx = ctx_mod.init(nb_cores=2, comm=engine)
+    try:
+        rt = serving.enable(ctx)
+        ctx.start()
+        XD = _DistVec("XD", 8, nb_ranks, 0)
+        dist_tp = _build_dist_chain(XD, 8, chain_rounds, 0.002)
+        dist_sub = ctx.submit(dist_tp, tenant="mesh", rank_scope="all")
+
+        store = LocalCollection("sib", {(i,): np.float32(i)
+                                        for i in range(n)})
+        sib = dtd.Taskpool("sibling")
+        ctx.submit(sib, tenant="localT")   # rank_scope defaults to {0}
+        assert sib.rank_scope == frozenset({0})
+        for _ in range(rounds):
+            _insert_sibling_round(sib, store, n)
+            time.sleep(0.01)               # keep inserting across the kill
+
+        with pytest.raises(RuntimeError, match="peer rank 1"):
+            dist_sub.wait(timeout=60.0)
+        assert rt.tenants()["mesh"].quarantined is not None
+
+        sib.wait()                          # sibling UNAFFECTED
+        got = np.array([store.data_of((i,)) for i in range(n)],
+                       dtype=np.float32)
+        assert np.all(got == _sibling_math(n, rounds))
+        assert rt.tenants()["localT"].quarantined is None
+        # the broken mesh refuses new mesh-scoped pools but keeps
+        # accepting rank-local ones
+        post = dtd.Taskpool("postkill")
+        ctx.submit(post, tenant="localT")
+        s2 = LocalCollection("s2", {("x",): np.float32(1.0)})
+        post.insert_task(lambda x: x + np.float32(1.0),
+                         dtd.TileArg(s2, ("x",), dtd.INOUT))
+        post.wait()
+        assert s2.data_of(("x",)) == np.float32(2.0)
+    finally:
+        ctx.fini()
+        mca_param.unset("runtime.stage_reads")
+        mca_param.unset("comm.stage_recv")
+        mca_param.unset("sched")
+        peer.join(timeout=15.0)
+        if peer.is_alive():
+            peer.terminate()
+
+
+def test_two_tenant_poison_isolation_under_load(ctx):
+    """One tenant's poison bodies mid-load cannot corrupt or wedge the
+    other: the survivor's full round-set completes bitwise-correct
+    while the poisoned pool aborts."""
+    rt = serving.enable(ctx)
+    n, rounds = 8, 15
+    store = LocalCollection("sv", {(i,): np.float32(i)
+                                   for i in range(n)})
+    survivor = dtd.Taskpool("survivor")
+    ctx.submit(survivor, tenant="goodT")
+    poisoned = dtd.Taskpool("poisoned")
+    ctx.submit(poisoned, tenant="badT")
+    pstore = LocalCollection("pv", {(i,): 0.0 for i in range(4)})
+    gate = threading.Event()
+
+    def poison(x):
+        gate.wait(5.0)
+        raise ValueError("mid-load poison")
+
+    for i in range(4):
+        poisoned.insert_task(poison, dtd.TileArg(pstore, (i,), dtd.INOUT))
+    for r in range(rounds):
+        _insert_sibling_round(survivor, store, n)
+        if r == rounds // 2:
+            gate.set()                     # poison fires mid-load
+    survivor.wait()
+    got = np.array([store.data_of((i,)) for i in range(n)],
+                   dtype=np.float32)
+    assert np.all(got == _sibling_math(n, rounds))
+    assert poisoned.error is not None
+    assert rt.tenants()["badT"].quarantined is not None
+    assert rt.tenants()["goodT"].quarantined is None
