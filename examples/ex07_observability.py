@@ -80,6 +80,22 @@ def main():
         out = os.path.join(tempfile.gettempdir(), "ex07_trace.json")
         trace.dump_chrome_trace(out)
         print(f"Chrome trace written to {out} (open in Perfetto)")
+
+        # ISSUE 9: the always-on metrics plane — Prometheus text +
+        # JSON statusz, no listener needed (set --mca
+        # serving.metrics_port 9100 for the HTTP /metrics + /statusz)
+        print("\n/metrics excerpt:")
+        for line in ctx.metrics_text().splitlines():
+            if line.startswith(("parsec_tasks_completed_total",
+                                "parsec_sched_ready_tasks")):
+                print(" ", line)
+        sz = ctx.statusz()
+        print(f"statusz: scheduler={sz['scheduler']} "
+              f"streams={len(sz['streams'])} "
+              f"metric_families={len(sz['metrics'])}")
+        # request tracing: submissions through Context.submit mint a
+        # rid; `python -m parsec_tpu.profiling.tools critpath <rid>
+        # rank*.json` prints the admission/queue/exec/wire breakdown
         print(f"\ncomm.thread_multiple = "
               f"{mca_param.get('comm.thread_multiple', 0)} "
               "(socket-engine knob; see tests/test_socket_comm.py)")

@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import enum
 import threading
-from typing import Any, Callable, Dict, Optional
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
 
 
 class AMTag(enum.IntEnum):
@@ -31,7 +32,9 @@ class AMTag(enum.IntEnum):
     RECOVER = 10          # fault-recovery control plane: completed-set
     #                       allgather across the live rank set
     #                       (data/recovery.exchange_completed)
-    FIRST_USER_TAG = 11
+    CLOCK = 11            # clock-offset pingpong (distributed-trace
+    #                       timestamp alignment, profiling/spans.py)
+    FIRST_USER_TAG = 12
 
 MAX_REGISTERED_TAGS = 32     # PARSEC_MAX_REGISTERED_TAGS (parsec_comm_engine.h:24)
 
@@ -55,12 +58,31 @@ class CommEngine:
         self.stats = {"activations_sent": 0, "activations_recv": 0,
                       "bytes_sent": 0, "bytes_recv": 0}
         # per-message-kind wire accounting (profiling msg-size info,
-        # remote_dep.h:374-384): kind -> sent/recv message+byte counters.
-        # "activate" = p2p activation payloads, "bcast" = tree-edge
-        # broadcast payloads (the root's entry IS its data-plane egress),
-        # "seg" = pipelined payload segments (wire-level), "put"/"get" =
-        # classic rendezvous legs.
-        self.stats_by_kind: Dict[str, Dict[str, int]] = {}
+        # remote_dep.h:374-384): "activate" = p2p activation payloads,
+        # "bcast" = tree-edge broadcast payloads (the root's entry IS
+        # its data-plane egress), "seg" = pipelined payload segments
+        # (wire-level), "put"/"get" = classic rendezvous legs. The
+        # counters live in the shared metrics registry
+        # (profiling/metrics.py — the live /metrics export surface);
+        # the per-engine ``stats_by_kind`` dict accessor remains as a
+        # VIEW over this engine's own children, distinguished from
+        # same-rank siblings (loopback fabrics) by the engine label.
+        from ..profiling import metrics as metrics_mod
+        self._engine_id = str(metrics_mod.next_engine_id())
+        # profiling.metrics=0 (the bench A/B baseline): count into a
+        # PRIVATE unexported registry instead — stats_by_kind keeps its
+        # accounting contract either way, but the kill switch really
+        # does keep the global export surface out of the hot path
+        wire_reg = metrics_mod.registry() if metrics_mod.enabled() \
+            else metrics_mod.MetricsRegistry()
+        self._m_msgs = wire_reg.counter(
+            "parsec_wire_msgs_total",
+            "wire messages by kind (activate/bcast/seg/put/get)",
+            ("rank", "engine", "kind", "dir"))
+        self._m_bytes = wire_reg.counter(
+            "parsec_wire_bytes_total", "wire payload bytes by kind",
+            ("rank", "engine", "kind", "dir"))
+        self._kind_children: Dict[Tuple[str, str], tuple] = {}
         self._stats_lock = threading.Lock()
         self._trace = None
         # one-sided tile-fetch service (RMA GET over AMs): exposed
@@ -100,6 +122,23 @@ class CommEngine:
         # byte stats/check-comms assertions see nonzero traffic
         return 8
 
+    def _kind_counters(self, kind: str, direction: str) -> tuple:
+        """This engine's (msgs, bytes) registry children for one
+        (kind, direction) — resolved once, then a lock-free dict hit."""
+        key = (kind, direction)
+        pair = self._kind_children.get(key)
+        if pair is None:
+            with self._stats_lock:
+                pair = self._kind_children.get(key)
+                if pair is None:
+                    labels = {"rank": str(self.rank),
+                              "engine": self._engine_id,
+                              "kind": kind, "dir": direction}
+                    pair = self._kind_children[key] = (
+                        self._m_msgs.labels(**labels),
+                        self._m_bytes.labels(**labels))
+        return pair
+
     def record_msg(self, direction: str, kind: str, peer: int,
                    nbytes: int) -> None:
         with self._stats_lock:
@@ -116,16 +155,35 @@ class CommEngine:
                 else:
                     self.stats["activations_recv"] += 1
                     self.stats["bytes_recv"] += nbytes
-            bk = self.stats_by_kind.get(kind)
-            if bk is None:
-                bk = self.stats_by_kind[kind] = {
-                    "sent_msgs": 0, "sent_bytes": 0,
-                    "recv_msgs": 0, "recv_bytes": 0}
-            bk[f"{direction}_msgs"] += 1
-            bk[f"{direction}_bytes"] += nbytes
+        m_msgs, m_bytes = self._kind_counters(kind, direction)
+        m_msgs.inc()
+        m_bytes.inc(nbytes)
         if self._trace is not None:
             self._trace.event(f"comm_{kind}", direction, stream_id=-1,
                               object_id=peer, info={"msg_size": nbytes})
+
+    @property
+    def stats_by_kind(self) -> Dict[str, Dict[str, int]]:
+        """Per-kind wire accounting VIEW over this engine's registry
+        counters (the ad-hoc dict this used to be now lives in the
+        shared metrics registry; shape unchanged:
+        ``{kind: {sent_msgs, sent_bytes, recv_msgs, recv_bytes}}``)."""
+        out: Dict[str, Dict[str, int]] = {}
+        with self._stats_lock:
+            items = list(self._kind_children.items())
+        for (kind, direction), (m_msgs, m_bytes) in items:
+            bk = out.setdefault(kind, {"sent_msgs": 0, "sent_bytes": 0,
+                                       "recv_msgs": 0, "recv_bytes": 0})
+            bk[f"{direction}_msgs"] = int(m_msgs.value())
+            bk[f"{direction}_bytes"] = int(m_bytes.value())
+        return out
+
+    def clock_meta(self, root: int = 0) -> Dict[str, float]:
+        """Clock-alignment metadata for dumped traces: the offset of
+        this process's ``perf_counter`` domain to ``root``'s. Engines
+        whose ranks share one process (loopback) share one clock —
+        offset 0; the socket engine measures it over the wire."""
+        return {"clock_offset_s": 0.0}
 
     # -- lifecycle --------------------------------------------------------
     def enable(self) -> None:
@@ -133,6 +191,19 @@ class CommEngine:
 
     def disable(self) -> None:
         self._enabled = False
+        # unexport this engine's wire-counter children (the per-engine
+        # label would otherwise grow the registry across engine churn —
+        # one engine per run in harness loops). The child objects stay
+        # alive in _kind_children, so post-run stats_by_kind reads keep
+        # working.
+        with self._stats_lock:
+            keys = [(kind, direction)
+                    for (kind, direction) in self._kind_children]
+        for kind, direction in keys:
+            labels = {"rank": str(self.rank), "engine": self._engine_id,
+                      "kind": kind, "dir": direction}
+            self._m_msgs.remove(**labels)
+            self._m_bytes.remove(**labels)
 
     # -- active messages --------------------------------------------------
     def tag_register(self, tag: int, cb: Callable[[int, Any], None]) -> None:
@@ -330,6 +401,61 @@ class CommEngine:
         the single-dep path."""
         for ref in refs:
             self.remote_dep_activate(task, ref, target_rank)
+
+    # -- request-scoped wire spans (profiling/spans.py) -------------------
+    def _span_attach(self, tp, task, msg) -> Optional[Dict]:
+        """Attach request-span context to an outgoing activation msg:
+        ``msg["span"] = {rid, id, parent, src}`` — the hop's span id is
+        minted HERE (sender side), parented to the sending task's span
+        (or the submission root for startup/eager pushes). Returns the
+        span dict, or None when tracing is off or the taskpool carries
+        no trace_rid (non-serving traffic stays byte-identical). ONE
+        builder for every transport, like _targets_of."""
+        if self._trace is None:
+            return None
+        rid = getattr(tp, "trace_rid", None)
+        if rid is None:
+            return None
+        from ..profiling.spans import next_span_id
+        prof = getattr(task, "prof", None) or {}
+        b = prof.get("b")         # the trace hook's fused begin stamp
+        sp = {"rid": prof.get("rid", rid),
+              "id": next_span_id(self.rank),
+              "parent": (b[0] if b is not None
+                         else getattr(tp, "root_span", None)),
+              "src": self.rank}
+        msg["span"] = sp
+        return sp
+
+    def _span_sent(self, sp: Optional[Dict], dst: int,
+                   nbytes: int) -> None:
+        """Record one tree-edge/wire send of span ``sp`` toward
+        ``dst`` (forwarding nodes call this too — the sent/recv pair
+        per edge is what the critpath wire share is computed from)."""
+        if sp is None or self._trace is None:
+            return
+        self._trace.event("wire", "sent", object_id=dst,
+                          info={"rid": sp["rid"], "span": sp["id"],
+                                "parent": sp["parent"],
+                                "src": self.rank, "dst": dst,
+                                "nbytes": nbytes})
+
+    def _span_recv(self, msg, src: int, nbytes: int, tasks) -> None:
+        """Receive side of a wire hop: record the edge's ``recv`` event
+        and parent every task the payload released to the hop's span —
+        the cross-rank causal edge of the request tree."""
+        sp = msg.get("span")
+        if sp is None or self._trace is None:
+            return
+        self._trace.event("wire", "recv", object_id=src,
+                          info={"rid": sp["rid"], "span": sp["id"],
+                                "parent": sp["parent"], "src": src,
+                                "dst": self.rank, "nbytes": nbytes})
+        now = time.perf_counter()
+        for t in tasks:
+            t.prof["parent_span"] = sp["id"]
+            t.prof["rid"] = sp["rid"]
+            t.prof["q_t0"] = now       # queue wait starts at release
 
     @staticmethod
     def _targets_of(refs) -> list:

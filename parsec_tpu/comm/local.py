@@ -139,8 +139,10 @@ class LocalCommEngine(CommEngine):
         monitor.outgoing_message_start(target_rank)
         msg = {"taskpool": tp.name, "targets": self._targets_of(refs),
                "value": refs[0].value}
-        self.record_msg("sent", "activate", target_rank,
-                        self.payload_bytes(refs[0].value))
+        nbytes = self.payload_bytes(refs[0].value)
+        self.record_msg("sent", "activate", target_rank, nbytes)
+        self._span_sent(self._span_attach(tp, task, msg), target_rank,
+                        nbytes)
         self.send_am(AMTag.ACTIVATE, target_rank, msg)
         monitor.outgoing_message_end(target_rank)
 
@@ -158,10 +160,12 @@ class LocalCommEngine(CommEngine):
         value = next(iter(rank_refs.values()))[0].value
         msg["value"] = value
         nbytes = self.payload_bytes(value)
+        bsp = self._span_attach(tp, task, msg)
         for c in bcast_live_children(topo, parts, self.rank, fanout,
                                      self.peer_alive):
             monitor.outgoing_message_start(c)
             self.record_msg("sent", "bcast", c, nbytes)
+            self._span_sent(bsp, c, nbytes)
             self.send_am(AMTag.ACTIVATE, c, msg)
             monitor.outgoing_message_end(c)
 
@@ -197,6 +201,7 @@ class LocalCommEngine(CommEngine):
                 for c in children:
                     tp.monitor.outgoing_message_start(c)
                     self.record_msg("sent", "bcast", c, nbytes)
+                    self._span_sent(msg.get("span"), c, nbytes)
                     self.send_am(AMTag.ACTIVATE, c, msg)
                     tp.monitor.outgoing_message_end(c)
                 self.record_msg("recv", "bcast", src_rank, nbytes)
@@ -214,6 +219,7 @@ class LocalCommEngine(CommEngine):
                 new_task = tp.activate_dep(ref)
                 if new_task is not None:
                     ready.append(new_task)
+            self._span_recv(msg, src_rank, nbytes, ready)
             if ready:
                 context.schedule(None, ready)
             tp.monitor.incoming_message_end(src_rank)

@@ -178,6 +178,12 @@ class SocketCommEngine(CommEngine):
         self.fault = FaultInjector.from_mca(rank)
         if self.fault is not None:
             self.fault.attach(self)
+        # clock-offset pingpong (distributed-trace alignment): replies
+        # run on the comm thread; initiators park on a Future
+        self._clock_futs: Dict[int, object] = {}
+        self._clock_next = itertools.count(1)
+        self._clock_cache: Dict[int, Tuple[float, float]] = {}
+        self.tag_register(AMTag.CLOCK, self._on_clock)
         # control-plane tags usable without a Context
         self.tag_register(AMTag.BARRIER, self._on_barrier)
         self.tag_register(AMTag.TERMDET_FOURCOUNTER, self._on_termdet)
@@ -1296,6 +1302,8 @@ class SocketCommEngine(CommEngine):
         else:
             msg["value"] = value
         self.record_msg("sent", "activate", target_rank, nbytes)
+        self._span_sent(self._span_attach(tp, task, msg), target_rank,
+                        nbytes)
         if target_rank != self.rank and self._thread_multiple():
             # THREAD_MULTIPLE: the worker ships the activation itself
             # (one [msg] frame — direct sends skip per-peer aggregation,
@@ -1356,12 +1364,14 @@ class SocketCommEngine(CommEngine):
         if ctx is not None and ctx.pins is not None:
             ctx.pins.bcast_fwd(tp.name, -1, children, nbytes)
         direct = self._thread_multiple()
+        bsp = self._span_attach(tp, task, msg)
         for c in children:
             monitor.outgoing_message_start(c)
             # one entry per tree edge at the logical payload size — the
             # "bcast" kind's sent_bytes at the root IS its data-plane
             # egress (the bench guard reads exactly this)
             self.record_msg("sent", "bcast", c, nbytes)
+            self._span_sent(bsp, c, nbytes)
             if direct and c != self.rank:
                 self._direct_send(c, AMTag.ACTIVATE, [msg])
             else:
@@ -1490,6 +1500,9 @@ class SocketCommEngine(CommEngine):
         for c in children:
             monitor.outgoing_message_start(c)
             self.record_msg("sent", "bcast", c, nbytes)
+            # forwarded tree edges keep the ROOT-minted span id — each
+            # edge still gets its own sent/recv pair for the wire share
+            self._span_sent(msg.get("span"), c, nbytes)
             # forwarding runs on the comm thread, which owns the
             # sockets: write the frame directly (ordering with the
             # stream catch-up + live segments below is per-socket FIFO)
@@ -1621,6 +1634,10 @@ class SocketCommEngine(CommEngine):
             new_task = tp.activate_dep(ref)
             if new_task is not None:
                 ready.append(new_task)
+        if "span" in msg and self._trace is not None:
+            self._span_recv(msg, src,
+                            msg.get("nbytes",
+                                    self.payload_bytes(value)), ready)
         if ready:
             self._context.schedule(None, ready)
         tp.monitor.incoming_message_end(src)
@@ -1841,6 +1858,69 @@ class SocketCommEngine(CommEngine):
 
     def peer_alive(self, rank: int) -> bool:
         return rank not in self._dead_peers
+
+    # ------------------------------------------------ clock alignment
+    def _on_clock(self, src: int, msg: Dict) -> None:
+        """CLOCK AM handler (comm thread): answer pings with this
+        process's perf_counter; route pongs to the waiting Future."""
+        if msg.get("op") == "ping":
+            self.send_am(AMTag.CLOCK, src,
+                         {"op": "pong", "req": msg["req"],
+                          "t_remote": time.perf_counter()})
+            return
+        fut = self._clock_futs.pop(msg["req"], None)
+        if fut is not None and not fut.is_ready():
+            fut.set(msg["t_remote"])
+
+    def clock_offset_to(self, peer: int, samples: int = 7,
+                        timeout: float = 5.0) -> Tuple[float, float]:
+        """Pingpong clock handshake against ``peer``: returns
+        ``(offset_s, rtt_s)`` where offset_s added to this process's
+        ``perf_counter`` lands in the peer's domain. NTP-style midpoint
+        estimate per sample (t_remote − (t_send + t_recv)/2), keeping
+        the minimum-RTT sample — the one with the least asymmetric
+        queueing. Cached per peer (the mesh's relative clock drift over
+        a trace's lifetime is far below the RTT noise floor)."""
+        if peer == self.rank or self.nb_ranks <= 1:
+            return 0.0, 0.0
+        cached = self._clock_cache.get(peer)
+        if cached is not None:
+            return cached
+        if self._thread is None:
+            # comm thread down (pre-enable / post-disable): a ping could
+            # never be answered — dump traces BEFORE fini to get offsets
+            raise RuntimeError("clock handshake needs the comm thread "
+                               "(dump traces before disable/fini)")
+        from ..core.future import Future
+        best: Optional[Tuple[float, float]] = None
+        for _ in range(max(samples, 1)):
+            fut = Future()
+            req = next(self._clock_next)
+            self._clock_futs[req] = fut
+            t0 = time.perf_counter()
+            self.send_am(AMTag.CLOCK, peer, {"op": "ping", "req": req})
+            try:
+                t_remote = fut.get(timeout=timeout)
+            finally:
+                self._clock_futs.pop(req, None)
+            t3 = time.perf_counter()
+            rtt = t3 - t0
+            off = t_remote - (t0 + t3) / 2.0
+            if best is None or rtt < best[1]:
+                best = (off, rtt)
+        self._clock_cache[peer] = best
+        return best
+
+    def clock_meta(self, root: int = 0) -> Dict[str, float]:
+        """Trace metadata block: the wire-measured offset to the root
+        rank's perf_counter domain + the handshake RTT (the alignment
+        error bound the multi-rank merge inherits)."""
+        if self.rank == root or self.nb_ranks <= 1 or \
+                not self.peer_alive(root):
+            return {"clock_offset_s": 0.0, "clock_rtt_us": 0.0}
+        off, rtt = self.clock_offset_to(root)
+        return {"clock_offset_s": off,
+                "clock_rtt_us": round(rtt * 1e6, 1)}
 
     # ------------------------------------------------- recovery exchange
     def recover_exchange(self, token: str, payload: Any, dead_ranks,

@@ -198,6 +198,24 @@ class Context:
         from ..device.hbm import manager_from_mca
         self.hbm = manager_from_mca()
 
+        # always-on metrics plane (profiling/metrics.py): the process-
+        # global registry plus this context's scrape-time collectors
+        # (queue depth, steal rates, wfq pool_stats, tenants, HBM,
+        # compile cache). The only HOT-path cost is one sharded counter
+        # inc per completed task; profiling.metrics=0 removes even that
+        # (the observability bench's A/B baseline).
+        from ..profiling import metrics as metrics_mod
+        self.metrics = metrics_mod.registry()
+        self._metrics_unhook = None
+        self._metrics_server = None
+        if metrics_mod.enabled():
+            self._metrics_unhook = \
+                metrics_mod.install_context_collectors(self)
+            port = int(mca_param.get("serving.metrics_port", 0))
+            if port:
+                self._metrics_server = metrics_mod.serve_http(
+                    port, statusz_fn=self.statusz)
+
         self._dot_path = str(mca_param.get("profiling.dot", "") or "")
         if self._dot_path:
             from ..profiling.grapher import Grapher
@@ -370,8 +388,41 @@ class Context:
                     f"taskpool {tp.name} aborted: {tp.error}") from tp.error
         return True
 
+    # ------------------------------------------------------ observability
+    def statusz(self) -> Dict:
+        """Live runtime status as one JSON-able dict: the metrics
+        registry, stream counters, active pools, and (when serving) the
+        tenant/pool report — the /statusz payload of the metrics
+        listener (``serving.metrics_port``)."""
+        with self._lock:
+            active = [tp.name for tp in self._active_taskpools]
+        out = {
+            "rank": self.my_rank,
+            "nb_ranks": self.nb_ranks,
+            "scheduler": self.scheduler.name,
+            "active_taskpools": active,
+            "streams": {es.th_id: dict(es.stats) for es in self.streams},
+            "metrics": self.metrics.to_dict(),
+        }
+        if self.serving is not None:
+            out["serving"] = self.serving.report()
+        if self.trace is not None:
+            out["trace_dropped"] = self.trace.dropped()
+        return out
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the metrics registry (the
+        /metrics payload)."""
+        return self.metrics.to_prometheus_text()
+
     def fini(self) -> None:
         """parsec_fini analog: drain and stop the workers."""
+        if self._metrics_server is not None:
+            self._metrics_server.shutdown()
+            self._metrics_server = None
+        if self._metrics_unhook is not None:
+            self._metrics_unhook()
+            self._metrics_unhook = None
         if self.serving is not None:
             self.serving.shutdown()
         if self._ckpt is not None:
@@ -753,6 +804,24 @@ class Context:
             tc.on_complete(task)
         if task.on_complete is not None:
             task.on_complete(task)
+        if ready and self.trace is not None:
+            # causal parent of everything this completion released: the
+            # local dependency edges of the request span tree (wire
+            # edges are parented by the comm engine's _span_recv). The
+            # ready→select queue-wait stamp (q_us on the released
+            # task's begin event) shares this loop — one perf_counter,
+            # no separate pass in schedule().
+            b = task.prof.get("b")      # (span id, t0, stream) — the
+            if b is not None:           # trace hook's fused begin stamp
+                sid = b[0]
+                rid = task.prof.get("rid")
+                now = time.perf_counter()
+                for t in ready:
+                    p = t.prof
+                    p["parent_span"] = sid
+                    p["q_t0"] = now
+                    if rid is not None:
+                        p["rid"] = rid
         if ready:
             if self._bypass_chain and es is not None and \
                     es.next_task is None:
@@ -770,6 +839,10 @@ class Context:
             es.stats["release_s"] += time.perf_counter() - t_rel
         self.pins.release_deps_end(es, task)
         self.pins.complete_exec_end(es, task)
+        # the always-on metrics plane adds NO hot-path work here: the
+        # per-stream es.stats["executed"] counters above already exist,
+        # and the registry exports their sum as
+        # parsec_tasks_completed_total at SCRAPE time (collector)
         tp.addto_nb_tasks(-1)
         # no task mempool here BY MEASUREMENT (round 5, PARITY
         # "Mempools" row): completed tasks die young via refcounting

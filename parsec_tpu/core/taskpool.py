@@ -361,6 +361,14 @@ class Taskpool:
         # error reporting: a failure then never lands in the context's
         # aborted list, so other callers' Context.wait stays clean
         self.error_owned = False
+        # request-scoped tracing (profiling/spans.py): serving
+        # submissions set trace_rid (deterministic from the pool name,
+        # identical on every rank) and root_span (the submission root
+        # every startup task / admission park parents to). None keeps
+        # the span path COMPLETELY off — plain attribute reads are the
+        # only hot-path cost.
+        self.trace_rid: Optional[str] = None
+        self.root_span: Optional[str] = None
         # lineage record: (class name, locals) of every locally-completed
         # task (runtime.lineage) — after a peer death the survivors'
         # union of these is the completed-set input of

@@ -794,7 +794,9 @@ class Taskpool(CoreTaskpool):
         ref = SuccessorRef(task_class=self._wire_tc, locals=(seq,),
                            flow_name=fname, value=value, dep_index=0,
                            priority=priority)
-        shim = _types.SimpleNamespace(taskpool=self)
+        # eager pushes have no producing task: the wire span parents to
+        # the submission root (prof empty -> _span_attach falls back)
+        shim = _types.SimpleNamespace(taskpool=self, prof={})
         self.context.comm.remote_dep_activate(shim, ref, target_rank)
 
     # ----------------------------------------------------- class callbacks
@@ -851,7 +853,9 @@ class Taskpool(CoreTaskpool):
             refs.append(ref)
         if rsends:
             import types as _types
-            shim = _types.SimpleNamespace(taskpool=self)
+            # prof rides along so the wire hop's span is parented to
+            # THIS completing task (profiling/spans.py)
+            shim = _types.SimpleNamespace(taskpool=self, prof=task.prof)
             for (rank, _vid), wire_refs in rsends.items():
                 self.context.comm.remote_dep_activate_multi(
                     shim, rank, wire_refs)

@@ -12,7 +12,11 @@ from . import pins
 from .pins import PinsManager, PinsEvent
 from . import pins_modules
 from .pins_modules import TaskProfiler, PrintSteals, Alperf, \
-    Counters, IteratorsChecker, new_module, install_selected
+    Counters, IteratorsChecker, StragglerWatchdog, new_module, \
+    install_selected
+from . import metrics
+from .metrics import MetricsRegistry, registry as metrics_registry
+from . import spans
 from .trace import Trace
 from .grapher import Grapher
 from .ptg_to_dtd import replay_ptg_through_dtd

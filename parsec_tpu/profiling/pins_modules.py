@@ -27,7 +27,18 @@ mca_param.register("pins", "",
                    help="comma-separated PINS modules to install at init "
                         "(task_profiler, print_steals, alperf, "
                         "iterators_checker, counters, overhead, tenant, "
-                        "dfsan)")
+                        "straggler, dfsan)")
+mca_param.register("profiling.straggler_factor", 3.0,
+                   help="straggler watchdog: flag a task instance whose "
+                        "body time exceeds its class's rolling p99 "
+                        "times this factor")
+mca_param.register("profiling.straggler_window", 256,
+                   help="straggler watchdog: rolling per-class sample "
+                        "window the p99 is estimated over")
+mca_param.register("profiling.straggler_min_samples", 20,
+                   help="straggler watchdog: observations of a class "
+                        "before flagging starts (a cold p99 estimate "
+                        "flags compile warmup, not stragglers)")
 
 
 class PinsModule:
@@ -337,6 +348,96 @@ class OverheadProfiler(PinsModule):
         return agg
 
 
+class StragglerWatchdog(PinsModule):
+    """Online straggler detection (the PINS-shaped watchdog the serving
+    plane runs LIVE instead of post-mortem): per task class, body times
+    feed a rolling window whose p99 is re-estimated every window/4
+    observations; an instance exceeding ``p99 × profiling.
+    straggler_factor`` (after ``profiling.straggler_min_samples``
+    observations) is flagged — into the report, the always-on metrics
+    registry (``parsec_stragglers_total{class}``), and a warning log.
+    A uniform slowdown moves the p99 WITH the tasks, so the watchdog
+    flags outliers (one wedged worker, one pathological input), not
+    load."""
+
+    name = "straggler"
+
+    def install(self, context) -> "StragglerWatchdog":
+        super().install(context)
+        from collections import deque
+        from . import metrics as metrics_mod
+        self._deque = deque
+        self._factor = float(mca_param.get(
+            "profiling.straggler_factor", 3.0))
+        self._window = max(int(mca_param.get(
+            "profiling.straggler_window", 256)), 8)
+        self._min = max(int(mca_param.get(
+            "profiling.straggler_min_samples", 20)), 2)
+        self._lock = threading.Lock()
+        # class -> [window deque, seen count, cached p99 (None = stale)]
+        self._rows: Dict[str, list] = {}
+        self.flagged: List[Dict[str, Any]] = []
+        self._m_flagged = metrics_mod.registry().counter(
+            "parsec_stragglers_total",
+            "task instances flagged by the straggler watchdog "
+            "(body time > rolling p99 x profiling.straggler_factor)",
+            ("class",)) if metrics_mod.enabled() else None
+        self._sub(PinsEvent.EXEC_BEGIN, self._begin)
+        self._sub(PinsEvent.EXEC_END, self._end)
+        return self
+
+    def _begin(self, es, task) -> None:
+        task.prof["straggler_t0"] = time.perf_counter()
+
+    @staticmethod
+    def _p99(samples) -> float:
+        s = sorted(samples)
+        return s[min(int(len(s) * 0.99), len(s) - 1)]
+
+    def _end(self, es, task) -> None:
+        t0 = task.prof.pop("straggler_t0", None)
+        if t0 is None:
+            return
+        dt = time.perf_counter() - t0
+        cls = task.task_class.name
+        flag = None
+        with self._lock:
+            row = self._rows.get(cls)
+            if row is None:
+                row = self._rows[cls] = [
+                    self._deque(maxlen=self._window), 0, None]
+            win, seen, p99 = row
+            if seen >= self._min:
+                if p99 is None or seen % max(self._window // 4, 1) == 0:
+                    p99 = row[2] = self._p99(win)
+                if dt > p99 * self._factor:
+                    flag = {"class": cls,
+                            "locals": list(task.locals),
+                            "body_s": round(dt, 6),
+                            "p99_s": round(p99, 6),
+                            "factor": round(dt / max(p99, 1e-12), 2)}
+                    self.flagged.append(flag)
+            win.append(dt)
+            row[1] = seen + 1
+        if flag is not None:
+            if self._m_flagged is not None:
+                self._m_flagged.labels(**{"class": cls}).inc()
+            debug_verbose(1, "pins",
+                          "straggler: %s%r body %.3f ms > p99 %.3f ms "
+                          "x %.1f", cls, tuple(task.locals),
+                          flag["body_s"] * 1e3, flag["p99_s"] * 1e3,
+                          self._factor)
+
+    def report(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "flagged": list(self.flagged),
+                "classes": {cls: {"seen": row[1],
+                                  "p99_s": (round(self._p99(row[0]), 6)
+                                            if row[0] else None)}
+                            for cls, row in self._rows.items()}}
+
+
 class TenantAccounting(PinsModule):
     """Per-tenant service accounting for the multi-tenant serving
     runtime (ROADMAP item 4): executed tasks and cumulative body
@@ -350,9 +451,23 @@ class TenantAccounting(PinsModule):
 
     def install(self, context) -> "TenantAccounting":
         super().install(context)
+        from . import metrics as metrics_mod
         self._lock = threading.Lock()
         self._rows: Dict[str, Dict[str, float]] = defaultdict(
             lambda: {"tasks": 0, "body_s": 0.0})
+        # unified counter surface: the rows ALSO land in the shared
+        # metrics registry (live /metrics export); the per-instance
+        # dict remains the isolated per-context view report() serves
+        self._m_tasks = self._m_body = None
+        if metrics_mod.enabled():
+            self._m_tasks = metrics_mod.registry().counter(
+                "parsec_tenant_tasks_total",
+                "tasks executed per tenant", ("rank", "tenant"))
+            self._m_body = metrics_mod.registry().counter(
+                "parsec_tenant_body_seconds_total",
+                "cumulative task-body seconds per tenant",
+                ("rank", "tenant"))
+        self._rank = str(context.my_rank)
         self._sub(PinsEvent.EXEC_BEGIN, self._begin)
         self._sub(PinsEvent.EXEC_END, self._end)
         return self
@@ -368,10 +483,14 @@ class TenantAccounting(PinsModule):
     def _end(self, es, task) -> None:
         t0 = task.prof.pop("tenant_t0", None)
         dt = 0.0 if t0 is None else time.perf_counter() - t0
+        ten = self._tenant_of(task)
         with self._lock:
-            row = self._rows[self._tenant_of(task)]
+            row = self._rows[ten]
             row["tasks"] += 1
             row["body_s"] += dt
+        if self._m_tasks is not None:
+            self._m_tasks.labels(rank=self._rank, tenant=ten).inc()
+            self._m_body.labels(rank=self._rank, tenant=ten).inc(dt)
 
     def report(self) -> Dict[str, Any]:
         with self._lock:
@@ -396,6 +515,7 @@ _MODULES = {
     "counters": Counters,
     "overhead": OverheadProfiler,
     "tenant": TenantAccounting,
+    "straggler": StragglerWatchdog,
 }
 
 

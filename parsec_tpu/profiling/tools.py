@@ -12,11 +12,19 @@ CLI::
     python -m parsec_tpu.profiling.tools chrome   out.json rank*.json
     python -m parsec_tpu.profiling.tools csv      out.csv  rank*.json
     python -m parsec_tpu.profiling.tools comms    rank*.json
+    python -m parsec_tpu.profiling.tools critpath <rid> rank*.json
 
 ``summary`` = dbpreader's per-key statistics; ``chrome`` merges ranks
-into one Chrome/Perfetto timeline (pid = rank); ``csv`` is the
-profile2h5 pandas-table analog; ``comms`` reproduces check-comms.py's
-message-count/byte-sum report from the comm msg_size events.
+into one Chrome/Perfetto timeline (pid = rank) ALIGNED onto rank 0's
+clock via each trace's ``meta.clock_offset_s`` (the pingpong handshake
+recorded at dump time — without it, per-process ``perf_counter``
+origins are arbitrary and a multi-rank merge is fiction); ``csv`` is
+the profile2h5 pandas-table analog; ``comms`` reproduces
+check-comms.py's message-count/byte-sum report from the comm msg_size
+events; ``critpath`` reconstructs one request's span tree
+(profiling/spans.py) and prints its admission/queue/exec/wire latency
+breakdown plus the critical path over executed dep edges (pass ``-``
+as the rid to list the requests present).
 """
 
 from __future__ import annotations
@@ -123,21 +131,39 @@ def comms(traces: List[Dict[str, Any]]) -> Dict[str, Any]:
     return out
 
 
+def _align_shifts(traces: List[Dict[str, Any]]) -> List[float]:
+    """Per-trace shift (seconds) landing every rank's events on one
+    clock: ``t0 + clock_offset_s`` from the trace meta, normalized so
+    the earliest trace starts at 0. Metadata-less traces (the
+    single-process format) shift by 0 — byte-compatible."""
+    from .spans import align_shift
+    raw = [align_shift(tr) for tr in traces]
+    if not any(raw):
+        return raw
+    base = min(s for s in raw if s) if any(raw) else 0.0
+    return [s - base if s else 0.0 for s in raw]
+
+
 def merge_chrome(traces: List[Dict[str, Any]]) -> Dict[str, Any]:
-    """Multi-rank Chrome/Perfetto timeline: pid = rank, tid = stream."""
+    """Multi-rank Chrome/Perfetto timeline: pid = rank, tid = stream;
+    ranks aligned onto one clock via the dump-time offset handshake
+    (``meta.clock_offset_s``)."""
     out = []
+    shifts = _align_shifts(traces)
     for rank, tr in enumerate(traces):
+        shift = shifts[rank]
         open_begins: Dict[Tuple, Dict] = {}
         for ev in tr["events"]:
-            us = ev["t"] * 1e6
+            us = (ev["t"] + shift) * 1e6
             k = (ev["key"], ev.get("object"))
             if ev["phase"] == "begin":
                 open_begins[k] = ev
             elif ev["phase"] == "end" and k in open_begins:
                 b = open_begins.pop(k)
+                b_us = (b["t"] + shift) * 1e6
                 out.append({"name": ev["key"], "ph": "X", "pid": rank,
-                            "tid": b["stream"], "ts": b["t"] * 1e6,
-                            "dur": us - b["t"] * 1e6,
+                            "tid": b["stream"], "ts": b_us,
+                            "dur": us - b_us,
                             "args": ev.get("info") or {}})
             else:
                 out.append({"name": f"{ev['key']}:{ev['phase']}",
@@ -190,6 +216,13 @@ def main(argv: Sequence[str] = None) -> int:
     v.add_argument("traces", nargs="+")
     m = sub.add_parser("comms", help="comm volume report (check-comms)")
     m.add_argument("traces", nargs="+")
+    k = sub.add_parser("critpath", help="one request's span tree: "
+                       "latency breakdown + critical path ('-' lists "
+                       "the rids present)")
+    k.add_argument("rid")
+    k.add_argument("traces", nargs="+")
+    k.add_argument("--json", action="store_true",
+                   help="emit the report as JSON instead of text")
     args = p.parse_args(argv)
 
     traces = load_ranks(args.traces)
@@ -206,6 +239,18 @@ def main(argv: Sequence[str] = None) -> int:
     elif args.cmd == "comms":
         json.dump(comms(traces), sys.stdout, indent=1)
         print()
+    elif args.cmd == "critpath":
+        from . import spans
+        if args.rid == "-":
+            for r in spans.rids(traces):
+                print(r)
+            return 0
+        rep = spans.critpath(traces, args.rid)
+        if args.json:
+            json.dump(rep, sys.stdout, indent=1)
+            print()
+        else:
+            print(spans.render_critpath(rep))
     return 0
 
 

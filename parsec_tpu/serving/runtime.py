@@ -145,6 +145,7 @@ class _PoolAdmission:
         timeout = float(mca_param.get("serving.backpressure_timeout_s",
                                       5.0))
         deadline = time.monotonic() + timeout
+        park_t0 = None        # perf_counter stamp of the first park
         with ten.cv:
             while True:
                 if ten.quarantined is not None:
@@ -181,6 +182,8 @@ class _PoolAdmission:
                         f"{timeout:.1f}s "
                         f"(serving.backpressure_timeout_s) at depth "
                         f"{ten.inflight}")
+                if park_t0 is None:
+                    park_t0 = time.perf_counter()
                 ten._waiters += 1
                 try:
                     ten.cv.wait(min(left, 0.25))
@@ -189,6 +192,28 @@ class _PoolAdmission:
             ten.inflight += n
             self.admitted += n
             ten.stats["rows_admitted"] += n
+        if park_t0 is not None:
+            self._record_park(tp, ten, park_t0, n)
+
+    def _record_park(self, tp: Taskpool, ten: Tenant,
+                     park_t0: float, n: int) -> None:
+        """Record a backpressure park as an ``admission`` span of the
+        request's trace (only actual waits — an unthrottled admit adds
+        zero events). Recorded after the fact with explicit times."""
+        tr = self.runtime.ctx.trace
+        rid = getattr(tp, "trace_rid", None)
+        if tr is None or rid is None:
+            return
+        from ..profiling import spans as spans_mod
+        sid = spans_mod.next_span_id(self.runtime.ctx.my_rank)
+        now = time.perf_counter()
+        info = {"rid": rid, "span": sid,
+                "parent": getattr(tp, "root_span", None),
+                "tenant": ten.name, "rows": n}
+        tr.event("admission", "begin", t=park_t0 - tr.t0,
+                 object_id=tp.name, info=info)
+        tr.event("admission", "end", t=now - tr.t0,
+                 object_id=tp.name, info=info)
 
     def on_retire(self, _tp: Taskpool) -> None:
         ten = self.tenant
@@ -287,6 +312,14 @@ class ServingRuntime:
             # slot would hand a tenant's successor straight to the
             # worker, starving the arbitration)
             context._bypass_chain = False
+        # always-on per-tenant request-latency distribution
+        # (profiling/metrics.py): observed once per finished
+        # submission, exported as a log2-bucket Prometheus histogram
+        from ..profiling import metrics as metrics_mod
+        self._m_latency = metrics_mod.registry().histogram(
+            "parsec_request_latency_seconds",
+            "submission latency (submit -> pool termination) per "
+            "tenant", ("tenant",)) if metrics_mod.enabled() else None
 
     # ------------------------------------------------------------ tenants
     def tenant(self, name: str, weight: float = 1.0,
@@ -428,6 +461,20 @@ class ServingRuntime:
         tp.fair_weight = weight if weight is not None else ten.weight
         tp.rank_scope = scope
         tp.error_owned = True
+        # request-scoped distributed tracing (profiling/spans.py): the
+        # rid derives from the taskpool NAME (the cross-rank registry
+        # identity), so every rank of a distributed submission mints
+        # the SAME rid without any exchange — one span tree spans the
+        # mesh; the root span parents startup tasks and admission parks
+        from ..profiling import spans as spans_mod
+        if getattr(tp, "trace_rid", None) is None:
+            tp.trace_rid = spans_mod.mint_rid(tp.name)
+        tp.root_span = f"{tp.trace_rid}#root{self.ctx.my_rank}"
+        tr = self.ctx.trace
+        if tr is not None:
+            tr.event("req", "begin", object_id=tp.trace_rid,
+                     info={"rid": tp.trace_rid, "span": tp.root_span,
+                           "parent": None, "tenant": ten.name})
         adm = None
         if hasattr(tp, "insert_task") and hasattr(tp, "admission"):
             adm = _PoolAdmission(self, ten)
@@ -487,6 +534,16 @@ class ServingRuntime:
         tp = sub.tp
         ten = sub.tenant
         sub.finished_t = time.monotonic()
+        if self._m_latency is not None:
+            self._m_latency.labels(tenant=ten.name).observe(
+                sub.finished_t - sub.submitted_t)
+        tr = self.ctx.trace
+        rid = getattr(tp, "trace_rid", None)
+        if tr is not None and rid is not None:
+            tr.event("req", "end", object_id=rid,
+                     info={"rid": rid, "span": tp.root_span,
+                           "error": (str(tp.error)[:120]
+                                     if tp.error else None)})
         adm = getattr(tp, "admission", None)
         if isinstance(adm, _PoolAdmission):
             adm.close()

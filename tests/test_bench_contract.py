@@ -338,3 +338,35 @@ def test_amort_section_registered():
     assert compact["detail"]["amort_panel_new_n_2_compiles"] == 0
     assert compact["detail"]["amort_panel_warm_start_s"] == 0.2
     assert compact["detail"]["amort_wf_warm_compiles"] == 7
+
+
+def test_observability_section_registered():
+    """--section observability is a first-class section (ISSUE 9 bench
+    contract): registry, error keys, compact summary, and the
+    obs_overhead_pct guard stay wired together — the ON rate rides the
+    throughput drop-guard, the overhead pct the rise-guard arm."""
+    bench = _load_bench()
+    assert "observability" in bench.SECTIONS
+    assert bench._SECTION_KEYS["observability"] == ("observability",)
+    assert "obs_tasks_per_sec" in bench._GFLOPS_GUARD_KEYS
+    assert "obs_overhead_pct" in bench._LATENCY_GUARD_KEYS
+    result = _fat_result()
+    result["detail"]["extra_configs"]["observability"] = {
+        "tasks_per_sec_off": 17322.8, "tasks_per_sec_on": 16744.6,
+        "obs_overhead_pct": 3.45, "obs_overhead_ok": True}
+    compact = json.loads(bench._compact_summary(result))
+    assert compact["detail"]["obs_overhead_pct"] == 3.45
+    assert compact["detail"]["obs_tasks_per_sec"] == 16744.6
+
+
+def test_obs_overhead_guard_fires_on_rise():
+    bench = _load_bench()
+    prior = {"obs_overhead_pct": 3.0, "obs_tasks_per_sec": 16000.0}
+    out = bench._compare_captures(
+        {"obs_overhead_pct": 6.0, "obs_tasks_per_sec": 12000.0}, prior)
+    assert "obs_overhead_pct" in out["latency_regression"]
+    assert "obs_tasks_per_sec" in out["throughput_regression"]
+    # within-band stays quiet
+    assert bench._compare_captures(
+        {"obs_overhead_pct": 3.2, "obs_tasks_per_sec": 15800.0},
+        prior) == {}

@@ -1,0 +1,312 @@
+"""Always-on metrics plane (profiling/metrics.py): registry units,
+Prometheus exposition, the /metrics + /statusz HTTP listener, and the
+tier-1 scrape smoke over the serving decode loop (ISSUE 9)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import parsec_tpu as parsec
+from parsec_tpu import dtd, serving
+from parsec_tpu.profiling import metrics
+from parsec_tpu.serving.decode import DecodeConfig, DecodeEngine
+from parsec_tpu.utils import mca_param
+
+
+# ---------------------------------------------------------------------------
+# registry units
+# ---------------------------------------------------------------------------
+
+def test_counter_shards_aggregate_across_threads():
+    reg = metrics.MetricsRegistry()
+    c = reg.counter("u_total", "unit", ("k",)).labels(k="a")
+
+    def worker():
+        for _ in range(1000):
+            c.inc()
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # per-thread shards: no lock on the inc path, exact at read time
+    # (shard COUNT may be below 4 — thread ids are reused)
+    assert c.value() == 4000
+    assert len(c._shards) >= 1
+
+
+def test_histogram_log2_buckets_cumulative():
+    reg = metrics.MetricsRegistry()
+    h = reg.histogram("lat_seconds", "lat", ("t",)).labels(t="x")
+    for v in (0.0009, 0.0011, 0.5, 0.7, 3.0):
+        h.observe(v)
+    buckets, total, count = h.snapshot()
+    assert count == 5
+    assert total == pytest.approx(4.202)
+    text = reg.to_prometheus_text()
+    # cumulative buckets end with the +Inf count == _count
+    assert 'lat_seconds_bucket{t="x",le="+Inf"} 5' in text
+    assert 'lat_seconds_count{t="x"} 5' in text
+    # an exact power of two lands in its own le (0.5 -> le=0.5)
+    assert 'le="0.5"' in text
+
+
+def test_gauge_function_and_collector():
+    reg = metrics.MetricsRegistry()
+    g = reg.gauge("depth", "queue depth", ("q",))
+    g.labels(q="a").set_function(lambda: 7)
+    calls = []
+
+    def collector():
+        calls.append(1)
+        g.labels(q="b").set(3)
+
+    reg.register_collector(collector)
+    d = reg.to_dict()
+    vals = {tuple(r["labels"].items()): r["value"]
+            for r in d["depth"]["values"]}
+    assert vals[(("q", "a"),)] == 7
+    assert vals[(("q", "b"),)] == 3
+    assert calls  # collector ran at scrape time
+
+    def bad():
+        raise RuntimeError("boom")
+
+    reg.register_collector(bad)
+    reg.to_prometheus_text()          # one bad collector must not sink
+    assert reg.collector_errors >= 1  # the scrape — counted, not raised
+
+
+def test_family_reregistration_type_checked():
+    reg = metrics.MetricsRegistry()
+    reg.counter("x_total", "h", ("a",))
+    assert reg.counter("x_total", "h", ("a",)) is reg.counter(
+        "x_total", "h", ("a",))
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "h", ("a",))
+    with pytest.raises(ValueError):
+        reg.counter("x_total", "h", ("b",))
+
+
+def test_wire_counters_live_in_registry_with_view():
+    """Satellite: CommEngine.stats_by_kind is a VIEW over the shared
+    registry (per-engine children) — two engines at the same rank stay
+    separable via the engine label."""
+    from parsec_tpu.comm.local import LocalCommEngine
+    e1, e2 = LocalCommEngine.make_fabric(2)
+    e1.record_msg("sent", "activate", 1, 100)
+    e1.record_msg("sent", "activate", 1, 50)
+    e1.record_msg("recv", "bcast", 1, 10)
+    assert e1.stats_by_kind["activate"] == {
+        "sent_msgs": 2, "sent_bytes": 150,
+        "recv_msgs": 0, "recv_bytes": 0}
+    assert "activate" not in e2.stats_by_kind     # per-engine isolation
+    text = metrics.registry().to_prometheus_text()
+    assert "parsec_wire_msgs_total" in text
+    assert f'engine="{e1._engine_id}"' in text
+
+
+# ---------------------------------------------------------------------------
+# exposition-format parser (the scrape-side contract)
+# ---------------------------------------------------------------------------
+
+def parse_prometheus(text):
+    """Minimal exposition-format 0.0.4 parser: returns
+    {metric_name: [(labels dict, float value)]}; raises on malformed
+    lines — the smoke's 'parses as Prometheus' assertion."""
+    out = {}
+    types = {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            if kind not in ("counter", "gauge", "histogram", "summary",
+                            "untyped"):
+                raise ValueError(f"bad TYPE: {line!r}")
+            types[name] = kind
+            continue
+        if line.startswith("#"):
+            raise ValueError(f"bad comment: {line!r}")
+        name, _, rest = line.partition("{")
+        if rest:
+            labels_s, _, val_s = rest.rpartition("} ")
+            labels = {}
+            for part in labels_s.split('","'):
+                k, _, v = part.partition('="')
+                labels[k] = v.rstrip('"')
+        else:
+            name, _, val_s = line.partition(" ")
+            labels = {}
+        out.setdefault(name, []).append((labels, float(val_s)))
+    if not out:
+        raise ValueError("no samples")
+    return out
+
+
+def test_parser_rejects_garbage():
+    with pytest.raises(ValueError):
+        parse_prometheus("# TYPE x nonsense\nx 1\n")
+    with pytest.raises(ValueError):
+        parse_prometheus("")
+
+
+# ---------------------------------------------------------------------------
+# HTTP listener + statusz
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=10.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+def test_http_listener_serves_metrics_and_statusz():
+    srv = metrics.serve_http(0, statusz_fn=lambda: {"ok": True})
+    try:
+        reg = metrics.registry()
+        reg.counter("listener_probe_total", "p").labels().inc()
+        text = _get(f"http://127.0.0.1:{srv.port}/metrics")
+        parsed = parse_prometheus(text)
+        assert "listener_probe_total" in parsed
+        sz = json.loads(_get(f"http://127.0.0.1:{srv.port}/statusz"))
+        assert sz == {"ok": True}
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 scrape smoke over the serving decode loop (ISSUE 9 satellite)
+# ---------------------------------------------------------------------------
+
+def test_metrics_scrape_during_serving_decode_smoke():
+    """Scrape /metrics WHILE the serving decode smoke runs: the payload
+    must parse as Prometheus exposition format and carry the always-on
+    per-tenant request-latency histogram, wire/task counters, and the
+    tenant admission gauges."""
+    t_start = time.monotonic()
+    mca_param.set("sched", "wfq")
+    srv = None
+    try:
+        ctx = parsec.init(nb_cores=4)
+        # port 0 = ephemeral; production sets serving.metrics_port and
+        # Context starts the listener itself
+        srv = metrics.serve_http(0, statusz_fn=ctx.statusz)
+        rt = serving.enable(ctx)
+        ctx.start()
+        cfg = DecodeConfig(d_model=16, n_heads=2, kv_tile=4)
+        ea = DecodeEngine(ctx, "scrapeA", cfg=cfg,
+                          tenant=rt.tenant("A", weight=3.0)).start()
+        eb = DecodeEngine(ctx, "scrapeB", cfg=cfg,
+                          tenant=rt.tenant("B", weight=1.0)).start()
+        for rid in range(3):
+            ea.request(rid, 5)
+            eb.request(rid, 5)
+        # scrape MID-LOAD: the always-on plane must serve while the
+        # decode DAGs are in flight
+        mid = parse_prometheus(_get(
+            f"http://127.0.0.1:{srv.port}/metrics"))
+        assert "parsec_tasks_completed_total" in mid
+        fa, fb = ea.drain(30.0), eb.drain(30.0)
+        assert len(fa) == 3 and len(fb) == 3
+        # the decode engines hold ONE persistent pool each; close()
+        # finishes the submissions, which observes the latencies
+        ea.close()
+        eb.close()
+        final = parse_prometheus(_get(
+            f"http://127.0.0.1:{srv.port}/metrics"))
+        # per-tenant request-latency histogram with both tenants
+        lat = final["parsec_request_latency_seconds_count"]
+        tenants = {labels.get("tenant") for labels, _v in lat}
+        assert {"A", "B"} <= tenants
+        counts = {labels["tenant"]: v for labels, v in lat}
+        assert counts["A"] >= 1 and counts["B"] >= 1
+        # tenant admission state gauges from the context collector
+        assert "parsec_tenant_state" in final
+        # statusz JSON parses and carries the serving report
+        sz = json.loads(_get(f"http://127.0.0.1:{srv.port}/statusz"))
+        assert "metrics" in sz and "serving" in sz
+        assert sz["serving"]["stats"]["submitted"] >= 2
+        parsec.fini(ctx)
+    finally:
+        if srv is not None:
+            srv.shutdown()
+        mca_param.unset("sched")
+    assert time.monotonic() - t_start < 60.0
+
+
+def test_statusz_direct_and_latency_histogram(ctx):
+    """Context.statusz() without the HTTP listener; the serving
+    histogram observes a plain DTD submission too."""
+    from parsec_tpu.data import LocalCollection
+    rt = serving.enable(ctx)
+    tp = dtd.Taskpool("szpool")
+    sub = ctx.submit(tp, tenant="tz")
+    S = LocalCollection("S", {(0,): np.zeros(2, np.float32)})
+    tp.insert_task(lambda x: x + 1, dtd.TileArg(S, (0,), dtd.INOUT))
+    tp.wait()
+    sub.wait()
+    sz = ctx.statusz()
+    assert sz["scheduler"] == ctx.scheduler.name
+    assert "parsec_tasks_completed_total" in sz["metrics"]
+    rows = sz["metrics"]["parsec_request_latency_seconds"]["values"]
+    assert any(r["labels"].get("tenant") == "tz" and r["count"] >= 1
+               for r in rows)
+    json.dumps(sz)      # the whole statusz payload is JSON-able
+
+
+def test_collector_prunes_dead_pools_and_unhooks():
+    """A persistent serving registry stays BOUNDED: gauge children for
+    pools that finished are pruned at the next scrape, and a context's
+    uninstall closure removes everything its collector set."""
+    reg = metrics.registry()
+    mca_param.set("sched", "wfq")
+    try:
+        ctx = parsec.init(nb_cores=2)
+        rt = serving.enable(ctx)
+        ctx.start()
+        from parsec_tpu.data import LocalCollection
+        S = LocalCollection("SP", {(0,): np.zeros(2, np.float32)})
+        for i in range(3):
+            tp = dtd.Taskpool(f"ephemeral{i}")
+            sub = ctx.submit(tp, tenant="tp")
+            tp.insert_task(lambda x: x + 1,
+                           dtd.TileArg(S, (0,), dtd.INOUT))
+            tp.wait()
+            sub.wait()
+        reg.to_dict()                       # scrape: prunes finished pools
+        pool_rows = reg.to_dict().get("parsec_pool_tasks",
+                                      {}).get("values", [])
+        pools = {r["labels"]["pool"] for r in pool_rows}
+        # wfq keeps the LAST finished pool in pool_stats until its next
+        # select() pass — bounded; the earlier ones must be pruned
+        stale = {p for p in pools if p.startswith("ephemeral")}
+        assert stale <= {"ephemeral2"}, pools
+        parsec.fini(ctx)                    # unhook removes the rest
+        d = reg.to_dict()
+        ready = [r for r in d["parsec_sched_ready_tasks"]["values"]
+                 if r["labels"]["rank"] == str(ctx.my_rank)]
+        # this context's children are gone (another live test context
+        # at the same rank could legitimately re-add them)
+        assert all("ephemeral" not in json.dumps(r) for r in ready)
+    finally:
+        mca_param.unset("sched")
+
+
+def test_engine_disable_unexports_but_view_survives():
+    from parsec_tpu.comm.local import LocalCommEngine
+    reg = metrics.registry()
+    e1, e2 = LocalCommEngine.make_fabric(2)
+    e1.record_msg("sent", "activate", 1, 64)
+    text = reg.to_prometheus_text()
+    assert f'engine="{e1._engine_id}"' in text
+    e1.disable()
+    text = reg.to_prometheus_text()
+    assert f'engine="{e1._engine_id}"' not in text   # unexported
+    assert e1.stats_by_kind["activate"]["sent_msgs"] == 1  # view lives

@@ -1,0 +1,384 @@
+"""Distributed request tracing (ISSUE 9): span reconstruction units,
+the per-stream trace ring buffers, the straggler watchdog, and the
+2-rank socket golden-file round-trip — ``tools chrome/csv/comms`` +
+``critpath`` over a serving trace spanning two real processes, with
+clock-offset alignment assertions."""
+
+import csv
+import json
+import multiprocessing as mp
+import os
+import time
+
+import numpy as np
+import pytest
+
+import parsec_tpu as parsec
+from parsec_tpu import dtd, serving
+from parsec_tpu.data import LocalCollection
+from parsec_tpu.profiling import Trace, spans, tools
+from parsec_tpu.utils import mca_param
+
+
+# ---------------------------------------------------------------------------
+# trace ring buffers (satellite: per-stream recording, bounded + counted)
+# ---------------------------------------------------------------------------
+
+def test_trace_ring_bounded_with_drop_counter():
+    tr = Trace(max_events=8)
+    for i in range(20):
+        tr.event("k", "begin", object_id=i)
+    recs = tr.to_records()
+    assert len(recs) == 8                      # bounded
+    assert tr.dropped() == 12                  # honesty counter
+    assert [r["object"] for r in recs] == list(range(12, 20))  # oldest out
+    assert tr.meta()["dropped"] == 12
+
+
+def test_trace_max_events_knob():
+    mca_param.set("profiling.trace_max_events", 4)
+    try:
+        tr = Trace()
+        for i in range(10):
+            tr.event("k", "begin", object_id=i)
+        assert len(tr.to_records()) == 4
+        assert tr.dropped() == 6
+    finally:
+        mca_param.unset("profiling.trace_max_events")
+
+
+def test_trace_rings_are_per_thread():
+    import threading
+    tr = Trace(max_events=100)
+
+    def rec(n):
+        for i in range(n):
+            tr.event("k", "begin", object_id=i)
+
+    ts = [threading.Thread(target=rec, args=(10,)) for _ in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    rec(5)
+    assert len(tr.to_records()) == 35
+    # one ring per recording thread (ids may be reused across exits)
+    assert len(tr._rings) >= 2
+
+
+# ---------------------------------------------------------------------------
+# span reconstruction units (synthetic traces)
+# ---------------------------------------------------------------------------
+
+def _ev(key, phase, t, info, obj=None):
+    return {"key": key, "phase": phase, "t": t, "stream": 0,
+            "object": obj, "info": info}
+
+
+def _synthetic_traces():
+    """Two ranks with WILDLY different perf_counter origins; the meta
+    offset is what makes the merge sane."""
+    rid = "req:p"
+    r0 = {"meta": {"rank": 0, "t0": 1000.0, "clock_offset_s": 0.0},
+          "events": [
+              _ev("req", "begin", 0.0,
+                  {"rid": rid, "span": "root", "parent": None}, rid),
+              _ev("task", "begin", 0.001,
+                  {"rid": rid, "span": "0:1", "parent": "root",
+                   "q_us": 100.0}, "A"),
+              _ev("task", "end", 0.003, {"rid": rid, "span": "0:1"},
+                  "A"),
+              _ev("wire", "sent", 0.003,
+                  {"rid": rid, "span": "0:2", "parent": "0:1",
+                   "src": 0, "dst": 1, "nbytes": 64}, 1),
+              _ev("req", "end", 0.010, {"rid": rid, "span": "root"},
+                  rid)]}
+    # rank 1's clock origin is 5000 but offset −4000 lands it at 1000
+    r1 = {"meta": {"rank": 1, "t0": 5000.0, "clock_offset_s": -4000.0},
+          "events": [
+              _ev("wire", "recv", 0.004,
+                  {"rid": rid, "span": "0:2", "parent": "0:1",
+                   "src": 0, "dst": 1, "nbytes": 64}, 0),
+              _ev("task", "begin", 0.005,
+                  {"rid": rid, "span": "1:1", "parent": "0:2",
+                   "q_us": 50.0}, "B"),
+              _ev("task", "end", 0.008, {"rid": rid, "span": "1:1"},
+                  "B")]}
+    return [r0, r1]
+
+
+def test_build_spans_aligns_and_parents():
+    traces = _synthetic_traces()
+    nodes = spans.build_spans(traces, rid="req:p")
+    assert set(nodes) == {"root", "0:1", "0:2", "1:1"}
+    wire = nodes["0:2"]
+    assert wire["kind"] == "wire"
+    assert wire["edges"] == [{"src": 0, "dst": 1,
+                              "t_sent": pytest.approx(1000.003),
+                              "t_recv": pytest.approx(1000.004)}]
+    assert nodes["1:1"]["parent"] == "0:2"     # task ← wire hop
+    assert wire["parent"] == "0:1"             # wire hop ← sending task
+    # aligned: the rank-1 task starts after the rank-0 send
+    assert nodes["1:1"]["t0"] > nodes["0:1"]["t1"]
+
+
+def test_critpath_breakdown_and_path():
+    rep = spans.critpath(_synthetic_traces(), "req:p")
+    bd = rep["breakdown"]
+    assert bd["exec_ms"] == pytest.approx(5.0)       # 2ms + 3ms
+    assert bd["queue_ms"] == pytest.approx(0.15)
+    assert bd["wire_ms"] == pytest.approx(1.0)
+    assert rep["ranks"] == [0, 1]
+    kinds = [p["kind"] for p in rep["critical_path"]]
+    assert kinds == ["req", "task", "wire", "task"]
+    assert rep["critical_path_ms"] == pytest.approx(2 + 1 + 3.0)
+    out = spans.render_critpath(rep)
+    assert "breakdown" in out and "wire" in out
+    with pytest.raises(ValueError):
+        spans.critpath(_synthetic_traces(), "req:nope")
+
+
+def test_merge_chrome_applies_clock_shift():
+    doc = tools.merge_chrome(_synthetic_traces())
+    evs = {(e["pid"], e["name"]): e for e in doc["traceEvents"]}
+    a = evs[(0, "task")]
+    b = evs[(1, "task")]
+    # without the shift rank 1 would sit ~4000 s away; aligned they
+    # are microseconds apart and B begins after A ends
+    assert b["ts"] > a["ts"] + a["dur"]
+    assert b["ts"] - a["ts"] < 1e6
+
+
+# ---------------------------------------------------------------------------
+# single-process serving span tree (loopback of the full wiring)
+# ---------------------------------------------------------------------------
+
+def test_local_submission_yields_span_tree():
+    ctx = parsec.init(nb_cores=2)
+    try:
+        serving.enable(ctx)
+        tr = Trace().install(ctx)
+        ctx.start()
+        tp = dtd.Taskpool("spanpool")
+        sub = ctx.submit(tp, tenant="t")
+        S = LocalCollection("S", {(0,): np.zeros(2, np.float32)})
+        for _ in range(4):
+            tp.insert_task(lambda x: x + 1,
+                           dtd.TileArg(S, (0,), dtd.INOUT))
+        tp.wait()
+        sub.wait()
+        doc = {"meta": tr.meta(), "events": tr.to_records()}
+        assert spans.rids([doc]) == ["req:spanpool"]
+        rep = spans.critpath([doc], "req:spanpool")
+        assert rep["n_tasks"] == 4
+        # RAW chain: every task parents to its predecessor, root first
+        kinds = [p["kind"] for p in rep["critical_path"]]
+        assert kinds == ["req"] + ["task"] * 4
+        assert rep["breakdown"]["exec_ms"] > 0
+    finally:
+        parsec.fini(ctx)
+
+
+# ---------------------------------------------------------------------------
+# straggler watchdog (online PINS module)
+# ---------------------------------------------------------------------------
+
+def test_straggler_watchdog_flags_outlier(ctx):
+    from parsec_tpu.profiling.pins_modules import new_module
+    mca_param.set("profiling.straggler_min_samples", 10)
+    try:
+        mod = new_module("straggler").install(ctx)
+        tp = dtd.Taskpool("strag")
+        ctx.add_taskpool(tp)
+
+        def body(d):
+            time.sleep(d)
+
+        # the rolling p99 is PER CLASS: the straggler is an outlier
+        # INSTANCE of the same class, not a slow different class
+        tp.insert_tasks(body, [(dtd.ValueArg(0.001),)
+                               for _ in range(30)])
+        tp.insert_task(body, dtd.ValueArg(0.12))
+        tp.wait()
+        rep = mod.report()
+        flagged = [f for f in rep["flagged"] if f["body_s"] > 0.05]
+        assert flagged, rep
+        assert flagged[0]["factor"] > 3.0
+        assert rep["classes"]["body"]["seen"] == 31
+        mod.uninstall()
+    finally:
+        mca_param.unset("profiling.straggler_min_samples")
+
+
+# ---------------------------------------------------------------------------
+# 2-rank socket golden-file round-trip (the tentpole's acceptance)
+# ---------------------------------------------------------------------------
+
+def _free_port_base():
+    from parsec_tpu.comm.pingpong import _free_port_base as fpb
+    return fpb(2)
+
+
+_N_STEPS = 8
+
+
+def _rank_main(rank, base_port, outdir, q):
+    try:
+        from parsec_tpu.comm.socket_engine import SocketCommEngine
+        mca_param.set("runtime.stage_reads", "0")
+        mca_param.set("comm.stage_recv", "0")
+        engine = SocketCommEngine(rank, 2, base_port=base_port)
+        ctx = parsec.init(nb_cores=2, comm=engine)
+        serving.enable(ctx)
+        tr = Trace().install(ctx)
+        ctx.start()
+
+        class AltVec:
+            """Two scalar tiles, one owned per rank."""
+            name = "A"
+            dc_id = 7
+
+            def __init__(self):
+                self.v = {0: np.zeros(8, np.float32),
+                          1: np.ones(8, np.float32)}
+
+            def rank_of(self, key):
+                return key[0] % 2
+
+            def data_of(self, key):
+                return self.v[key[0]]
+
+            def write_tile(self, key, value):
+                self.v[key[0]] = value
+
+        A = AltVec()
+        tp = dtd.Taskpool("traced")
+        sub = ctx.submit(tp, tenant="golden", rank_scope="all")
+
+        def step(mine, other):
+            return mine + other
+
+        # task k runs on rank k%2 and READS the tile the other rank's
+        # previous task wrote: every step is one cross-rank RAW edge
+        for k in range(_N_STEPS):
+            tp.insert_task(
+                step,
+                dtd.TileArg(A, (k % 2,), dtd.INOUT, affinity=True),
+                dtd.TileArg(A, ((k + 1) % 2,), dtd.INPUT))
+        tp.wait()
+        sub.wait()
+        engine.sync()
+        # dump BEFORE fini: the clock handshake needs the comm thread
+        tr.dump_json(os.path.join(outdir, f"rank{rank}.json"))
+        engine.sync()
+        ctx.fini()
+        q.put((rank, "ok", None))
+    except BaseException as exc:  # noqa: BLE001 — report to parent
+        import traceback
+        q.put((rank, "error", f"{exc}\n{traceback.format_exc()}"))
+
+
+@pytest.fixture(scope="module")
+def golden_traces(tmp_path_factory):
+    """Run the 2-rank serving job once; every round-trip test reads the
+    same pair of dumped rank traces (the golden files)."""
+    outdir = str(tmp_path_factory.mktemp("traces"))
+    mpctx = mp.get_context("spawn")
+    q = mpctx.Queue()
+    base_port = _free_port_base()
+    procs = [mpctx.Process(target=_rank_main,
+                           args=(r, base_port, outdir, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    try:
+        for _ in range(2):
+            rank, status, err = q.get(timeout=120)
+            assert status == "ok", f"rank {rank} failed:\n{err}"
+    finally:
+        for p in procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+    paths = [os.path.join(outdir, f"rank{r}.json") for r in range(2)]
+    return paths, tools.load_ranks(paths)
+
+
+def test_two_rank_span_tree_spans_both_ranks(golden_traces):
+    """Acceptance: ONE span tree spanning both ranks, wire-hop spans
+    parented to the sending task, rank-1 spans landing after their
+    rank-0 parent sends (clock-offset alignment)."""
+    _paths, traces = golden_traces
+    assert spans.rids(traces) == ["req:traced"]
+    # rank 1 measured a real cross-process clock offset
+    assert traces[0]["meta"]["clock_offset_s"] == 0.0
+    assert traces[1]["meta"]["clock_offset_s"] != 0.0
+    assert traces[1]["meta"].get("clock_rtt_us", 0) > 0
+    nodes = spans.build_spans(traces, rid="req:traced")
+    tasks = [n for n in nodes.values() if n["kind"] == "task"]
+    wires = [n for n in nodes.values() if n["kind"] == "wire"]
+    assert {n["rank"] for n in tasks} == {0, 1}
+    assert wires, "no wire-hop spans recorded"
+    # every wire hop is parented to a task (or root) span, and every
+    # hop's receiving-side task is parented to the hop
+    for w in wires:
+        assert w["parent"] in nodes
+    hop_ids = {sid for sid, n in nodes.items() if n["kind"] == "wire"}
+    wire_parented = [t for t in tasks if t["parent"] in hop_ids]
+    assert wire_parented, "no task parented to a wire hop"
+    # clock alignment: a task released by a wire hop starts AFTER the
+    # hop's send left the other rank (margin = handshake RTT)
+    margin = traces[1]["meta"]["clock_rtt_us"] / 1e6 + 1e-3
+    for t in wire_parented:
+        hop = nodes[t["parent"]]
+        assert t["t0"] >= hop["t0"] - margin, (t, hop)
+        for e in hop.get("edges", ()):
+            assert e["t_recv"] >= e["t_sent"] - margin, e
+
+
+def test_two_rank_critpath_breakdown(golden_traces):
+    _paths, traces = golden_traces
+    rep = spans.critpath(traces, "req:traced")
+    assert rep["ranks"] == [0, 1]
+    assert rep["n_tasks"] == _N_STEPS
+    bd = rep["breakdown"]
+    assert bd["exec_ms"] > 0 and bd["wire_ms"] > 0
+    # the chain alternates ranks, so the critical path must cross a
+    # wire hop between tasks of different ranks
+    kinds = [p["kind"] for p in rep["critical_path"]]
+    assert "wire" in kinds and kinds.count("task") >= 2
+    out = spans.render_critpath(rep)
+    assert "req:traced" in out
+
+
+def test_two_rank_tools_chrome_csv_comms_roundtrip(golden_traces,
+                                                   tmp_path):
+    """Golden-file round-trip of the CLI surface over the 2-rank
+    serving trace: chrome merge (aligned), csv table, comms report,
+    critpath — all through main()."""
+    paths, traces = golden_traces
+    chrome = str(tmp_path / "merged.json")
+    assert tools.main(["chrome", chrome] + paths) == 0
+    doc = json.load(open(chrome))
+    pids = {e["pid"] for e in doc["traceEvents"]}
+    assert pids == {0, 1}
+    # aligned timeline: every rank-1 'task' X-event overlaps the
+    # request window, not a ±hours-away perf_counter origin
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"
+          and e["name"] == "task"]
+    ts = [e["ts"] for e in xs]
+    assert max(ts) - min(ts) < 60e6       # within one minute of window
+
+    out_csv = str(tmp_path / "events.csv")
+    assert tools.main(["csv", out_csv] + paths) == 0
+    rows = list(csv.DictReader(open(out_csv)))
+    assert {r["rank"] for r in rows} == {"0", "1"}
+    assert any(r["key"] == "wire" for r in rows)
+
+    rep = tools.comms(traces)
+    assert rep["total"]["activations_sent"] > 0
+    assert rep["total"]["activations_sent"] == \
+        rep["total"]["activations_recv"]
+
+    assert tools.main(["critpath", "req:traced"] + paths) == 0
+    assert tools.main(["critpath", "-"] + paths) == 0
