@@ -854,53 +854,105 @@ def _section_taskrate():
     N independent zero-flow DTD tasks with trivial CPU bodies through
     the full host-runtime path (insert → dep-track → schedule → select →
     dispatch → release), so the rate IS the per-task runtime overhead
-    budget. The headline rate is a raw run (median of 3); a second,
-    instrumented run (``runtime.stage_timers`` via the ``overhead`` PINS
-    module) reports the per-stage breakdown. Host-only: the TPU device
-    is disabled so the section never touches (or waits on) the chip."""
+    budget. Interleaved A/B across ``runtime.native_dtd`` (ISSUE 10):
+    the headline ``tasks_per_sec`` is the NATIVE engine (the shipped
+    default when the library builds — insert/dep-count/select/steal/
+    release behind the C ABI, the registered no-op body never entering
+    Python); ``tasks_per_sec_python`` keeps the Python engine's rate and
+    ``native_stage_counts`` reads the native engine's per-stage atomics.
+    A further instrumented run (``runtime.stage_timers`` via the
+    ``overhead`` PINS module — which itself keeps the pool on the
+    Python path per the fallback rule) reports the Python per-stage
+    breakdown. Host-only: the TPU device is disabled so the section
+    never touches (or waits on) the chip."""
     import parsec_tpu as parsec
     from parsec_tpu import dtd
     from parsec_tpu.core.task import DeviceType
+    from parsec_tpu.dsl.dtd_native import register_native_body
     from parsec_tpu.profiling.pins_modules import new_module
 
+    from parsec_tpu import _native
+
+    register_native_body(_null_task_body)
     mca_param.set("device.tpu.enabled", False)
     N = int(os.environ.get("PARSEC_BENCH_TASKRATE_N", 20000))
     nb_cores = int(os.environ.get("PARSEC_BENCH_TASKRATE_CORES", 4))
+    # no toolchain: degrade to the Python-only measurement (forcing
+    # native=1 would raise by design) and say so in the row
+    native_ok = _native.available()
 
-    def run(n, instrument=False, cores=None):
-        ctx = parsec.init(nb_cores=cores or nb_cores)
-        mod = new_module("overhead").install(ctx) if instrument else None
-        ctx.start()
-        tp = dtd.Taskpool("taskrate")
-        ctx.add_taskpool(tp)
-        t0 = time.perf_counter()
-        tp.insert_tasks(_null_task_body, [() for _ in range(n)],
-                        device=DeviceType.CPU)
-        tp.wait()
-        dt = time.perf_counter() - t0
-        rep = mod.report() if mod is not None else None
-        parsec.fini(ctx)
-        return dt, rep
+    def run(n, instrument=False, cores=None, native=None):
+        if native is not None:
+            mca_param.set("runtime.native_dtd", native)
+        try:
+            ctx = parsec.init(nb_cores=cores or nb_cores)
+            mod = new_module("overhead").install(ctx) if instrument \
+                else None
+            ctx.start()
+            tp = dtd.Taskpool("taskrate")
+            ctx.add_taskpool(tp)
+            t0 = time.perf_counter()
+            tp.insert_tasks(_null_task_body, [() for _ in range(n)],
+                            device=DeviceType.CPU)
+            tp.wait()
+            dt = time.perf_counter() - t0
+            rep = mod.report() if mod is not None else None
+            nstats = ctx.native_dtd_stats()
+            engaged = tp._native is not None
+            parsec.fini(ctx)
+            return dt, rep, nstats, engaged
+        finally:
+            if native is not None:
+                mca_param.unset("runtime.native_dtd")
 
     try:
-        run(min(N, 2000))                  # warm the code paths
-        dt = sorted(run(N)[0] for _ in range(3))[1]
+        run(min(N, 2000), native=0)        # warm both code paths
+        if native_ok:
+            run(min(N, 2000), native=1)
+        pys, nats = [], []
+        nstats, engaged = {}, False
+        for _ in range(3):                 # interleaved A/B
+            pys.append(run(N, native=0)[0])
+            if native_ok:
+                dt, _, ns, eng = run(N, native=1)
+                nats.append(dt)
+                nstats, engaged = ns, engaged or eng
+        py_dt = sorted(pys)[1]
+        nat_dt = sorted(nats)[1] if nats else py_dt
         # breakdown on ONE worker: per-task stage timers under N
         # GIL-contending workers mostly measure each other's GIL waits
         # (observed 4x swings run-to-run at 4 cores); single-threaded
         # the budget is deterministic and the shares are meaningful
-        _, rep = run(N, instrument=True, cores=1)
+        _, rep, _, _ = run(N, instrument=True, cores=1)
+        headline = nat_dt if engaged else py_dt
         return {"taskrate": {
             "n_tasks": N, "nb_cores": nb_cores,
-            "tasks_per_sec": round(N / dt, 1),
-            "run_s": round(dt, 4),
-            "overhead_us_per_task": round(dt / N * 1e6, 3),
+            "tasks_per_sec": round(N / headline, 1),
+            "tasks_per_sec_native": round(N / nat_dt, 1) if engaged
+            else None,
+            "tasks_per_sec_python": round(N / py_dt, 1),
+            "native_vs_python": round(py_dt / nat_dt, 2) if engaged
+            else None,
+            "native_engine_engaged": engaged,
+            "native_unavailable": (None if native_ok else
+                                   _native.build_error()),
+            "run_s": round(headline, 4),
+            "overhead_us_per_task": round(headline / N * 1e6, 3),
             "stage_us_per_task": rep["per_task_us"],
-            "note": "null CPU bodies; stage rows are µs per task from a "
-                    "single-worker instrumented run "
-                    "(runtime.stage_timers) — the deterministic "
-                    "per-task overhead budget (multi-worker stage "
-                    "timers mostly measure GIL waits)"}}
+            "native_stage_counts": {
+                k: v for k, v in nstats.items()
+                if k in ("inserted", "linked_deps", "ready_pushed",
+                         "popped", "stolen", "overflow_pushed",
+                         "completed_native", "completed_python",
+                         "released_edges", "ring_highwater",
+                         "pump_calls")},
+            "note": "interleaved A/B medians-of-3 across "
+                    "runtime.native_dtd; headline = the shipped default "
+                    "(native when built). stage rows are µs per task "
+                    "from a single-worker instrumented PYTHON run "
+                    "(runtime.stage_timers forces the instrumented "
+                    "fallback); native_stage_counts reads the C++ "
+                    "engine's atomics"}}
     finally:
         mca_param.unset("device.tpu.enabled")
 
@@ -934,6 +986,11 @@ def _section_observability():
     from parsec_tpu.profiling.trace import Trace
 
     mca_param.set("device.tpu.enabled", False)
+    # pin the PYTHON engine on BOTH sides: the ON arm's installed Trace
+    # forces the instrumented path anyway (ISSUE 10 fallback rule), so
+    # letting the OFF arm run native would measure the engine
+    # difference, not the observability plane's cost
+    mca_param.set("runtime.native_dtd", 0)
     N = int(os.environ.get("PARSEC_BENCH_OBS_N", 20000))
     mca_param.set("dtd.window_size", 2 * N)     # the chain is the
     mca_param.set("dtd.threshold_size", N)      # backlog, not a leak
@@ -1014,6 +1071,7 @@ def _section_observability():
                     "--section serving."}}
     finally:
         mca_param.unset("device.tpu.enabled")
+        mca_param.unset("runtime.native_dtd")
         mca_param.unset("dtd.window_size")
         mca_param.unset("dtd.threshold_size")
 
@@ -1292,6 +1350,11 @@ _GFLOPS_GUARD_KEYS = ("value", "gemm_panel_fused_gflops",
                       # tasks/sec is higher-is-better like the GFLOPS
                       # rows, so the same >10%-drop guard applies
                       "tasks_per_sec",
+                      # ISSUE 10: BOTH engines guarded — the native
+                      # hot loop and the instrumented Python fallback
+                      # each must hold their rate round-over-round
+                      "tasks_per_sec_native",
+                      "tasks_per_sec_python",
                       # serving sustained requests/s rides the same
                       # drop guard
                       "serving_requests_per_sec",
@@ -1490,6 +1553,12 @@ def _compact_summary(result):
                                             "panel_fused_gflops"),
             "host_dtd_gflops": pick("host_dtd", "host_runtime_gflops"),
             "tasks_per_sec": pick("taskrate", "tasks_per_sec"),
+            "tasks_per_sec_native": pick("taskrate",
+                                         "tasks_per_sec_native"),
+            "tasks_per_sec_python": pick("taskrate",
+                                         "tasks_per_sec_python"),
+            "taskrate_native_ratio": pick("taskrate",
+                                          "native_vs_python"),
             "taskrate_stage_us": pick("taskrate", "stage_us_per_task"),
             "geqrf_fused_gflops": pick("geqrf_fused", "gflops"),
             "getrf_fused_gflops": pick("getrf_fused", "gflops"),
@@ -1520,6 +1589,7 @@ def _compact_summary(result):
             "recovery_bitwise_check": pick("recovery", "bitwise_check"),
             "serving_requests_per_sec": pick("serving",
                                              "requests_per_sec"),
+            "serving_native_ratio": pick("serving", "native_vs_python"),
             "serving_p99_ms": pick("serving", "p99_ms"),
             "serving_p99_ratio": pick("serving", "p99_ratio_worst"),
             "serving_shed": pick("serving", "shed_count"),
@@ -2005,6 +2075,10 @@ def render_parity():
             f"{k} {st[k]}" for k in ("insert", "select", "dispatch",
                                      "release") if k in st)
             if st else "")
+        if tk.get("native_vs_python"):
+            note = (f"native {tk.get('native_vs_python')}× the Python "
+                    f"engine ({tk.get('tasks_per_sec_python')}/s); "
+                    + note)
         rows.append((
             f"null-task rate (N={tk.get('n_tasks')}, "
             f"{tk.get('nb_cores')} cores, host-only)",

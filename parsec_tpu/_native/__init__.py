@@ -1,18 +1,23 @@
 """Native C++ core loader.
 
 Builds ``core.cpp`` into a shared library with g++ on first use (cached
-next to the source, keyed by source mtime) and exposes it through ctypes.
-The Python runtime falls back to its pure-Python implementations when the
-toolchain is unavailable (``load() -> None``), so the package works
-everywhere; on a real deployment the native engine carries the
-dependency-tracking and static-DAG execution hot paths, mirroring the
-reference where those layers are native C (parsec/parsec.c,
-parsec/scheduling.c, parsec/class/*).
+next to the source, keyed by a hash of the source — an edited core.cpp
+rebuilds instead of silently loading the stale binary) and exposes it
+through ctypes. The Python runtime falls back to its pure-Python
+implementations when the toolchain is unavailable (``load() -> None``),
+so the package works everywhere; ``build_error()`` reports WHY the
+library is missing so callers that require it (``runtime.native_dtd=1``)
+can fail loudly instead of silently degrading. On a real deployment the
+native engine carries the dependency-tracking, dynamic-task (DTD), and
+static-DAG execution hot paths, mirroring the reference where those
+layers are native C (parsec/parsec.c, parsec/scheduling.c,
+parsec/interfaces/dtd/insert_function.c, parsec/class/*).
 """
 
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -21,26 +26,65 @@ from typing import Optional
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "core.cpp")
 _SO = os.path.join(_HERE, "libparsec_core.so")
+_STAMP = _SO + ".srchash"
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+_build_error: Optional[str] = None
 
 BODY_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_uint32, ctypes.c_int32)
 
+#: pdtd_stats slot names, in the C ABI's out[16] order
+PDTD_STAT_KEYS = (
+    "inserted", "linked_deps", "ready_pushed", "popped", "stolen",
+    "overflow_pushed", "completed_native", "completed_python",
+    "released_edges", "output_drops", "dropped_cancelled",
+    "ring_highwater", "inflight", "ready", "pump_calls", "reserved")
+
+
+def _src_hash() -> str:
+    with open(_SRC, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
 
 def _build() -> bool:
-    if os.path.exists(_SO) and \
-            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return True
+    global _build_error
+    try:
+        want = _src_hash()
+    except OSError as exc:
+        _build_error = f"cannot read {_SRC}: {exc}"
+        return False
+    if os.path.exists(_SO):
+        try:
+            with open(_STAMP) as f:
+                have = f.read().strip()
+        except OSError:
+            have = ""               # pre-hash .so (or stamp lost): rebuild
+        if have == want:
+            return True
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
            "-o", _SO + ".tmp", _SRC]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        proc = subprocess.run(cmd, check=True, capture_output=True,
+                              timeout=120)
+        del proc
         os.replace(_SO + ".tmp", _SO)
+        with open(_STAMP, "w") as f:
+            f.write(want)
         return True
-    except (OSError, subprocess.SubprocessError):
-        return False
+    except FileNotFoundError:
+        _build_error = "g++ not found on PATH"
+    except subprocess.CalledProcessError as exc:
+        tail = (exc.stderr or b"").decode(errors="replace")[-500:]
+        _build_error = f"g++ failed (rc={exc.returncode}): {tail}"
+    except (OSError, subprocess.SubprocessError) as exc:
+        _build_error = f"build failed: {exc}"
+    # rebuild impossible but a (prebuilt / stampless) .so exists: try
+    # it — a deployment shipping the binary without the toolchain must
+    # not lose the native engine; a STALE binary missing newly-added
+    # symbols fails the bind cleanly (load()'s AttributeError guard)
+    return os.path.exists(_SO)
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -68,6 +112,38 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pgraph_run.restype = ctypes.c_int
     lib.pgraph_remaining.argtypes = [p]
     lib.pgraph_remaining.restype = u32
+    lib.pgraph_consume.argtypes = [p, u32]
+    lib.pgraph_consume.restype = ctypes.c_int
+    # pdtd: dynamic-task engine (DTD insert→release hot loop)
+    lib.pdtd_new.argtypes = [ctypes.c_int, u32]
+    lib.pdtd_new.restype = p
+    lib.pdtd_free.argtypes = [p]
+    lib.pdtd_insert.argtypes = [p, u32, ctypes.POINTER(i32),
+                                ctypes.POINTER(ctypes.c_uint8),
+                                ctypes.POINTER(u32), ctypes.POINTER(u32),
+                                ctypes.POINTER(ctypes.c_uint8)]
+    lib.pdtd_insert.restype = ctypes.c_int64
+    lib.pdtd_arm.argtypes = [p, u32, u32]
+    lib.pdtd_pump.argtypes = [p, ctypes.c_int, ctypes.POINTER(u32)]
+    lib.pdtd_pump.restype = ctypes.c_int
+    lib.pdtd_pump_batch.argtypes = [p, ctypes.c_int, ctypes.POINTER(u32),
+                                    ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+    lib.pdtd_pump_batch.restype = ctypes.c_int
+    lib.pdtd_complete.argtypes = [p, ctypes.c_int, u32,
+                                  ctypes.POINTER(u32), i32,
+                                  ctypes.POINTER(i32)]
+    lib.pdtd_complete.restype = ctypes.c_int
+    lib.pdtd_complete_batch.argtypes = [p, ctypes.c_int,
+                                        ctypes.POINTER(u32), ctypes.c_int]
+    lib.pdtd_complete_batch.restype = ctypes.c_int
+    lib.pdtd_inflight.argtypes = [p]
+    lib.pdtd_inflight.restype = u32
+    lib.pdtd_ready.argtypes = [p]
+    lib.pdtd_ready.restype = u32
+    lib.pdtd_wait_below.argtypes = [p, u32, ctypes.c_int]
+    lib.pdtd_wait_below.restype = u32
+    lib.pdtd_cancel.argtypes = [p]
+    lib.pdtd_stats.argtypes = [p, ctypes.POINTER(u64)]
     # foundation classes (reference parsec/class/*)
     lib.plifo_new.argtypes = [u32]
     lib.plifo_new.restype = p
@@ -104,24 +180,40 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
 
 def load() -> Optional[ctypes.CDLL]:
     """The native library, or None when it cannot be built/loaded."""
-    global _lib, _tried
+    global _lib, _tried, _build_error
     with _lock:
         if _lib is not None or _tried:
             return _lib
         _tried = True
         if os.environ.get("PARSEC_NO_NATIVE"):
+            _build_error = "disabled by PARSEC_NO_NATIVE"
             return None
         if not _build():
             return None
         try:
             _lib = _bind(ctypes.CDLL(_SO))
-        except OSError:
+        except OSError as exc:
+            _build_error = f"dlopen({_SO}) failed: {exc}"
+            _lib = None
+        except AttributeError as exc:
+            # a stale .so missing newly-added symbols: the source-hash
+            # stamp normally prevents this; surface it instead of a
+            # confusing partial bind
+            _build_error = f"stale {_SO}: {exc}"
             _lib = None
         return _lib
 
 
 def available() -> bool:
     return load() is not None
+
+
+def build_error() -> Optional[str]:
+    """Why the native library is unavailable (None when it loaded, or
+    when load() was never attempted)."""
+    load()
+    return None if _lib is not None else \
+        (_build_error or "native library unavailable")
 
 
 def kahn_levels(n: int, edges) -> "Optional[list]":

@@ -14,6 +14,18 @@
 //             threads; task bodies are invoked through a Python callback
 //             (ctypes acquires the GIL per call; numpy/XLA bodies release
 //             it during heavy work, so C++ threads overlap host compute)
+//   pdtd_*    DYNAMIC-task engine (the DTD insert→release hot loop,
+//             reference insert_function.c + scheduling.c): a growable
+//             segmented task table fed by batched inserts (the Python
+//             side stages rows in a reusable ring of arrays), the
+//             counter/finalize dependency protocol of the pdep table
+//             run on the dense task entries, per-worker plifo ready
+//             stacks with work stealing and a shared overflow dequeue,
+//             and native release (successor countdown + ready push +
+//             refcounted output drop). Python workers pump it through
+//             pdtd_pump — native-bodied (no-op) tasks complete without
+//             ever re-entering Python; Python-bodied tasks surface one
+//             at a time and complete through pdtd_complete.
 //
 // Everything here is original TPU-build code; reference citations are for
 // behavioral parity only.
@@ -197,6 +209,11 @@ struct PGraphWorker {
 struct PGraph {
   uint32_t n = 0;
   std::vector<std::atomic<int32_t>> deps;  // remaining input deps
+  // remaining consumers of each task's OUTPUT (= outdegree): the Python
+  // executor drops its reference to a producer's outputs when this hits
+  // zero (pgraph_consume) — atomic countdown instead of a Python-side
+  // refcount dict under a global lock
+  std::vector<std::atomic<int32_t>> consumers;
   std::vector<int32_t> priority;
   std::vector<uint32_t> head;  // CSR successor adjacency
   std::vector<uint32_t> adj;
@@ -291,6 +308,10 @@ void* pgraph_new(uint32_t n, const int32_t* ndeps, const int32_t* priority,
   g->adj.resize(m);
   std::vector<uint32_t> cursor(g->head.begin(), g->head.end() - 1);
   for (uint64_t i = 0; i < m; ++i) g->adj[cursor[esrc[i]]++] = edst[i];
+  g->consumers = std::vector<std::atomic<int32_t>>(n);
+  for (uint32_t i = 0; i < n; ++i)
+    g->consumers[i].store((int32_t)(g->head[i + 1] - g->head[i]),
+                          std::memory_order_relaxed);
   g->workers = std::vector<PGraphWorker>(g->nworkers);
   g->remaining.store(n, std::memory_order_relaxed);
   return g;
@@ -328,6 +349,16 @@ int pgraph_run(void* gp) {
 
 uint32_t pgraph_remaining(void* gp) {
   return static_cast<PGraph*>(gp)->remaining.load();
+}
+
+// Count one consumed output of task ``tid`` (a body that read it just
+// ran). Returns 1 when this was the LAST consumer — the caller may drop
+// the retained outputs now — 0 otherwise, -1 on a bad id.
+int pgraph_consume(void* gp, uint32_t tid) {
+  PGraph* g = static_cast<PGraph*>(gp);
+  if (tid >= g->n) return -1;
+  return g->consumers[tid].fetch_sub(1, std::memory_order_acq_rel) == 1
+             ? 1 : 0;
 }
 
 // ---------------------------------------------------------------------------
@@ -624,6 +655,509 @@ uint64_t pmempool_outstanding(void* pp) {
 uint64_t pmempool_allocated(void* pp) {
   return static_cast<Pmempool*>(pp)->allocated.load(
       std::memory_order_relaxed);
+}
+
+// ---------------------------------------------------------------------------
+// pdtd: dynamic-task engine — the DTD insert→release hot loop off the GIL.
+//
+// Tasks are identified by their insertion sequence number (dense u32, the
+// same cross-rank identity the Python DTD layer uses), which makes the
+// pdep open-hash redundant: the SAME counter/finalize dependency protocol
+// (accumulate arrivals against an unpublished goal, publish + finalize
+// under the per-task lock — parsec.c:1554 / the DTD _GOAL_UNSET parking
+// of remote_dep_mpi.c:1935) runs directly on the dense task entry, with
+// the entry mutex playing the seq-stripe lock's role.
+//
+// Two-phase insert (pdtd_insert then pdtd_arm): phase A registers a batch
+// and links it to in-flight predecessors (linked_out tells Python, per
+// dependency slot, whether the edge was made — an unlinked slot means the
+// producer already completed and committed, so Python snapshots the
+// current tile version in program order, exactly the Python engine's
+// rule). Tasks whose goal is already met DEFER instead of becoming
+// runnable, so Python can finish attaching per-task state (input
+// resolvers, retained-output records) before pdtd_arm makes the batch
+// visible to the workers. Dependencies from OLDER batches completing in
+// the window between the two phases also land in the deferred state.
+//
+// Ready queues: one plifo per worker + a locked overflow dequeue (the
+// lfq local-buffer/system-dequeue shape); select pops local LIFO, then
+// steals peers, then drains the overflow. Native-bodied tasks (flags
+// bit0 clear) complete entirely inside pdtd_pump; Python-bodied tasks
+// are returned one at a time and complete through pdtd_complete, which
+// performs the successor countdown, ready pushes, and the refcounted
+// output drop (nconsumers per producer; the drop list tells Python which
+// retained outputs just died).
+// ---------------------------------------------------------------------------
+
+struct PdtdTask {
+  std::mutex mu;
+  std::vector<uint32_t> succs;    // tasks whose inputs I produce
+  std::vector<uint32_t> lpreds;   // linked preds (refcounted outputs I read)
+  int64_t goal = -1;              // -1 = unpublished (insert still linking)
+  int64_t arrived = 0;            // satisfied deps (may precede goal)
+  std::atomic<int32_t> nconsumers{0};  // linked readers of my outputs
+  int32_t priority = 0;
+  uint8_t flags = 0;              // bit0: needs a Python body
+  bool done = false;
+  bool armed = false;
+  bool ready_deferred = false;    // goal met before arming
+};
+
+struct Pdtd {
+  static constexpr uint32_t kSegBits = 12;
+  static constexpr uint32_t kSegSize = 1u << kSegBits;   // tasks per segment
+  // 16384 directory slots (128 KB in the engine struct) x 4096 tasks =
+  // 67M tasks per pool; engines are per-taskpool, so the directory is
+  // deliberately small — serving churns one engine per submission
+  static constexpr uint32_t kMaxSegs = 1u << 14;
+  std::atomic<PdtdTask*> segs[kMaxSegs];
+  std::atomic<uint32_t> ntasks{0};
+  std::mutex grow_mu;
+
+  int nworkers = 1;
+  std::vector<Plifo*> queues;         // per-worker ready stacks
+  std::mutex overflow_mu;             // plifo-full spill (system dequeue)
+  std::deque<uint32_t> overflow;
+  std::atomic<uint32_t> rr{0};        // arm-time round-robin cursor
+
+  std::atomic<uint32_t> inflight{0};
+  std::atomic<bool> cancelled{false};
+  std::mutex cv_mu;
+  std::condition_variable cv;
+  std::atomic<int> waiters{0};
+
+  // stats (pdtd_stats order — mirrored by the Python loader)
+  std::atomic<uint64_t> s_inserted{0}, s_linked{0}, s_ready_pushed{0},
+      s_popped{0}, s_stolen{0}, s_overflow{0}, s_completed_native{0},
+      s_completed_python{0}, s_released{0}, s_drops{0}, s_dropped_cancel{0},
+      s_ring_hw{0}, s_pump_calls{0};
+
+  ~Pdtd() {
+    for (uint32_t s = 0; s < kMaxSegs; ++s) {
+      PdtdTask* seg = segs[s].load(std::memory_order_relaxed);
+      if (seg == nullptr) break;     // ensure() fills segments densely
+      delete[] seg;
+    }
+    for (Plifo* q : queues) plifo_free(q);
+  }
+
+  PdtdTask* task(uint32_t tid) {
+    return &segs[tid >> kSegBits].load(std::memory_order_acquire)
+               [tid & (kSegSize - 1)];
+  }
+
+  bool ensure(uint32_t upto) {  // segments covering task ids [0, upto)
+    std::lock_guard<std::mutex> lk(grow_mu);
+    uint32_t need = (upto + kSegSize - 1) >> kSegBits;
+    if (need > kMaxSegs) return false;
+    for (uint32_t s = 0; s < need; ++s) {
+      if (segs[s].load(std::memory_order_relaxed) == nullptr) {
+        PdtdTask* seg = new (std::nothrow) PdtdTask[kSegSize];
+        if (!seg) return false;
+        segs[s].store(seg, std::memory_order_release);
+      }
+    }
+    return true;
+  }
+
+  void push_ready(int w, uint32_t tid) {
+    s_ready_pushed.fetch_add(1, std::memory_order_relaxed);
+    if (plifo_push(queues[w], tid) != 0) {
+      std::lock_guard<std::mutex> lk(overflow_mu);
+      overflow.push_back(tid);
+      s_overflow.fetch_add(1, std::memory_order_relaxed);
+    }
+  }
+
+  bool pop_ready(int w, uint32_t* out) {
+    uint64_t item;
+    if (plifo_pop(queues[w], &item)) {
+      *out = (uint32_t)item;
+      s_popped.fetch_add(1, std::memory_order_relaxed);
+      return true;
+    }
+    for (int i = 1; i < nworkers; ++i) {
+      if (plifo_pop(queues[(w + i) % nworkers], &item)) {
+        *out = (uint32_t)item;
+        s_stolen.fetch_add(1, std::memory_order_relaxed);
+        return true;
+      }
+    }
+    {
+      std::lock_guard<std::mutex> lk(overflow_mu);
+      if (!overflow.empty()) {
+        *out = overflow.front();
+        overflow.pop_front();
+        s_stolen.fetch_add(1, std::memory_order_relaxed);
+        return true;
+      }
+    }
+    return false;
+  }
+
+  void retire_one() {
+    if (inflight.fetch_sub(1, std::memory_order_acq_rel) == 1 ||
+        waiters.load(std::memory_order_acquire) > 0) {
+      std::lock_guard<std::mutex> lk(cv_mu);
+      cv.notify_all();
+    }
+  }
+
+  // successor countdown of a completing (or insert-time-ready) task;
+  // returns how many successors became ready (pushed to worker w)
+  int release_succs(int w, const std::vector<uint32_t>& succs) {
+    int newly = 0;
+    for (uint32_t sid : succs) {
+      PdtdTask* s = task(sid);
+      bool ready = false, armed = false;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->arrived += 1;
+        if (s->goal >= 0 && s->arrived == s->goal && !s->done) {
+          armed = s->armed;
+          if (armed) ready = true;
+          else s->ready_deferred = true;
+        }
+      }
+      s_released.fetch_add(1, std::memory_order_relaxed);
+      if (ready) {
+        push_ready(w, sid);
+        newly++;
+      }
+    }
+    return newly;
+  }
+
+  // refcounted output drop: count one consumption of each linked pred;
+  // preds whose last consumer this was land in drops_out (if provided)
+  int drop_preds(const std::vector<uint32_t>& lpreds, uint32_t* drops_out,
+                 int32_t cap) {
+    int nd = 0;
+    for (uint32_t pid : lpreds) {
+      PdtdTask* p = task(pid);
+      if (p->nconsumers.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+        if (drops_out != nullptr && nd < cap) drops_out[nd] = pid;
+        nd++;
+        s_drops.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+    return nd;
+  }
+
+  // complete a native-bodied task inline (no Python re-entry)
+  void complete_native(int w, uint32_t tid) {
+    PdtdTask* t = task(tid);
+    std::vector<uint32_t> succs;
+    {
+      std::lock_guard<std::mutex> lk(t->mu);
+      t->done = true;
+      succs.swap(t->succs);
+    }
+    release_succs(w, succs);
+    drop_preds(t->lpreds, nullptr, 0);
+    s_completed_native.fetch_add(1, std::memory_order_relaxed);
+    retire_one();
+  }
+
+  // cancelled-engine drop at select time: no body runs, but successors
+  // MUST still count down — a dependent of a dropped task would
+  // otherwise never become ready, never be dropped itself, and hold
+  // inflight > 0 forever (the retiring engine would never fold). The
+  // released dependents are pushed, popped, and dropped in turn, so a
+  // whole cancelled chain drains.
+  void drop_cancelled(int w, uint32_t tid) {
+    PdtdTask* t = task(tid);
+    std::vector<uint32_t> succs;
+    {
+      std::lock_guard<std::mutex> lk(t->mu);
+      t->done = true;
+      succs.swap(t->succs);
+    }
+    release_succs(w, succs);
+    drop_preds(t->lpreds, nullptr, 0);
+    s_dropped_cancel.fetch_add(1, std::memory_order_relaxed);
+    retire_one();
+  }
+};
+
+void* pdtd_new(int nworkers, uint32_t queue_capacity) {
+  Pdtd* e = new (std::nothrow) Pdtd();
+  if (!e) return nullptr;
+  e->nworkers = nworkers < 1 ? 1 : nworkers;
+  if (queue_capacity == 0) queue_capacity = 1u << 13;
+  for (uint32_t s = 0; s < Pdtd::kMaxSegs; ++s)
+    e->segs[s].store(nullptr, std::memory_order_relaxed);
+  for (int i = 0; i < e->nworkers; ++i) {
+    Plifo* q = static_cast<Plifo*>(plifo_new(queue_capacity));
+    if (!q) {
+      delete e;
+      return nullptr;
+    }
+    e->queues.push_back(q);
+  }
+  return e;
+}
+
+void pdtd_free(void* ep) { delete static_cast<Pdtd*>(ep); }
+
+// Phase A: register a batch of n tasks (dense ids continuing the table)
+// and link them to in-flight predecessors. preds is the flat
+// concatenation of each task's predecessor ids (npreds[i] per task);
+// linked_out (same layout) receives 1 where the edge was made, 0 where
+// the predecessor had already completed (Python then snapshots the
+// committed tile version in program order). The batch stays invisible to
+// the workers until pdtd_arm. Returns the first task id, or -1.
+int64_t pdtd_insert(void* ep, uint32_t n, const int32_t* prio,
+                    const uint8_t* flags, const uint32_t* npreds,
+                    const uint32_t* preds, uint8_t* linked_out) {
+  Pdtd* e = static_cast<Pdtd*>(ep);
+  uint32_t first = e->ntasks.load(std::memory_order_relaxed);
+  if (!e->ensure(first + n)) return -1;
+  uint64_t pi = 0;
+  for (uint32_t i = 0; i < n; ++i) {
+    uint32_t tid = first + i;
+    PdtdTask* t = e->task(tid);
+    t->priority = prio ? prio[i] : 0;
+    t->flags = flags ? flags[i] : 1;
+    int64_t goal = 0;
+    uint32_t np = npreds ? npreds[i] : 0;
+    for (uint32_t k = 0; k < np; ++k, ++pi) {
+      uint32_t pid = preds[pi];
+      if (pid >= tid) return -2;          // protocol error: forward edge
+      PdtdTask* p = e->task(pid);
+      bool linked = false;
+      {
+        std::lock_guard<std::mutex> lk(p->mu);
+        if (!p->done) {
+          p->succs.push_back(tid);
+          p->nconsumers.fetch_add(1, std::memory_order_relaxed);
+          linked = true;
+        }
+      }
+      if (linked_out) linked_out[pi] = linked ? 1 : 0;
+      if (linked) {
+        goal += 1;
+        t->lpreds.push_back(pid);
+        e->s_linked.fetch_add(1, std::memory_order_relaxed);
+      }
+    }
+    // publish the goal and finalize against arrivals that raced ahead
+    // (an already-linked pred may have completed before this point)
+    {
+      std::lock_guard<std::mutex> lk(t->mu);
+      t->goal = goal;
+      if (t->arrived == goal) t->ready_deferred = true;
+    }
+    e->inflight.fetch_add(1, std::memory_order_relaxed);
+  }
+  e->ntasks.store(first + n, std::memory_order_release);
+  e->s_inserted.fetch_add(n, std::memory_order_relaxed);
+  uint64_t hw = e->s_ring_hw.load(std::memory_order_relaxed);
+  while (n > hw &&
+         !e->s_ring_hw.compare_exchange_weak(hw, n,
+                                             std::memory_order_relaxed)) {
+  }
+  return (int64_t)first;
+}
+
+// Phase B: make the batch runnable. Tasks whose goal was already met
+// (at insert, or by an older batch completing meanwhile) are pushed
+// round-robin across the worker queues.
+void pdtd_arm(void* ep, uint32_t first, uint32_t n) {
+  Pdtd* e = static_cast<Pdtd*>(ep);
+  for (uint32_t tid = first; tid < first + n; ++tid) {
+    PdtdTask* t = e->task(tid);
+    bool ready = false;
+    {
+      std::lock_guard<std::mutex> lk(t->mu);
+      t->armed = true;
+      if (t->ready_deferred) {
+        t->ready_deferred = false;
+        ready = true;
+      }
+    }
+    if (ready) {
+      uint32_t w = e->rr.fetch_add(1, std::memory_order_relaxed);
+      e->push_ready((int)(w % e->nworkers), tid);
+    }
+  }
+}
+
+// Worker pump: run native-bodied ready tasks to completion until either
+// a Python-bodied task surfaces (returns 1, *out_tid set — the caller
+// runs its body and calls pdtd_complete) or the queues are dry (returns
+// 2 if any native work was done this call, 0 if none). Cancelled
+// engines drop queued tasks here, at select time.
+int pdtd_pump(void* ep, int worker, uint32_t* out_tid) {
+  Pdtd* e = static_cast<Pdtd*>(ep);
+  if (worker < 0 || worker >= e->nworkers) worker = 0;
+  e->s_pump_calls.fetch_add(1, std::memory_order_relaxed);
+  bool ran = false;
+  uint32_t tid;
+  while (e->pop_ready(worker, &tid)) {
+    PdtdTask* t = e->task(tid);
+    if (e->cancelled.load(std::memory_order_acquire)) {
+      e->drop_cancelled(worker, tid);
+      ran = true;
+      continue;
+    }
+    if (t->flags & 1) {
+      *out_tid = tid;
+      return 1;
+    }
+    e->complete_native(worker, tid);
+    ran = true;
+  }
+  return ran ? 2 : 0;
+}
+
+// Batched pump: like pdtd_pump, but collects up to ``cap`` Python-bodied
+// tasks per call (native-bodied ones still complete inline) so the
+// Python worker pays ONE GIL round-trip per batch instead of per task —
+// the GIL-convoy fix for the Python-bodied serving shape. Returns the
+// number of tids written; *ran_native is set when native-bodied (or
+// cancelled-dropped) work was done regardless.
+int pdtd_pump_batch(void* ep, int worker, uint32_t* out_tids, int cap,
+                    int* ran_native) {
+  Pdtd* e = static_cast<Pdtd*>(ep);
+  if (worker < 0 || worker >= e->nworkers) worker = 0;
+  e->s_pump_calls.fetch_add(1, std::memory_order_relaxed);
+  bool ran = false;
+  int n = 0;
+  uint32_t tid;
+  while (n < cap && e->pop_ready(worker, &tid)) {
+    PdtdTask* t = e->task(tid);
+    if (e->cancelled.load(std::memory_order_acquire)) {
+      e->drop_cancelled(worker, tid);
+      ran = true;
+      continue;
+    }
+    if (t->flags & 1) {
+      out_tids[n++] = tid;
+      continue;
+    }
+    e->complete_native(worker, tid);
+    ran = true;
+  }
+  if (ran_native) *ran_native = ran ? 1 : 0;
+  return n;
+}
+
+// Complete a Python-bodied task: successor countdown + ready pushes +
+// refcounted output drop. drops_out (capacity drops_cap) receives the
+// predecessor ids whose retained outputs just lost their last consumer;
+// info_out[0] = successors made ready, info_out[1] = this task's final
+// consumer count (0 → Python need not retain its outputs). Returns the
+// drop count, or -1 on a bad id.
+int pdtd_complete(void* ep, int worker, uint32_t tid, uint32_t* drops_out,
+                  int32_t drops_cap, int32_t* info_out) {
+  Pdtd* e = static_cast<Pdtd*>(ep);
+  if (worker < 0 || worker >= e->nworkers) worker = 0;
+  if (tid >= e->ntasks.load(std::memory_order_acquire)) return -1;
+  PdtdTask* t = e->task(tid);
+  std::vector<uint32_t> succs;
+  {
+    std::lock_guard<std::mutex> lk(t->mu);
+    if (t->done) return -1;
+    t->done = true;
+    succs.swap(t->succs);
+  }
+  int newly = e->release_succs(worker, succs);
+  int nd = e->drop_preds(t->lpreds, drops_out, drops_cap);
+  if (info_out) {
+    info_out[0] = newly;
+    info_out[1] = t->nconsumers.load(std::memory_order_acquire);
+  }
+  e->s_completed_python.fetch_add(1, std::memory_order_relaxed);
+  e->retire_one();
+  return nd;
+}
+
+// Batched completion for Python-bodied tasks that retained no outputs
+// and consumed none (no drop/consumer reporting needed — the null-task
+// and serving shapes): one GIL round-trip completes the whole batch.
+// Returns the number of successors made ready.
+int pdtd_complete_batch(void* ep, int worker, const uint32_t* tids,
+                        int n) {
+  Pdtd* e = static_cast<Pdtd*>(ep);
+  if (worker < 0 || worker >= e->nworkers) worker = 0;
+  int newly = 0;
+  std::vector<uint32_t> succs;
+  for (int i = 0; i < n; ++i) {
+    uint32_t tid = tids[i];
+    if (tid >= e->ntasks.load(std::memory_order_acquire)) continue;
+    PdtdTask* t = e->task(tid);
+    succs.clear();
+    {
+      std::lock_guard<std::mutex> lk(t->mu);
+      if (t->done) continue;
+      t->done = true;
+      succs.swap(t->succs);
+    }
+    newly += e->release_succs(worker, succs);
+    e->drop_preds(t->lpreds, nullptr, 0);
+    e->s_completed_python.fetch_add(1, std::memory_order_relaxed);
+    e->retire_one();
+  }
+  return newly;
+}
+
+uint32_t pdtd_inflight(void* ep) {
+  return static_cast<Pdtd*>(ep)->inflight.load(std::memory_order_acquire);
+}
+
+uint32_t pdtd_ready(void* ep) {
+  Pdtd* e = static_cast<Pdtd*>(ep);
+  uint32_t n = 0;
+  for (Plifo* q : e->queues) n += plifo_size(q);
+  {
+    std::lock_guard<std::mutex> lk(e->overflow_mu);
+    n += (uint32_t)e->overflow.size();
+  }
+  return n;
+}
+
+// Sliding-window park (the DTD inserter throttle off the GIL): wait
+// until inflight <= threshold, the engine is cancelled, or timeout_ms
+// elapses. Returns the current inflight count.
+uint32_t pdtd_wait_below(void* ep, uint32_t threshold, int timeout_ms) {
+  Pdtd* e = static_cast<Pdtd*>(ep);
+  std::unique_lock<std::mutex> lk(e->cv_mu);
+  e->waiters.fetch_add(1, std::memory_order_acq_rel);
+  e->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+    return e->inflight.load(std::memory_order_acquire) <= threshold ||
+           e->cancelled.load(std::memory_order_acquire);
+  });
+  e->waiters.fetch_sub(1, std::memory_order_acq_rel);
+  return e->inflight.load(std::memory_order_acquire);
+}
+
+// Cancel: queued tasks are dropped at the next pop; parked waiters wake.
+void pdtd_cancel(void* ep) {
+  Pdtd* e = static_cast<Pdtd*>(ep);
+  e->cancelled.store(true, std::memory_order_release);
+  std::lock_guard<std::mutex> lk(e->cv_mu);
+  e->cv.notify_all();
+}
+
+void pdtd_stats(void* ep, uint64_t* out16) {
+  Pdtd* e = static_cast<Pdtd*>(ep);
+  out16[0] = e->s_inserted.load(std::memory_order_relaxed);
+  out16[1] = e->s_linked.load(std::memory_order_relaxed);
+  out16[2] = e->s_ready_pushed.load(std::memory_order_relaxed);
+  out16[3] = e->s_popped.load(std::memory_order_relaxed);
+  out16[4] = e->s_stolen.load(std::memory_order_relaxed);
+  out16[5] = e->s_overflow.load(std::memory_order_relaxed);
+  out16[6] = e->s_completed_native.load(std::memory_order_relaxed);
+  out16[7] = e->s_completed_python.load(std::memory_order_relaxed);
+  out16[8] = e->s_released.load(std::memory_order_relaxed);
+  out16[9] = e->s_drops.load(std::memory_order_relaxed);
+  out16[10] = e->s_dropped_cancel.load(std::memory_order_relaxed);
+  out16[11] = e->s_ring_hw.load(std::memory_order_relaxed);
+  out16[12] = e->inflight.load(std::memory_order_acquire);
+  out16[13] = pdtd_ready(ep);
+  out16[14] = e->s_pump_calls.load(std::memory_order_relaxed);
+  out16[15] = 0;
 }
 
 }  // extern "C"

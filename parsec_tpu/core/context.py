@@ -172,6 +172,12 @@ class Context:
 
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        # native DTD engines (dsl/dtd_native.py): live engines are
+        # pumped by the worker loop; terminated pools fold their
+        # counters into _ndtd_totals so completed-task totals survive
+        self._ndtd_live: List = []
+        self._ndtd_lock = threading.Lock()
+        self._ndtd_totals: Dict[str, int] = {}
         self._active_taskpools: List[Taskpool] = []
         # name → taskpool, kept past termination: late control traffic
         # (DTD flush writebacks/acks) must still find its taskpool
@@ -388,6 +394,77 @@ class Context:
                     f"taskpool {tp.name} aborted: {tp.error}") from tp.error
         return True
 
+    # -------------------------------------------------- native DTD engines
+    def _ndtd_register(self, eng) -> None:
+        with self._ndtd_lock:
+            if eng not in self._ndtd_live:
+                self._ndtd_live.append(eng)
+
+    def _ndtd_retire(self, eng) -> None:
+        """A pool terminated: fold its engine now if drained, else mark
+        it retiring — the workers keep pumping it (cancelled pools drop
+        their queued tasks at select time there) and the pump folds it
+        once the last in-flight task leaves."""
+        if eng.inflight() == 0:
+            self._ndtd_unregister(eng)
+        else:
+            eng.retiring = True
+
+    def _ndtd_unregister(self, eng) -> None:
+        """Fold a retired engine's monotonic counters into the context
+        totals (idempotent — refired termination is absorbed)."""
+        with self._ndtd_lock:
+            if eng not in self._ndtd_live:
+                return
+            self._ndtd_live.remove(eng)
+            for k, v in eng.stats().items():
+                if k in ("inflight", "ready"):
+                    continue                    # gauges, not counters
+                if k == "ring_highwater":
+                    self._ndtd_totals[k] = max(
+                        self._ndtd_totals.get(k, 0), v)
+                else:
+                    self._ndtd_totals[k] = \
+                        self._ndtd_totals.get(k, 0) + v
+        eng.release_refs()
+
+    def native_dtd_stats(self) -> Dict[str, int]:
+        """Aggregate native-DTD engine counters: retired pools' folded
+        totals plus every live engine (scrape-time; the hot loop only
+        touches C++ atomics)."""
+        with self._ndtd_lock:
+            out = dict(self._ndtd_totals)
+            live = list(self._ndtd_live)
+        for eng in live:
+            for k, v in eng.stats().items():
+                if k == "ring_highwater":
+                    out[k] = max(out.get(k, 0), v)
+                else:
+                    out[k] = out.get(k, 0) + v
+        return out
+
+    def _ndtd_pump(self, es: "ExecutionStream") -> bool:
+        """Progress the live native DTD engines on this worker; True
+        when any task completed (native-bodied ones inside the C call
+        with the GIL released, Python-bodied ones here). Exception-
+        guarded like _task_progress: a raising user hook (on_retire /
+        on_complete) aborts ITS pool instead of killing the worker."""
+        with self._ndtd_lock:
+            engines = list(self._ndtd_live)
+        ran = False
+        for eng in engines:
+            try:
+                if eng.pump(es):
+                    ran = True
+            except Exception as exc:  # noqa: BLE001 — worker must survive
+                warning("scheduling", "native DTD pump of %s raised: %s",
+                        eng.tp.name, exc)
+                import traceback
+                traceback.print_exc()
+                eng.tp.abort(exc)
+                ran = True
+        return ran
+
     # ------------------------------------------------------ observability
     def statusz(self) -> Dict:
         """Live runtime status as one JSON-able dict: the metrics
@@ -408,6 +485,9 @@ class Context:
             out["serving"] = self.serving.report()
         if self.trace is not None:
             out["trace_dropped"] = self.trace.dropped()
+        nstats = self.native_dtd_stats()
+        if nstats:
+            out["native_dtd"] = nstats
         return out
 
     def metrics_text(self) -> str:
@@ -566,11 +646,18 @@ class Context:
         while True:
             if self._shutdown:
                 return
-            if not self._started or not self._active_taskpools:
+            # retiring native engines (aborted pool already removed
+            # from _active_taskpools, tasks still draining) count as
+            # work: without them in this condition the cancelled tasks
+            # would never be dropped and the engine never folded
+            if not self._started or not (self._active_taskpools or
+                                         self._ndtd_live):
                 self._work_evt.clear()
                 # re-check after clear to avoid a lost wakeup from
                 # add_taskpool()/start() racing with the clear
-                if self._shutdown or (self._started and self._active_taskpools):
+                if self._shutdown or (self._started and
+                                      (self._active_taskpools or
+                                       self._ndtd_live)):
                     continue
                 self._work_evt.wait(timeout=0.1)
                 continue
@@ -584,6 +671,15 @@ class Context:
                     es.stats["select_calls"] += 1
                 else:
                     task = self.scheduler.select(es)
+            if task is None and self._ndtd_live:
+                # native DTD pump (the insert→release loop behind the C
+                # ABI): native-bodied tasks drain entirely inside the
+                # ctypes call with the GIL released; Python-bodied ones
+                # run here. Tried when the Python queues are dry so
+                # queued Python pools are never starved by a native loop.
+                if self._ndtd_pump(es):
+                    backoff = backoff_min
+                    continue
             if task is None:
                 es.stats["starved"] += 1
                 # event-driven wakeup: schedule() sets _work_evt, so a
@@ -594,6 +690,12 @@ class Context:
                 # bounds termdet/shutdown polling.
                 self._work_evt.clear()
                 task = self.scheduler.select(es)
+                if task is None and self._ndtd_live and \
+                        self._ndtd_pump(es):
+                    # a native batch armed between the pump above and
+                    # the clear: same lost-wakeup guard as the reselect
+                    backoff = backoff_min
+                    continue
                 if task is None:
                     self._work_evt.wait(timeout=backoff)
                     backoff = min(backoff * 2, backoff_max)

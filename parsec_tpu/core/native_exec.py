@@ -11,7 +11,9 @@ Value passing: each edge carries the producer flow's output to the
 consumer flow (the release-deps data attachment, parsec.c:1694-1780);
 collection-sourced inputs resolve through the class's data_lookup.
 Producer outputs are refcounted per consumer and dropped as soon as the
-last consumer ran.
+last consumer ran — the countdown is an ATOMIC in the native core
+(``pgraph_consume``; the engine owns ``nconsumers``), so concurrent
+bodies never serialize on a Python refcount lock.
 
 Use when the DAG is statically enumerable (always true for PTG). The
 dynamic paths (DTD insertion, multi-rank) use the host runtime; the
@@ -22,7 +24,6 @@ XLA program.
 from __future__ import annotations
 
 import ctypes
-import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -88,8 +89,6 @@ class NativeDAGExecutor:
         edst = np.asarray(edst, dtype=np.uint32)
 
         self._outputs: List[Optional[dict]] = [None] * n
-        self._pending_consumers = self.nconsumers.copy()
-        self._refcount_lock = threading.Lock()
         self._error: Optional[BaseException] = None
 
         self._body_cb = _native.BODY_FN(self._run_body)   # keep alive
@@ -124,16 +123,13 @@ class NativeDAGExecutor:
             if chore is None:
                 raise RuntimeError(f"no body for {tc.name}")
             result = chore.hook(task, *task.input_values())
-            out_flows = tc.output_flows
-            if result is None:
-                outs = {}
-            elif isinstance(result, dict):
-                outs = result
-            elif isinstance(result, (tuple, list)):
-                outs = {f.name: v for f, v in zip(out_flows, result)}
-            else:
-                outs = {out_flows[0].name: result}
-            task.output.update(outs)
+            # THE shared body-result contract (core.task.normalize_
+            # outputs): the old inline zip silently truncated on arity
+            # mismatch where the host runtime raises — engine choice
+            # must not change what a return value means
+            from .task import normalize_outputs
+            task.output.update(normalize_outputs(
+                result, [f.name for f in tc.output_flows], task))
             # terminal collection write-backs; successor activation is
             # native (the engine counts down deps from the edge list).
             # Budget-tracked when an HBM manager is attached — the same
@@ -150,12 +146,13 @@ class NativeDAGExecutor:
             if self.nconsumers[tid]:
                 self._outputs[tid] = {f.name: task.output.get(
                     f.name, task.data.get(f.name)) for f in tc.flows}
-            # drop predecessor outputs once their last consumer ran
-            with self._refcount_lock:
-                for (i, _sf, _df, _spec) in self.in_edges[tid]:
-                    self._pending_consumers[i] -= 1
-                    if self._pending_consumers[i] == 0:
-                        self._outputs[i] = None
+            # drop predecessor outputs once their last consumer ran:
+            # the countdown is the engine's atomic (pgraph_consume) —
+            # whichever consumer decrements to zero sees 1 exactly once,
+            # so the Python side needs no lock around the drop
+            for (i, _sf, _df, _spec) in self.in_edges[tid]:
+                if self.lib.pgraph_consume(self._g, i) == 1:
+                    self._outputs[i] = None
             return 0
         except BaseException as exc:  # noqa: BLE001 — crossing the C ABI
             self._error = exc
@@ -170,7 +167,17 @@ class NativeDAGExecutor:
             raise RuntimeError(f"native DAG execution failed (rc={rc})")
 
     def __del__(self):
+        # interpreter-shutdown tolerant: at teardown the ctypes library
+        # (or its function pointers) may already be torn down — leaking
+        # to the OS then is correct, raising from __del__ is not
         g = getattr(self, "_g", None)
-        if g:
-            self.lib.pgraph_free(g)
+        lib = getattr(self, "lib", None)
+        if g and lib is not None:
+            try:
+                lib.pgraph_free(g)
+            except (AttributeError, TypeError, OSError):
+                pass
+        try:
             self._g = None
+        except Exception:  # noqa: BLE001 — __del__ must never raise
+            pass

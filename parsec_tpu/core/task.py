@@ -153,3 +153,29 @@ class Task:
     def __repr__(self) -> str:
         args = ", ".join(map(str, self.locals))
         return f"{self.task_class.name}({args})"
+
+
+def normalize_outputs(result: Any, out_flow_names: Sequence[str],
+                      label: Any) -> Dict[str, Any]:
+    """Functional-body result → output-flow dict: None = no outputs,
+    dict = as-is, tuple/list zipped against the output flows (arity
+    checked), a bare value requires exactly one output flow. THE single
+    copy of this contract — the device layer and the native DTD engine
+    both normalize through here, so engine/device choice can never
+    change what a body's return value means. ``label`` is only used in
+    error messages (a Task repr, a seq id, ...)."""
+    if result is None:
+        return {}
+    if isinstance(result, dict):
+        return result
+    if isinstance(result, (tuple, list)):
+        if len(result) != len(out_flow_names):
+            raise ValueError(
+                f"{label}: body returned {len(result)} values for "
+                f"{len(out_flow_names)} output flows")
+        return dict(zip(out_flow_names, result))
+    if len(out_flow_names) != 1:
+        raise ValueError(
+            f"{label}: single return value but {len(out_flow_names)} "
+            "output flows")
+    return {out_flow_names[0]: result}

@@ -58,26 +58,15 @@ class Device:
     def _run_hook(self, task: Task, chore: Chore) -> HookReturn:
         """Run the functional body and normalize outputs into
         ``task.output`` keyed by output-flow name."""
+        from ..core.task import normalize_outputs
         t0 = time.perf_counter()
         inputs = task.input_values()
         result = chore.hook(task, *inputs)
-        out_flows = task.task_class.output_flows
-        if result is None:
-            outs = {}
-        elif isinstance(result, dict):
-            outs = result
-        elif isinstance(result, (tuple, list)):
-            if len(result) != len(out_flows):
-                raise ValueError(
-                    f"{task!r}: body returned {len(result)} values for "
-                    f"{len(out_flows)} output flows")
-            outs = {f.name: v for f, v in zip(out_flows, result)}
-        else:
-            if len(out_flows) != 1:
-                raise ValueError(
-                    f"{task!r}: single return value but {len(out_flows)} "
-                    f"output flows")
-            outs = {out_flows[0].name: result}
+        # the task object itself as the label: it is only ever
+        # formatted inside the error branches (no per-task repr cost)
+        outs = normalize_outputs(
+            result, [f.name for f in task.task_class.output_flows],
+            task)
         task.output.update(outs)
         with self._lock:
             self.stats["tasks"] += 1

@@ -214,6 +214,11 @@ class Taskpool(CoreTaskpool):
         # on the inserting thread(s)
         self.insert_s = 0.0
         self.insert_calls = 0
+        # native dynamic-task engine (dsl/dtd_native.py): resolved once
+        # at first insert per the runtime.native_dtd knob and the
+        # instrumented-fallback rule; None = the Python engine below
+        self._native = None
+        self._native_checked = False
         # per-taskpool insertion sequence: the cross-rank task identity
         # (every rank replays the same sequence → same numbering)
         self._seq = 0
@@ -274,6 +279,19 @@ class Taskpool(CoreTaskpool):
         with self._inflight_cv:
             self._closed = self._closed or (self.error is not None)
             self._inflight_cv.notify_all()
+        eng = self._native
+        if eng is not None:
+            if self.error is not None:
+                # abort/cancel: release the native queues (queued tasks
+                # drop at select time) and any natively-parked inserter
+                eng.cancel()
+            ctx = self.context
+            if ctx is not None:
+                # fold the engine's counters into the context totals so
+                # parsec_tasks_completed_total survives the pool; an
+                # aborted pool with tasks still in flight keeps its
+                # engine pumped until they drain (retiring state)
+                ctx._ndtd_retire(eng)
         super()._on_terminated()
 
     # ------------------------------------------------------------- classes
@@ -447,11 +465,16 @@ class Taskpool(CoreTaskpool):
     def insert_task(self, fn: Callable, *args, priority: int = 0,
                     device: DeviceType = DeviceType.ALL,
                     name: Optional[str] = None,
-                    pure: bool = False) -> Optional[Task]:
+                    pure: bool = False) -> Optional[Any]:
         """parsec_dtd_insert_task analog (insert_function.c:3488). In
         distributed mode every rank calls this with the identical sequence;
-        returns the local Task, or None when the task is placed remotely
-        (a shell — only tile tracking is updated here).
+        returns the local Task (Python engine) or the task's insertion
+        sequence number as an opaque int handle (native engine — no
+        Python Task object exists there, by design), or None when the
+        task is placed remotely (a shell — only tile tracking is
+        updated here). Callers must treat the result as opaque
+        not-None evidence; the ``name`` hint is display-only and unused
+        by both engines.
 
         ``pure=True`` declares ``fn`` a pure function of its arguments:
         the body is jitted (per arg-shape/value signature) so device
@@ -466,6 +489,11 @@ class Taskpool(CoreTaskpool):
         self._check_insertable()
         if self.admission is not None:
             self.admission.admit(self, 1)
+        eng = self._engine()
+        if eng is not None:
+            # native hot loop: returns the task's sequence number (the
+            # opaque handle — native tasks have no Python Task object)
+            return eng.insert_rows(fn, [args], priority, device, pure)[0]
         tc = self._task_class_for(fn, self._shape_of(args), device,
                                   pure=pure)
         task = self._insert_one(tc, args, priority, None, None)
@@ -477,7 +505,7 @@ class Taskpool(CoreTaskpool):
 
     def insert_tasks(self, fn: Callable, rows, *, priority: int = 0,
                      device: DeviceType = DeviceType.ALL,
-                     pure: bool = False) -> List[Optional[Task]]:
+                     pure: bool = False) -> List[Optional[Any]]:
         """Batched :meth:`insert_task` — the insertion fast path. All
         ``rows`` (sequences of Tile/Value/Scratch args) are inserted with
         the same body, paying the per-insert lookup costs ONCE per batch
@@ -491,7 +519,9 @@ class Taskpool(CoreTaskpool):
 
         Semantically identical to calling ``insert_task`` per row —
         program order, tile tracking, and the cross-rank replay sequence
-        are unchanged. Returns one ``Task | None`` (shell) per row."""
+        are unchanged. Returns one opaque handle per row: a ``Task``
+        (Python engine) or an int seq (native engine), ``None`` for a
+        remote shell."""
         timed = self.context is not None and self.context.stage_timers
         t0 = time.perf_counter() if timed else None
         self._check_insertable()
@@ -501,6 +531,9 @@ class Taskpool(CoreTaskpool):
             return out
         if self.admission is not None:
             self.admission.admit(self, len(rows))
+        eng = self._engine()
+        if eng is not None:
+            return eng.insert_rows(fn, rows, priority, device, pure)
         shape0 = self._shape_of(rows[0])
         tc0 = self._task_class_for(fn, shape0, device, pure=pure)
         ready: List[Task] = []
@@ -537,6 +570,22 @@ class Taskpool(CoreTaskpool):
         return out
 
     # -- insertion internals ----------------------------------------------
+    def _engine(self):
+        """The native dynamic-task engine, or None (the Python path).
+        Resolved ONCE at first insert — the observers the fallback rule
+        checks are installed before work starts; a pool never switches
+        engines mid-flight (the tile tracking marks differ). A raising
+        resolution (forced runtime.native_dtd=1 without a toolchain) is
+        deliberately NOT cached: every retried insert must keep raising
+        rather than silently proceeding on the Python engine."""
+        if self._native_checked:
+            return self._native
+        from . import dtd_native
+        eng = dtd_native.engine_for(self)   # may raise (forced mode)
+        self._native = eng
+        self._native_checked = True
+        return eng
+
     def _check_insertable(self) -> None:
         if self.error is not None:
             raise RuntimeError(
@@ -941,6 +990,12 @@ class Taskpool(CoreTaskpool):
             first = not self._closed
             self._closed = True
             self._inflight_cv.notify_all()
+        if self._native is not None:
+            # native pools never tick nb_tasks (per-task monitor traffic
+            # is exactly the overhead the engine removes): drain the
+            # engine's inflight count FIRST, so releasing the enqueue
+            # action below is what fires termdet
+            self._native.drain()
         if first and self._enqueue_counted:
             self.addto_runtime_actions(-1)
         self.wait_completed()
@@ -953,6 +1008,7 @@ class Taskpool(CoreTaskpool):
         In distributed mode this is a COLLECTIVE: after the local quiesce,
         each rank pushes the tiles it holds back to their owners, waits
         for the owners' acks, and barriers."""
+        from .dtd_native import _NativeWriter
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if self.error is not None:
@@ -966,7 +1022,7 @@ class Taskpool(CoreTaskpool):
                 if collection is not None and tile.collection is not collection:
                     continue
                 with tile.lock:
-                    if isinstance(tile.last_writer, Task):
+                    if isinstance(tile.last_writer, (Task, _NativeWriter)):
                         busy = True
                         break
             if not busy:

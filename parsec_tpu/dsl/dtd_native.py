@@ -1,0 +1,677 @@
+"""Native DTD engine: the insert→release hot loop behind the C ABI.
+
+PaRSEC's entire dynamic-task core is native C — insertion
+(insert_function.c), the dependency hash table (parsec.c:1503-1649), the
+scheduler queues (mca/sched/*) and the worker progress loop
+(scheduling.c:537-676) — precisely so per-task overhead stays in the
+microseconds. This module is the TPU build's equivalent: it drives the
+``pdtd_*`` engine in ``_native/core.cpp`` so that insert, dependency
+countdown, select, steal, and release all run in C++ with the GIL
+released, and Python is entered only to run task bodies. A body
+registered with :func:`register_native_body` (a no-op) lets null tasks
+complete entirely inside the native pump — the shape of the classic
+tasks/s scheduling microbenchmark.
+
+Engine selection (``runtime.native_dtd``, resolved once per taskpool at
+first insert):
+
+- ``auto`` (default): native when the library builds AND the pool is
+  eligible; silently the Python path otherwise.
+- ``1``: same eligibility rules, but an unavailable toolchain is a hard
+  error instead of a silent fallback.
+- ``0``: always the Python path.
+
+Eligibility — the **instrumented-fallback rule**: the native loop cannot
+fire per-task Python observers, so a pool stays on the (instrumented)
+Python engine whenever any of these holds:
+
+- distributed (``nb_ranks > 1``) — replay/shell semantics are Python;
+- an observer is live: dfsan sanitizer, Trace (spans), Grapher,
+  ``runtime.stage_timers``, the debug-history EXE ring, or any
+  registered PINS callback (the ``tenant`` service accounting among
+  them);
+- the context scheduler does not opt in (``native_dtd_capable`` — the
+  lfq/ll/ltq/lhq/gd families do; ``wfq`` keeps Python pools so its
+  weighted-fair arbitration and ``pool_stats`` observe every task, and
+  the PRIORITY-policy modules — llp, pbq, ap, ip, spq — likewise,
+  since the native LIFO/steal queues would discard their ordering key);
+- a non-CPU device is registered (bodies would route through device
+  managers the native pump bypasses).
+
+Serving hooks do NOT force a fallback: ``Taskpool.admission`` runs on
+the inserting thread as usual, and a pool with ``on_retire`` simply
+marks every task Python-bodied so the tenant window drains exactly once
+per completion. ``Taskpool.cancel`` is honored at select time inside
+the native pump.
+
+Program-order semantics are preserved exactly (the functional-WAR
+guarantee of dsl/dtd.py): the two-phase insert (``pdtd_insert`` links
+against in-flight writers, ``pdtd_arm`` makes the batch runnable) lets
+the inserter snapshot the committed tile version whenever the linked
+writer turns out to have already completed — at that instant no other
+writer of the tile can be in flight, because all later writers are in
+the still-unarmed batch.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import _native
+from ..core.task import FlowAccess
+from ..utils import mca_param
+from ..utils.debug import warning
+
+mca_param.register(
+    "runtime.native_dtd", "auto",
+    help="run single-rank DTD pools on the native C++ engine: auto "
+         "(when the library builds and no per-task observer is live) "
+         "| 1 (error if the toolchain is missing) | 0 (Python path)")
+
+# staging-ring row capacity: one pdtd_insert call per ring fill; the
+# native side reports the high-water mark as ring_highwater
+_RING = 1024
+_MAX_PREDS_INIT = 64
+# Python-bodied tasks fetched per pump call: one GIL round-trip (and
+# one batched completion) per _PUMP_BATCH bodies instead of two ctypes
+# calls per task — at 4 workers the per-task calls convoyed on the GIL
+_PUMP_BATCH = 32
+
+# fns registered as native no-op bodies: zero-arg, returns None — tasks
+# inserted with one of these (and no per-task retire hook) complete
+# entirely inside the native pump, never re-entering Python
+_NATIVE_BODIES: set = set()
+
+
+def register_native_body(fn: Callable) -> Callable:
+    """Declare ``fn`` a no-op body (zero arguments, returns ``None``):
+    tasks inserted with it skip Python entirely on the native engine.
+    Returns ``fn`` so it can be used as a decorator."""
+    _NATIVE_BODIES.add(fn)
+    return fn
+
+
+def is_native_body(fn: Callable) -> bool:
+    return fn in _NATIVE_BODIES
+
+
+class _NativeWriter:
+    """In-flight-writer marker parked in ``_Tile.last_writer`` by the
+    native engine (the Python engine parks the Task object there).
+    ``dtd.Taskpool.flush`` treats it as busy like a Task."""
+
+    __slots__ = ("seq",)
+
+    def __init__(self, seq: int):
+        self.seq = seq
+
+
+class _Shim:
+    """Just enough of a Task for the DTD chore hooks, which only read
+    ``task.dsl['argspec']`` (both the eager and the pure/jit hook)."""
+
+    __slots__ = ("dsl",)
+
+    def __init__(self, argspec):
+        self.dsl = {"argspec": argspec}
+
+
+def resolve_mode() -> str:
+    """'off' | 'auto' | 'force' from the runtime.native_dtd MCA param."""
+    v = str(mca_param.get("runtime.native_dtd", "auto")).lower()
+    if v in ("0", "off", "false", "no"):
+        return "off"
+    if v in ("1", "on", "true", "force", "yes"):
+        return "force"
+    return "auto"
+
+
+def engine_for(tp) -> Optional["NativeDTD"]:
+    """Build the native engine for ``tp`` if it is eligible (see module
+    docstring), else None. Raises when ``runtime.native_dtd=1`` is
+    forced but the library cannot be built/loaded — a silent fallback
+    would misreport every rate the caller measures."""
+    mode = resolve_mode()
+    if mode == "off":
+        return None
+    lib = _native.load()
+    if lib is None:
+        if mode == "force":
+            raise RuntimeError(
+                "runtime.native_dtd=1 but the native core is "
+                f"unavailable: {_native.build_error()} — install g++ "
+                "or set runtime.native_dtd=0/auto")
+        return None
+    ctx = tp.context
+    if ctx is None or tp.nb_ranks > 1:
+        return None
+    # instrumented-fallback rule: any live per-task observer keeps the
+    # pool on the Python path (the observers' hooks must see every task)
+    if ctx.dfsan is not None or ctx.trace is not None or \
+            ctx.grapher is not None or ctx.stage_timers:
+        return None
+    if ctx.pins.active():
+        return None
+    from ..utils import debug_history
+    if debug_history.enabled():     # EXE-mark ring expects every task
+        return None
+    if not getattr(ctx.scheduler, "native_dtd_capable", False):
+        return None
+    # a REAL accelerator module registered: bodies must route through
+    # the device managers (async dispatch, batching, per-device load)
+    # — the native pump runs them inline on the worker thread, which is
+    # only equivalent when every device executes on the host anyway
+    # (virtual CPU-platform modules). Tests that pin the device-manager
+    # plane itself (load splitting across modules) set
+    # runtime.native_dtd=0 explicitly.
+    if any(getattr(d, "platform", "cpu") != "cpu"
+           for d in ctx.devices.devices):
+        return None
+    return NativeDTD(tp, lib)
+
+
+class NativeDTD:
+    """Per-taskpool driver of the native ``pdtd_*`` engine."""
+
+    def __init__(self, tp, lib):
+        self.tp = tp
+        self.lib = lib
+        ctx = tp.context
+        self.nworkers = ctx.nb_cores
+        # per-worker plifo capacity sized to the inserter window (ready
+        # tasks are bounded by inflight <= window; 2x slack across the
+        # round-robin spread) — a fixed large capacity was pure per-pool
+        # allocation churn on the serving admission path. Overspill goes
+        # to the engine's locked overflow dequeue.
+        qcap = max(1024, 2 * tp._window // max(1, self.nworkers))
+        self._e = lib.pdtd_new(self.nworkers, qcap)
+        if not self._e:
+            raise MemoryError("pdtd_new failed")
+        # per-python-task state, keyed by seq: (hook, out_flow_names,
+        # argspec, resolvers, out_tiles, n_lpreds)
+        self.rows: Dict[int, tuple] = {}
+        # retained outputs of completed writers, keyed by seq — dropped
+        # by the native refcount (pdtd_complete's drop list)
+        self.outputs: Dict[int, Dict[str, Any]] = {}
+        # staging ring: reusable arrays, one native call per fill
+        self._prio = np.zeros(_RING, np.int32)
+        self._flags = np.zeros(_RING, np.uint8)
+        self._npreds = np.zeros(_RING, np.uint32)
+        self._preds = np.zeros(_RING * 4, np.uint32)
+        self._linked = np.zeros(_RING * 4, np.uint8)
+        # per-worker pump/complete scratch (workers never share a slot)
+        self._tidbuf = [(ctypes.c_uint32 * _PUMP_BATCH)()
+                        for _ in range(self.nworkers)]
+        self._ranbuf = [ctypes.c_int() for _ in range(self.nworkers)]
+        self._batchbuf = [(ctypes.c_uint32 * _PUMP_BATCH)()
+                          for _ in range(self.nworkers)]
+        self._infobuf = [(ctypes.c_int32 * 2)()
+                         for _ in range(self.nworkers)]
+        self._dropbuf = [(ctypes.c_uint32 * _MAX_PREDS_INIT)()
+                         for _ in range(self.nworkers)]
+        # class-info cache: (fn, shape, device, pure) -> (hook,
+        # out_flow_names); resolution goes through the taskpool's
+        # task-class cache so pure=True bodies share the process-wide
+        # jit cache with the Python engine
+        self._class_info: Dict[Any, tuple] = {}
+        self._lock = threading.Lock()       # insert-side ring guard
+        self._unarmed = None    # (first, n) between pdtd_insert and arm
+        self._cancelled = False
+        # set when the pool terminated with tasks still in flight (an
+        # abort): the workers keep pumping this engine and fold it into
+        # the context totals once the last task drains
+        self.retiring = False
+        ctx._ndtd_register(self)
+
+    # -------------------------------------------------------------- insert
+    def _class_for(self, fn, shape, device, pure):
+        key = (fn, shape, device, pure)
+        info = self._class_info.get(key)
+        if info is None:
+            tc = self.tp._task_class_for(fn, shape, device, pure=pure)
+            hook = tc.incarnations[0].hook if tc.incarnations else None
+            info = (hook, tuple(f.name for f in tc.output_flows),
+                    tc.name)
+            self._class_info[key] = info
+        return info
+
+    def insert_rows(self, fn, rows, priority, device, pure) -> List[int]:
+        """Batched insert through the native engine; returns the task
+        sequence numbers (the opaque per-task handles — native tasks
+        have no Python Task object)."""
+        out: List[int] = []
+        n = len(rows)
+        for start in range(0, n, _RING):
+            out.extend(self._insert_chunk(
+                fn, rows[start:start + _RING], priority, device, pure))
+            self._throttle()
+        return out
+
+    def _insert_chunk(self, fn, rows, priority, device, pure) -> List[int]:
+        with self._lock:
+            try:
+                return self._insert_chunk_locked(fn, rows, priority,
+                                                 device, pure)
+            except BaseException as exc:
+                # a raise mid-chunk (stage_read failure, bad argspec)
+                # leaves registered-but-unarmed tasks and/or a bumped
+                # tp._seq behind — unrecoverable for this pool. Abort it
+                # so wait()-ers get the error instead of hanging, and
+                # arm whatever the engine registered so the cancelled
+                # tasks drain through the drop path.
+                pending = self._unarmed
+                if pending is not None:
+                    self._unarmed = None
+                    self.lib.pdtd_arm(self._e, pending[0], pending[1])
+                self.tp.abort(exc)
+                raise
+
+    def _insert_chunk_locked(self, fn, rows, priority, device,
+                             pure) -> List[int]:
+        from .dtd import ScratchArg, ValueArg
+        tp = self.tp
+        ctx = tp.context
+        lib = self.lib
+        native_ok = (fn in _NATIVE_BODIES and tp.on_retire is None)
+        n = len(rows)
+        tile_cache: Dict[Any, Any] = {}
+        prio_a, flags_a, npreds_a = self._prio, self._flags, self._npreds
+        preds_a, linked_a = self._preds, self._linked
+        seqs: List[int] = []
+        # pending[(row_i)] = per-row python-side record
+        pend: List[Optional[tuple]] = []
+        pi = 0
+        max_lp = 0
+        for args in rows:
+            seq = tp._seq
+            tp._seq += 1
+            seqs.append(seq)
+            i = len(pend)
+            spec: List[tuple] = []
+            resolvers: List[tuple] = []
+            out_tiles: List[tuple] = []
+            seen: Dict[Any, int] = {}       # tile -> primary flow idx
+            flow_i = 0
+            row_np = 0
+            for a in args:
+                if isinstance(a, ValueArg):
+                    spec.append(("value", a.value))
+                    continue
+                if isinstance(a, ScratchArg):
+                    spec.append(("scratch", (a.shape, a.dtype)))
+                    continue
+                tile = tp._tile_of_cached(a.collection, a.key,
+                                          tile_cache)
+                fname = f"f{flow_i}"
+                idx = flow_i
+                flow_i += 1
+                spec.append(("tile", None))
+                primary = seen.get(tile)
+                if primary is not None:
+                    # same tile twice in one insert: alias to the
+                    # first occurrence (no self-link)
+                    resolvers.append((2, primary))
+                else:
+                    seen[tile] = idx
+                    with tile.lock:
+                        writer = tile.last_writer
+                        writer_flow = tile.last_writer_flow
+                    if isinstance(writer, _NativeWriter):
+                        if pi >= len(preds_a):
+                            preds_a = self._grow_preds(pi + n)
+                            linked_a = self._linked
+                        preds_a[pi] = writer.seq
+                        # snap-vs-link decided by pdtd_insert's
+                        # linked_out (slot pi) in pass 2
+                        resolvers.append(
+                            (1, writer.seq, writer_flow, tile, pi))
+                        pi += 1
+                        row_np += 1
+                    else:
+                        # no writer in flight: snapshot the current
+                        # version NOW (program order; stage-through
+                        # like the Python engine)
+                        resolvers.append((0, ctx.stage_read(
+                            a.collection, a.key,
+                            a.collection.data_of(a.key))))
+                if a.access & FlowAccess.WRITE:
+                    with tile.lock:
+                        tile.last_writer = _NativeWriter(seq)
+                        tile.last_writer_flow = fname
+                    out_tiles.append((tile, fname, idx))
+            needs_python = not (native_ok and not spec)
+            flags_a[i] = 1 if needs_python else 0
+            prio_a[i] = priority
+            npreds_a[i] = row_np
+            max_lp = max(max_lp, row_np)
+            pend.append((spec, resolvers, out_tiles)
+                        if needs_python else None)
+        if max_lp > _MAX_PREDS_INIT and \
+                max_lp > len(self._dropbuf[0]):
+            self._dropbuf = [(ctypes.c_uint32 * (2 * max_lp))()
+                             for _ in range(self.nworkers)]
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        first = lib.pdtd_insert(
+            self._e, n, prio_a.ctypes.data_as(i32p),
+            flags_a.ctypes.data_as(u8p),
+            npreds_a.ctypes.data_as(u32p),
+            preds_a.ctypes.data_as(u32p),
+            linked_a.ctypes.data_as(u8p))
+        if first < 0:
+            raise RuntimeError(
+                f"pdtd_insert failed (rc={first}): task table "
+                "exhausted or inconsistent predecessor ids")
+        # registered but not yet runnable: _insert_chunk's except path
+        # arms this range so an abort still drains the engine
+        self._unarmed = (int(first), n)
+        if first != seqs[0]:
+            raise RuntimeError(
+                f"native DTD id drift: table at {first}, pool seq "
+                f"at {seqs[0]} — mixed-engine insertion?")
+        # pass 2: resolve snap-vs-link from linked_out, attach the
+        # python-side rows, THEN arm the batch (a task must not be
+        # runnable before its resolvers exist)
+        hook_info = None
+        for i, rec in enumerate(pend):
+            if rec is None:
+                continue
+            spec, resolvers, out_tiles = rec
+            n_lp = 0
+            for j, r in enumerate(resolvers):
+                if r[0] != 1:
+                    continue
+                if linked_a[r[4]]:
+                    resolvers[j] = (1, r[1], r[2])
+                    n_lp += 1
+                else:
+                    # writer already completed and committed: the
+                    # collection holds exactly its version (every
+                    # later writer is in this still-unarmed batch)
+                    tile = r[3]
+                    resolvers[j] = (0, ctx.stage_read(
+                        tile.collection, tile.key,
+                        tile.collection.data_of(tile.key)))
+            if hook_info is None:
+                hook_info = {}
+            shape = tp._shape_of(rows[i])
+            info = hook_info.get(shape)
+            if info is None:
+                info = hook_info[shape] = self._class_for(
+                    fn, shape, device, pure)
+            self.rows[seqs[i]] = (info, tuple(spec), resolvers,
+                                  out_tiles, n_lp)
+        self._unarmed = None
+        lib.pdtd_arm(self._e, first, n)
+        evt = ctx._work_evt
+        if not evt.is_set():
+            evt.set()
+        return seqs
+
+    def _grow_preds(self, need: int) -> np.ndarray:
+        cap = max(2 * len(self._preds), need)
+        self._preds = np.resize(self._preds, cap)
+        self._linked = np.zeros(cap, np.uint8)
+        return self._preds
+
+    def _throttle(self) -> None:
+        """Sliding-window inserter park off the GIL (the pdtd cv): the
+        same window/threshold contract as the Python engine, released
+        event-driven on drain and on abort/cancel."""
+        tp = self.tp
+        lib = self.lib
+        if lib.pdtd_inflight(self._e) < tp._window:
+            return
+        while not tp._closed and tp.error is None:
+            left = lib.pdtd_wait_below(self._e, tp._threshold, 250)
+            if left <= tp._threshold or self._cancelled:
+                break
+        if tp.error is not None:
+            raise RuntimeError(
+                f"taskpool {tp.name} aborted: {tp.error}") from tp.error
+
+    # ---------------------------------------------------------------- pump
+    def pump(self, es) -> bool:
+        """Worker-side progress: drain native-bodied ready tasks inside
+        the C ABI call (GIL released), run Python-bodied ones here in
+        batches of up to _PUMP_BATCH per GIL round-trip. Returns True
+        when any task was completed."""
+        lib = self.lib
+        w = es.th_id if es.th_id < self.nworkers else 0
+        tids = self._tidbuf[w]
+        rann = self._ranbuf[w]
+        ran = False
+        while True:
+            n = lib.pdtd_pump_batch(self._e, w, tids, _PUMP_BATCH,
+                                    ctypes.byref(rann))
+            if rann.value:
+                ran = True
+            if n == 0:
+                if self.retiring and lib.pdtd_inflight(self._e) == 0:
+                    # aborted pool fully drained: fold the counters now
+                    self.tp.context._ndtd_unregister(self)
+                return ran
+            ran = True
+            self._run_batch(tids, n, w)
+
+    def _run_batch(self, tids, n: int, w: int) -> None:
+        """Run up to _PUMP_BATCH Python bodies. Tasks with no tile
+        traffic (no retained outputs, no consumed predecessors — the
+        null-task and serving shapes) complete through ONE batched
+        native call; tile-bearing tasks take the full individual path
+        (write-back, retained outputs, drop reporting)."""
+        tp = self.tp
+        rows = self.rows
+        done: List[tuple] = []          # (seq, tc_name) batch-completable
+        try:
+            for i in range(n):
+                seq = tids[i]
+                row = rows.pop(seq, None)
+                if row is None:
+                    done.append((seq, "dtd_task"))
+                    continue
+                info, spec, resolvers, out_tiles, n_lp = row
+                if out_tiles or n_lp:
+                    self._run_full(seq, info, spec, resolvers,
+                                   out_tiles, n_lp, w)
+                    continue
+                hook = info[0]
+                result = hook(_Shim(spec), *self._resolve(resolvers)) \
+                    if hook is not None else None
+                self._normalize(result, info[1], seq)   # validate-only:
+                # no output flow can exist without an out tile
+                done.append((seq, info[2]))
+        except BaseException as exc:  # noqa: BLE001 — worker must survive
+            self._flush_batch(done, w)
+            self._fail(seq, exc, w)
+            # account the popped-but-unrun remainder so the engine still
+            # drains (the pool is aborted; their bodies never run)
+            rest = [(tids[j], "dtd_task") for j in range(i + 1, n)]
+            for s, _ in rest:
+                rows.pop(s, None)
+            self._flush_batch(rest, w, retire=False)
+            return
+        self._flush_batch(done, w)
+
+    def _flush_batch(self, done: List[tuple], w: int,
+                     retire: bool = True) -> None:
+        if not done:
+            return
+        tp = self.tp
+        # retire hooks + lineage BEFORE the native completion: wait()'s
+        # drain returns when the engine's inflight hits zero, and the
+        # Python engine guarantees every on_retire happened-before wait
+        # returns (the tenant-window accounting tests rely on it). The
+        # finally keeps the completion unconditional — a raising retire
+        # hook must not strand popped tasks (inflight would never drain)
+        try:
+            if retire and tp.on_retire is not None:
+                for _ in done:
+                    tp.on_retire(tp)
+            if tp.context._track_completed:
+                add = tp.completed_tasks.add
+                for s, nm in done:
+                    add((nm, (s,)))
+        finally:
+            arr = self._batchbuf[w]
+            for j, (s, _) in enumerate(done):
+                arr[j] = s
+            newly = self.lib.pdtd_complete_batch(self._e, w, arr,
+                                                 len(done))
+            if newly:
+                evt = tp.context._work_evt
+                if not evt.is_set():
+                    evt.set()
+
+    def _resolve(self, resolvers) -> List[Any]:
+        vals = [None] * len(resolvers)
+        outputs = self.outputs
+        for i, r in enumerate(resolvers):
+            k = r[0]
+            if k == 0:
+                vals[i] = r[1]
+            elif k == 1:
+                out = outputs.get(r[1])
+                vals[i] = None if out is None else out.get(r[2])
+            else:                               # alias of an earlier flow
+                vals[i] = vals[r[1]]
+        return vals
+
+    def _run_full(self, seq: int, info, spec, resolvers, out_tiles,
+                  n_lp: int, w: int) -> None:
+        """Individual path for tile-bearing tasks: body, write-back +
+        writer-marker retire (write BEFORE clear, the Python engine's
+        retire protocol), retained outputs for linked readers, native
+        completion with drop reporting."""
+        tp = self.tp
+        hook, out_flows, tc_name = info
+        try:
+            vals = self._resolve(resolvers)
+            result = hook(_Shim(spec), *vals) if hook is not None \
+                else None
+            outs = self._normalize(result, out_flows, seq)
+            if out_tiles:
+                # retained per-flow value for linked readers: the
+                # produced output, else the input that flowed through
+                # (INOUT chain semantics)
+                retained: Dict[str, Any] = {}
+                for (tile, fname, idx) in out_tiles:
+                    v = outs.get(fname, vals[idx] if idx < len(vals)
+                                 else None)
+                    retained[fname] = v
+                    if fname in outs:
+                        tile.collection.write_tile(tile.key, outs[fname])
+                    with tile.lock:
+                        lw = tile.last_writer
+                        if isinstance(lw, _NativeWriter) and \
+                                lw.seq == seq:
+                            tile.last_writer = None
+                            tile.last_writer_flow = None
+                self.outputs[seq] = retained
+        except BaseException as exc:  # noqa: BLE001 — worker must survive
+            self._fail(seq, exc, w)
+            return
+        # retire before the native completion — see _flush_batch; the
+        # finally keeps the completion unconditional on a raising hook
+        try:
+            if tp.on_retire is not None:
+                tp.on_retire(tp)
+            if tp.context._track_completed:
+                tp.completed_tasks.add((tc_name, (seq,)))
+        finally:
+            self._complete(seq, w, n_lp, drop_own=not out_tiles)
+
+    def _complete(self, seq: int, w: int, n_lp: int,
+                  drop_own: bool) -> None:
+        lib = self.lib
+        info = self._infobuf[w]
+        drops = self._dropbuf[w] if n_lp else None
+        nd = lib.pdtd_complete(self._e, w, seq, drops,
+                               n_lp, info)
+        if nd > 0:
+            outputs = self.outputs
+            for i in range(min(nd, n_lp)):
+                outputs.pop(drops[i], None)
+        if not drop_own and info[1] == 0:
+            # no linked reader will ever consume these outputs
+            self.outputs.pop(seq, None)
+        if info[0]:
+            evt = self.tp.context._work_evt
+            if not evt.is_set():
+                evt.set()
+
+    def _fail(self, seq: int, exc: BaseException, w: int) -> None:
+        """A Python body raised: abort the pool (which cancels this
+        engine via _on_terminated), then account the failed task so the
+        engine still drains."""
+        tp = self.tp
+        warning("scheduling", "native DTD task seq=%d of %s raised: %s",
+                seq, tp.name, exc)
+        import traceback
+        traceback.print_exc()
+        tp.abort(exc)
+        self._complete(seq, w, 0, drop_own=True)
+
+    # ----------------------------------------------------- drain / cancel
+    def drain(self) -> None:
+        """Block until every inserted task left flight (wait()); exits
+        early when the pool aborted (cancel() already released the
+        queued tasks)."""
+        lib = self.lib
+        tp = self.tp
+        while lib.pdtd_inflight(self._e) > 0:
+            if tp.error is not None:
+                return
+            lib.pdtd_wait_below(self._e, 0, 250)
+
+    def cancel(self) -> None:
+        self._cancelled = True
+        self.lib.pdtd_cancel(self._e)
+
+    def inflight(self) -> int:
+        return int(self.lib.pdtd_inflight(self._e))
+
+    def release_refs(self) -> None:
+        """Drop retained per-task state once the engine is FOLDED (the
+        pool terminated AND inflight hit zero — no body can resolve a
+        value anymore). The abort path completes failed/unrun tasks
+        without drop reporting, so without this sweep an aborted pool's
+        retained tile outputs would stay pinned until the pool object
+        itself is collected."""
+        self.rows.clear()
+        self.outputs.clear()
+
+    # ------------------------------------------------------------- observe
+    def stats(self) -> Dict[str, int]:
+        buf = (ctypes.c_uint64 * 16)()
+        self.lib.pdtd_stats(self._e, buf)
+        return {k: int(v) for k, v in zip(_native.PDTD_STAT_KEYS, buf)
+                if k != "reserved"}
+
+    # ------------------------------------------------------------- helpers
+    @staticmethod
+    def _normalize(result, out_flows, seq) -> Dict[str, Any]:
+        """Body result → output-flow dict: the ONE shared contract
+        (core.task.normalize_outputs — also the device layer's), so
+        engine choice never changes what a return value means."""
+        from ..core.task import normalize_outputs
+        return normalize_outputs(result, out_flows,
+                                 f"dtd task seq={seq}")
+
+    def __del__(self):
+        e = getattr(self, "_e", None)
+        lib = getattr(self, "lib", None)
+        if e and lib is not None:
+            try:
+                lib.pdtd_free(e)
+            except (AttributeError, TypeError, OSError):
+                pass        # interpreter teardown: the OS reclaims it
+        try:
+            self._e = None
+        except Exception:  # noqa: BLE001 — __del__ must never raise
+            pass

@@ -365,8 +365,16 @@ def install_context_collectors(context) -> Callable[[], None]:
     g_done = reg.gauge("parsec_tasks_completed_total",
                        "tasks completed by the host runtime (sum of "
                        "the per-stream executed counters + device "
-                       "completions; computed at scrape time — the "
-                       "hot path pays nothing)", ("rank",))
+                       "completions + native-DTD engine completions; "
+                       "computed at scrape time — the hot path pays "
+                       "nothing)", ("rank",))
+    g_native = reg.gauge("parsec_native_dtd",
+                         "native DTD engine counters (inserted/"
+                         "ready_pushed/stolen/released_edges/"
+                         "completed_native/completed_python/"
+                         "ring_highwater/inflight/ready, read from the "
+                         "engine's C++ atomics at scrape time)",
+                         ("rank", "key"))
     g_ready = reg.gauge("parsec_sched_ready_tasks",
                         "tasks queued in the scheduler", ("rank",))
     g_pools = reg.gauge("parsec_active_taskpools",
@@ -419,8 +427,16 @@ def install_context_collectors(context) -> Callable[[], None]:
                 agg[k] += es.stats.get(k, 0)
         for k, v in agg.items():
             setg(g_stream, v, rank=rank, event=k)
+        # native DTD engines complete tasks outside the stream counters
+        # (the whole point of the native loop) — fold them in so the
+        # completed-total stays correct whichever engine ran the pool
+        nstats = ctx.native_dtd_stats()
+        for k, v in nstats.items():
+            setg(g_native, v, rank=rank, key=k)
         setg(g_done, agg["executed"] +
-             ctx.stats.get("device_completed", 0), rank=rank)
+             ctx.stats.get("device_completed", 0) +
+             nstats.get("completed_native", 0) +
+             nstats.get("completed_python", 0), rank=rank)
         sched = ctx.scheduler
         if hasattr(sched, "pool_stats"):
             for pool, row in sched.pool_stats().items():

@@ -53,6 +53,12 @@ class PinsManager:
     def register(self, event: PinsEvent, cb: Callable) -> None:
         self._chains[event].append(cb)
 
+    def active(self) -> bool:
+        """True when ANY callback chain is populated — per-task PINS
+        observers are live, so the native DTD engine (whose hot loop
+        cannot fire them) must leave pools on the instrumented path."""
+        return any(self._chains.values())
+
     def unregister(self, event: PinsEvent, cb: Callable) -> None:
         try:
             self._chains[event].remove(cb)

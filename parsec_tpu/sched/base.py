@@ -17,6 +17,15 @@ class Scheduler:
 
     name = "base"
 
+    # Opt-in for the native DTD engine (dsl/dtd_native.py): True means
+    # this scheduler tolerates single-rank DTD pools draining through
+    # the native per-worker queues instead of its own structures (the
+    # worker loop pumps the engine when select() starves). Schedulers
+    # whose POLICY must observe every task — wfq's weighted-fair
+    # arbitration — keep this False so their pools stay on the
+    # instrumented Python path.
+    native_dtd_capable = False
+
     def install(self, context) -> None:
         self.context = context
 

@@ -52,6 +52,11 @@ class WFQScheduler(Scheduler):
     """Weighted-fair (stride) selection across live taskpools."""
 
     name = "wfq"
+    # weighted-fair arbitration must SEE every task to charge virtual
+    # time and populate pool_stats — DTD pools under wfq therefore stay
+    # on the instrumented Python path even when runtime.native_dtd is
+    # on (the documented serving-side arm of the fallback rule)
+    native_dtd_capable = False
 
     def install(self, context) -> None:
         super().install(context)

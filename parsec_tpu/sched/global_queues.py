@@ -25,6 +25,11 @@ _tie = itertools.count()
 
 
 class _HeapScheduler(Scheduler):
+    # ap/ip/spq are PRIORITY-policy schedulers: the whole module is the
+    # ordering key, and the native DTD engine's LIFO/steal queues would
+    # silently discard it — like wfq, they keep DTD pools on the Python
+    # path (native_dtd_capable stays False from the base class)
+
     def install(self, context) -> None:
         super().install(context)
         self.heap = []
@@ -77,6 +82,7 @@ class GDScheduler(Scheduler):
     """Single global dequeue: distance 0 pushes to the front, others to the
     back; select pops the front."""
     name = "gd"
+    native_dtd_capable = True
 
     def install(self, context) -> None:
         super().install(context)

@@ -69,6 +69,10 @@ class _LocalQueueScheduler(Scheduler):
     from VP peers, else system overflow queue."""
 
     local_bound = 0          # >0: bounded local buffer, overflow to system
+    # the native DTD engine's per-worker plifo queues + steal ARE this
+    # family's structure in C++ — the worker loop pumps them when
+    # select() comes up dry (runtime.native_dtd; dsl/dtd_native.py)
+    native_dtd_capable = True
 
     def install(self, context) -> None:
         super().install(context)
@@ -205,6 +209,9 @@ class PBQScheduler(_LocalQueueScheduler):
     similar priority stay FIFO-ordered (no total sort), high bands pop
     first. Distinct from llp's totally-ordered LIFO."""
     name = "pbq"
+    # priority-policy module: the native LIFO queues would discard the
+    # banding — DTD pools stay on the Python path (like wfq/ap/ip/spq)
+    native_dtd_capable = False
     n_bands = 4
     band_shift = 4            # priority // 16 picks the band (clamped)
 
@@ -271,6 +278,7 @@ class LLPScheduler(_LocalQueueScheduler):
     detach/merge/reattach (sched/llp, 790 LoC) — rather than pbq's
     banded FIFO. Steals take the victim's lowest-priority tail."""
     name = "llp"
+    native_dtd_capable = False      # priority policy — see pbq
 
     def _push_local(self, q, tasks, distance: int) -> None:
         batch = sorted(tasks, key=lambda t: -t.priority)

@@ -367,11 +367,80 @@ def _overload_probe(n_flood: int = 400, watermark: int = 64,
         ctx.fini()
 
 
+def _native_ab_probe(n_pools: int = 40, rows_per_pool: int = 200) -> Dict:
+    """Native-vs-Python serving A/B (ISSUE 10): a single-rank serving
+    runtime on a native-capable scheduler (lfq — wfq keeps DTD pools on
+    the instrumented Python path by design, so an A/B there measures
+    nothing) pushes a stream of admission-controlled submissions through
+    both engines. Every task carries the tenant's ``on_retire`` hook, so
+    the native engine runs its Python-bodied path: insert, dependency
+    countdown, select, steal, and release native; the body + window
+    retire in Python — the serving shape of the hot loop."""
+    import time as _time
+    from .. import _native
+    from ..core import context as ctx_mod
+    from ..dsl import dtd
+    from ..serving import runtime as srt
+    from ..utils import mca_param
+
+    if not _native.available():
+        # degrade instead of raising (forcing native=1 without a
+        # toolchain raises by design): record WHY, keep the section
+        return {"python": None, "native": None, "native_vs_python": None,
+                "note": f"native core unavailable: "
+                        f"{_native.build_error()}"}
+
+    def run(native: int) -> Dict:
+        ctx = None
+        try:
+            mca_param.set("runtime.native_dtd", native)
+            mca_param.set("sched", "lfq")
+            ctx = ctx_mod.init(nb_cores=4)
+            rt = srt.enable(ctx)
+            ctx.start()
+            engines = set()
+            t0 = _time.perf_counter()
+            for i in range(n_pools):
+                tp = dtd.Taskpool(f"ab{native}_{i}")
+                sub = ctx.submit(tp, tenant="ab")
+                tp.insert_tasks(_null_ab_body,
+                                [() for _ in range(rows_per_pool)])
+                tp.wait()
+                sub.wait()
+                engines.add(tp._native is not None)
+            dt = _time.perf_counter() - t0
+            return {"requests_per_sec": round(n_pools / dt, 2),
+                    "rows_per_sec": round(n_pools * rows_per_pool / dt, 1),
+                    "engine_native": engines == {True}}
+        finally:
+            mca_param.unset("runtime.native_dtd")
+            mca_param.unset("sched")
+            if ctx is not None:
+                ctx.fini()
+
+    run(0)                                     # warm both code paths
+    py = run(0)
+    nat = run(1)
+    ratio = (round(nat["rows_per_sec"] / py["rows_per_sec"], 3)
+             if py["rows_per_sec"] else None)
+    return {"python": py, "native": nat,
+            "native_vs_python": ratio,
+            "note": "lfq serving submissions (admission + on_retire per "
+                    "task) A/B'd across runtime.native_dtd; the wfq "
+                    "phase above keeps the instrumented Python path per "
+                    "the fallback rule"}
+
+
+def _null_ab_body(x=None):
+    return None
+
+
 def measure_serving(duration_s: float = 4.0) -> Dict:
     """The full ``--section serving`` measurement (see module doc)."""
     clean = _run_phase(False, duration_s)
     faulty = _run_phase(True, duration_s)
     overload = _overload_probe()
+    native_ab = _native_ab_probe()
 
     def p99(phase, t):
         row = phase["tenants"].get(t) or {}
@@ -404,6 +473,8 @@ def measure_serving(duration_s: float = 4.0) -> Dict:
         "clean": clean,
         "faulty": faulty,
         "overload": overload,
+        "native_ab": native_ab,
+        "native_vs_python": native_ab.get("native_vs_python"),
         "shed_count": overload["shed"],
         "quarantine_count": faulty["serving_stats"]["quarantined"],
         "isolation_check": "OK" if isolation_ok else "FAIL",

@@ -39,10 +39,15 @@ def _fat_result():
         "ooc_potrf": {"gflops": 5.5, "hbm_measured": {"spills": 5},
                       "note": "v" * 500},
         "taskrate": {"tasks_per_sec": 9876.5, "n_tasks": 20000,
+                     "tasks_per_sec_native": 702199.7,
+                     "tasks_per_sec_python": 9000.6,
+                     "native_vs_python": 78.02,
                      "overhead_us_per_task": 101.2,
                      "stage_us_per_task": {"insert": 34.4, "select": 1.8,
                                            "dispatch": 13.4,
                                            "release": 8.2},
+                     "native_stage_counts": {"inserted": 20000,
+                                             "stolen": 11268},
                      "note": "u" * 300},
     }
     return {
@@ -81,6 +86,9 @@ def test_compact_summary_fits_tail_window():
     assert d["getrf_fused_gflops"] == 63193.8
     assert d["geqrf_fused_gflops"] == 104985.7
     assert d["tasks_per_sec"] == 9876.5
+    assert d["tasks_per_sec_native"] == 702199.7
+    assert d["tasks_per_sec_python"] == 9000.6
+    assert d["taskrate_native_ratio"] == 78.02
     assert d["taskrate_stage_us"]["insert"] == 34.4
 
 
@@ -141,6 +149,29 @@ def test_compare_captures_guards_tasks_per_sec():
     # within-band / improvements stay quiet
     assert bench._compare_captures(
         {"tasks_per_sec": 9500.0, "host_dtd_gflops": 2000.0}, prior) == {}
+
+
+def test_native_taskrate_keys_registered_and_guarded():
+    """ISSUE 10 bench contract: the native-vs-python taskrate A/B keys
+    land in the compact summary and BOTH engine rates ride the
+    throughput drop-guard; the serving native A/B row is carried too."""
+    bench = _load_bench()
+    assert "tasks_per_sec_native" in bench._GFLOPS_GUARD_KEYS
+    assert "tasks_per_sec_python" in bench._GFLOPS_GUARD_KEYS
+    prior = {"tasks_per_sec_native": 700000.0,
+             "tasks_per_sec_python": 10000.0}
+    out = bench._compare_captures(
+        {"tasks_per_sec_native": 100000.0,       # -86%: the native loop
+         "tasks_per_sec_python": 9800.0}, prior)  # silently fell back?
+    assert "tasks_per_sec_native" in out["throughput_regression"]
+    assert "tasks_per_sec_python" not in out["throughput_regression"]
+    # serving native A/B: recorded in the compact summary
+    result = _fat_result()
+    result["detail"]["extra_configs"]["serving"] = {
+        "requests_per_sec": 55.7, "native_vs_python": 2.26,
+        "p99_ms": 13.7}
+    compact = json.loads(bench._compact_summary(result))
+    assert compact["detail"]["serving_native_ratio"] == 2.26
 
 
 def test_compare_captures_flags_latency_rise_only_on_worsening():

@@ -29,11 +29,18 @@ def san_ctx():
         mca_param.unset("pins")
 
 
-def _run_dtd_gemm(scheduler, release_batch, bypass_chain, nb_cores=4):
-    """One DTD GEMM run under the sanitizer; returns (races, digest)."""
+def _run_dtd_gemm(scheduler, release_batch, bypass_chain, nb_cores=4,
+                  native_dtd=0):
+    """One DTD GEMM run under the sanitizer; returns (races, digest).
+    ``native_dtd=1`` asserts the standing ISSUE 10 determinism guard:
+    the sanitizer is a per-task observer, so the pool falls back to the
+    instrumented Python path (the documented rule) and the per-tile
+    version digest must stay bitwise-identical to every other engine
+    configuration."""
     mca_param.set("pins", "dfsan")
     mca_param.set("runtime.release_batch", release_batch)
     mca_param.set("runtime.bypass_chain", bypass_chain)
+    mca_param.set("runtime.native_dtd", native_dtd)
     try:
         ctx = parsec.init(nb_cores=nb_cores, scheduler=scheduler)
         ctx.start()
@@ -54,20 +61,26 @@ def _run_dtd_gemm(scheduler, release_batch, bypass_chain, nb_cores=4):
         tp.wait()
         races = [str(r) for r in ctx.dfsan.races]
         digest = ctx.dfsan.digest()
+        # the sanitizer observer must have kept the pool on the
+        # instrumented Python path regardless of runtime.native_dtd
+        assert tp._native is None
         parsec.fini(ctx)
         return races, digest
     finally:
         mca_param.unset("pins")
         mca_param.unset("runtime.release_batch")
         mca_param.unset("runtime.bypass_chain")
+        mca_param.unset("runtime.native_dtd")
 
 
 def test_determinism_digest_across_schedulers_and_release_knobs():
     """Satellite/acceptance: the per-tile version-sequence digest is
     bitwise-identical across both scheduler families (lfq =
-    local_queues, gd = global_queues) and both `runtime.release_batch`
-    settings, plus `runtime.bypass_chain` off — the regression harness
-    for PR 3's batched-release/bypass-chain fast paths."""
+    local_queues, gd = global_queues), both `runtime.release_batch`
+    settings, `runtime.bypass_chain` off, AND `runtime.native_dtd`
+    on/off (ISSUE 10: the engine knob must never change the observed
+    dataflow) — the regression harness for the scheduler/release fast
+    paths."""
     digests = set()
     for scheduler in ("lfq", "gd"):
         for release_batch in (1, 0):
@@ -77,6 +90,10 @@ def test_determinism_digest_across_schedulers_and_release_knobs():
     races, digest = _run_dtd_gemm("lfq", 1, 0)     # bypass_chain off
     assert not races, races
     digests.add(digest)
+    for native in (0, 1):                          # ISSUE 10 engine knob
+        races, digest = _run_dtd_gemm("lfq", 1, 1, native_dtd=native)
+        assert not races, races
+        digests.add(digest)
     assert len(digests) == 1, f"schedule-dependent digests: {digests}"
 
 

@@ -34,8 +34,21 @@ def test_one_module_per_visible_device():
 
 
 def test_dtd_gemm_load_splits_across_devices():
-    """A DTD tiled GEMM's tasks spread over multiple device modules."""
+    """A DTD tiled GEMM's tasks spread over multiple device modules.
+    This pins the DEVICE-MANAGER plane (per-module load balancing), so
+    the pool must take the instrumented Python path — the native DTD
+    engine runs bodies inline on the worker and never touches the
+    modules (runtime.native_dtd docs)."""
     _skip_without_multichip()
+    from parsec_tpu.utils import mca_param
+    mca_param.set("runtime.native_dtd", 0)
+    try:
+        _dtd_gemm_load_split_body()
+    finally:
+        mca_param.unset("runtime.native_dtd")
+
+
+def _dtd_gemm_load_split_body():
     rng = np.random.default_rng(0)
     A_h = rng.standard_normal((256, 256)).astype(np.float32)
     B_h = rng.standard_normal((256, 256)).astype(np.float32)

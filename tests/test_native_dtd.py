@@ -1,0 +1,476 @@
+"""Native dynamic-task engine (ISSUE 10): the DTD insert→release hot
+loop behind the C ABI (`pdtd_*` in _native/core.cpp, driven by
+dsl/dtd_native.py). Covers: build/load in this container (tier-1, NOT
+skipped), engine engagement + the instrumented-fallback rule, dataflow
+semantics parity with the Python engine (chains, program-order reader
+snapshots, diamonds, aliases, value/scratch args, bitwise GEMM), the
+serving contracts on the new engine (admission park/reject, on_retire
+window drain, deadline/explicit cancel at select time, wfq fallback
+keeping pool_stats populated), poison-body abort, and the
+observability hookup (native counters in statusz + the metrics
+registry's tasks-completed total)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import parsec_tpu as parsec
+from parsec_tpu import _native, serving
+from parsec_tpu.core.taskpool import CancelledError
+from parsec_tpu.data import LocalCollection, TiledMatrix
+from parsec_tpu.dsl import dtd
+from parsec_tpu.dsl.dtd_native import register_native_body
+from parsec_tpu.serving.runtime import AdmissionRejected
+from parsec_tpu.utils import mca_param
+
+
+# ---------------------------------------------------------------------------
+# build hardening (tier-1: runs everywhere, no skip)
+# ---------------------------------------------------------------------------
+
+def test_native_library_builds_and_loads_in_this_container():
+    """The container bakes in g++; the native core must build and load
+    — a silent fallback here would invalidate every native-path rate
+    this repo reports."""
+    assert _native.available(), _native.build_error()
+    lib = _native.load()
+    for sym in ("pdtd_new", "pdtd_insert", "pdtd_arm", "pdtd_pump",
+                "pdtd_pump_batch", "pdtd_complete", "pdtd_complete_batch",
+                "pdtd_cancel", "pdtd_stats", "pgraph_consume"):
+        assert hasattr(lib, sym), sym
+
+
+def test_forced_native_without_toolchain_fails_loudly(monkeypatch):
+    """runtime.native_dtd=1 with no buildable library must raise with a
+    diagnosable message, not silently serve Python-engine rates."""
+    from parsec_tpu.dsl import dtd_native
+    monkeypatch.setattr(_native, "load", lambda: None)
+    monkeypatch.setattr(_native, "build_error", lambda: "g++ not found")
+    mca_param.set("runtime.native_dtd", 1)
+    try:
+        ctx = parsec.init(nb_cores=1)
+        tp = dtd.Taskpool("forced")
+        tp.context = ctx
+        with pytest.raises(RuntimeError, match="native_dtd=1.*g\\+\\+"):
+            dtd_native.engine_for(tp)
+        parsec.fini(ctx)
+    finally:
+        mca_param.unset("runtime.native_dtd")
+
+
+@pytest.fixture
+def nctx():
+    """A context whose DTD pools engage the native engine (default
+    scheduler, no observers)."""
+    if not _native.available():
+        pytest.skip("native core unavailable")
+    ctx = parsec.init(nb_cores=4)
+    ctx.start()
+    try:
+        yield ctx
+    finally:
+        parsec.fini(ctx)
+
+
+def _native_pool(ctx, name):
+    tp = dtd.Taskpool(name)
+    ctx.add_taskpool(tp)
+    return tp
+
+
+# ---------------------------------------------------------------------------
+# engagement + fallback rule
+# ---------------------------------------------------------------------------
+
+def test_engine_engages_by_default_and_knob_disables(nctx):
+    tp = _native_pool(nctx, "engage")
+    tp.insert_task(lambda: None)
+    assert tp._native is not None
+    tp.wait()
+    mca_param.set("runtime.native_dtd", 0)
+    try:
+        tp2 = _native_pool(nctx, "disengage")
+        tp2.insert_task(lambda: None)
+        assert tp2._native is None
+        tp2.wait()
+    finally:
+        mca_param.unset("runtime.native_dtd")
+
+
+@pytest.mark.parametrize("observer", ["pins", "stage_timers", "trace"])
+def test_instrumented_fallback_rule(observer):
+    """Any live per-task observer keeps the pool on the Python path,
+    even with runtime.native_dtd forced on."""
+    if not _native.available():
+        pytest.skip("native core unavailable")
+    mca_param.set("runtime.native_dtd", 1)
+    if observer == "pins":
+        mca_param.set("pins", "dfsan")
+    elif observer == "stage_timers":
+        mca_param.set("runtime.stage_timers", 1)
+    try:
+        ctx = parsec.init(nb_cores=2)
+        if observer == "trace":
+            from parsec_tpu.profiling.trace import Trace
+            Trace().install(ctx)
+        ctx.start()
+        tp = dtd.Taskpool(f"obs_{observer}")
+        ctx.add_taskpool(tp)
+        S = LocalCollection("S", {(0,): 0})
+        tp.insert_task(lambda x: x + 1, dtd.TileArg(S, (0,), dtd.INOUT))
+        assert tp._native is None
+        tp.wait()
+        assert S.data_of((0,)) == 1
+        parsec.fini(ctx)
+    finally:
+        mca_param.unset("runtime.native_dtd")
+        mca_param.unset("pins")
+        mca_param.unset("runtime.stage_timers")
+
+
+def test_wfq_scheduler_keeps_python_path_and_pool_stats():
+    """The serving-side arm of the fallback rule: under wfq the pool
+    stays on the instrumented Python path (weighted-fair arbitration
+    must see every task) and pool_stats is still populated — with
+    runtime.native_dtd forced ON."""
+    if not _native.available():
+        pytest.skip("native core unavailable")
+    mca_param.set("runtime.native_dtd", 1)
+    try:
+        ctx = parsec.init(nb_cores=2, scheduler="wfq")
+        rt = serving.enable(ctx)
+        ctx.start()
+        tp = dtd.Taskpool("wfq_pool")
+        sub = ctx.submit(tp, tenant="t1")
+        S = LocalCollection("S", {(0,): 0})
+        for _ in range(20):
+            tp.insert_task(lambda x: x + 1,
+                           dtd.TileArg(S, (0,), dtd.INOUT))
+        assert tp._native is None
+        tp.wait()
+        sub.wait()
+        stats = ctx.scheduler.pool_stats()
+        row = stats.get("wfq_pool")
+        assert row is not None and row["selected"] >= 20, stats
+        assert S.data_of((0,)) == 20
+        parsec.fini(ctx)
+    finally:
+        mca_param.unset("runtime.native_dtd")
+
+
+# ---------------------------------------------------------------------------
+# dataflow semantics parity
+# ---------------------------------------------------------------------------
+
+def test_chain_diamond_alias_value_scratch(nctx):
+    S = LocalCollection("s", {("x",): 5})
+    reads, dups = [], []
+    tp = _native_pool(nctx, "sem")
+    tp.insert_task(lambda x: x * 2, dtd.TileArg(S, ("x",), dtd.INOUT))
+    for _ in range(2):                      # diamond readers
+        tp.insert_task(lambda x: reads.append(x),
+                       dtd.TileArg(S, ("x",), dtd.INPUT))
+    tp.insert_task(lambda x: x + 7, dtd.TileArg(S, ("x",), dtd.INOUT))
+
+    def dup(a, b):                          # same tile twice: alias
+        dups.append((a, b))
+        return a + b
+    tp.insert_task(dup, dtd.TileArg(S, ("x",), dtd.INOUT),
+                   dtd.TileArg(S, ("x",), dtd.INPUT))
+
+    def vs(x, alpha, scratch):              # value + scratch args
+        assert scratch.shape == (4,)
+        return x * alpha
+    tp.insert_task(vs, dtd.TileArg(S, ("x",), dtd.INOUT),
+                   dtd.ValueArg(3.0), dtd.ScratchArg((4,)))
+    assert tp._native is not None
+    tp.wait()
+    # both readers observe writer-1's version (program order) — but
+    # they may EXECUTE after later writers (the functional-WAR
+    # guarantee), so only values are asserted, not interleaving
+    assert reads == [10, 10]
+    assert dups == [(17, 17)]
+    assert S.data_of(("x",)) == 34 * 3.0
+
+
+def test_flush_waits_for_native_writers(nctx):
+    S = LocalCollection("s", {("x",): 1})
+    tp = _native_pool(nctx, "flush")
+    gate = threading.Event()
+
+    def slow(x):
+        gate.wait(5.0)
+        return x + 1
+    tp.insert_task(slow, dtd.TileArg(S, ("x",), dtd.INOUT))
+    assert tp._native is not None
+    done = {}
+
+    def flusher():
+        tp.flush(S)
+        done["v"] = S.data_of(("x",))
+    th = threading.Thread(target=flusher)
+    th.start()
+    time.sleep(0.1)
+    assert "v" not in done          # flush parks on the in-flight writer
+    gate.set()
+    th.join(5.0)
+    assert done.get("v") == 2
+    tp.wait()
+
+
+def test_gemm_bitwise_identical_across_engines():
+    """Acceptance: the DTD GEMM result is BITWISE identical across the
+    Python and native engines (same bodies, same program-order
+    dataflow; fp32 accumulation order is per-tile in both)."""
+    if not _native.available():
+        pytest.skip("native core unavailable")
+    from parsec_tpu.algorithms.gemm import insert_gemm_dtd
+
+    def run(native):
+        # per-run seeded rng: both engines must see the SAME matrices
+        lrng = np.random.default_rng(7)
+        mca_param.set("runtime.native_dtd", native)
+        try:
+            ctx = parsec.init(nb_cores=4)
+            ctx.start()
+            A = TiledMatrix.from_array(
+                lrng.standard_normal((32, 32)).astype(np.float32), 16, 16,
+                name="A")
+            B = TiledMatrix.from_array(
+                lrng.standard_normal((32, 32)).astype(np.float32), 16, 16,
+                name="B")
+            C = TiledMatrix.from_array(np.zeros((32, 32), np.float32),
+                                       16, 16, name="C")
+            tp = dtd.Taskpool("gemm_ab")
+            ctx.add_taskpool(tp)
+            insert_gemm_dtd(tp, A, B, C)
+            assert (tp._native is not None) == bool(native)
+            tp.flush()
+            tp.wait()
+            out = np.asarray(C.to_array()).copy()
+            parsec.fini(ctx)
+            return out
+        finally:
+            mca_param.unset("runtime.native_dtd")
+
+    a = run(0)
+    b = run(1)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_stress_chains_and_fanout_native(nctx):
+    """Thousands of WAW-chained + independent tasks drain through the
+    native queues without a lost release (the no-lost-wakeup shape)."""
+    n, tiles = 4000, 32
+    C = LocalCollection("C", {(i,): 0 for i in range(tiles)})
+    tp = _native_pool(nctx, "stress")
+
+    def bump(x):
+        return x + 1
+    tp.insert_tasks(bump, [(dtd.TileArg(C, (i % tiles,), dtd.INOUT),)
+                           for i in range(n)])
+    assert tp._native is not None
+    tp.wait()
+    assert sum(C.data_of((i,)) for i in range(tiles)) == n
+
+
+# ---------------------------------------------------------------------------
+# serving contracts on the native engine
+# ---------------------------------------------------------------------------
+
+def test_serving_admission_and_retire_on_native_engine():
+    """Native serving smoke, part 1 (lfq = native-capable): the tenant
+    window admits/parks/drains through admission + on_retire with every
+    task on the native engine's Python-bodied path."""
+    if not _native.available():
+        pytest.skip("native core unavailable")
+    mca_param.set("serving.tenant_window", 64)
+    mca_param.set("serving.tenant_backpressure", 0.5)
+    try:
+        ctx = parsec.init(nb_cores=4)
+        rt = serving.enable(ctx)
+        ctx.start()
+        ten = rt.tenant("nat", window=64)
+        tp = dtd.Taskpool("nat_pool")
+        sub = ctx.submit(tp, tenant=ten)
+        # batches of 10 through the soft window (parks + drains via the
+        # native on_retire path). 10 is deterministic against the HARD
+        # window: admits only happen at inflight <= soft(32), so entry
+        # inflight never exceeds 42 and 42+10 < 64 — bigger batches can
+        # hard-reject when a loaded machine delays the retires
+        for _ in range(20):
+            tp.insert_tasks(lambda: None, [() for _ in range(10)])
+        assert tp._native is not None
+        tp.wait()
+        sub.wait()
+        assert ten.stats["rows_admitted"] == 200
+        assert ten.stats["rows_retired"] == 200, ten.stats
+        assert ten.inflight == 0
+        # hard-window rejection still fires on the native path
+        gate = threading.Event()
+        tp2 = dtd.Taskpool("nat_flood")
+        sub2 = ctx.submit(tp2, tenant=ten)
+        S = LocalCollection("fs", {(i,): 0 for i in range(64)})
+        tp2.insert_tasks(lambda x: gate.wait(10.0) or x,
+                         [(dtd.TileArg(S, (i,), dtd.INOUT),)
+                          for i in range(64)])
+        with pytest.raises(AdmissionRejected):
+            tp2.insert_tasks(lambda x: x,
+                             [(dtd.TileArg(S, (0,), dtd.INOUT),)
+                              for _ in range(64)])
+        gate.set()
+        tp2.wait()
+        sub2.wait()
+        parsec.fini(ctx)
+    finally:
+        mca_param.unset("serving.tenant_window")
+        mca_param.unset("serving.tenant_backpressure")
+
+
+def test_deadline_cancel_drops_native_queued_tasks():
+    """Native serving smoke, part 2: a deadline expiry cancels the pool
+    — queued native tasks are dropped at select time, the in-flight one
+    drains, and the submission reports the cancellation."""
+    if not _native.available():
+        pytest.skip("native core unavailable")
+    ctx = parsec.init(nb_cores=2)
+    rt = serving.enable(ctx)
+    ctx.start()
+    S = LocalCollection("dc", {("x",): 0})
+    gate = threading.Event()
+
+    def slow(x):
+        gate.wait(10.0)
+        return x + 1
+    tp = dtd.Taskpool("deadline")
+    sub = ctx.submit(tp, tenant="d", deadline_s=0.3)
+    tp.insert_tasks(slow, [(dtd.TileArg(S, ("x",), dtd.INOUT),)
+                           for _ in range(50)])
+    assert tp._native is not None
+    time.sleep(0.6)                 # reaper fires; head task still gated
+    gate.set()
+    with pytest.raises(CancelledError):
+        sub.wait(timeout=10.0)
+    tp2 = dtd.Taskpool("exp")       # explicit cancel path
+    sub2 = ctx.submit(tp2, tenant="d")
+    gate2 = threading.Event()
+    tp2.insert_tasks(lambda x: gate2.wait(10.0) or x,
+                     [(dtd.TileArg(S, ("x",), dtd.INOUT),)
+                      for _ in range(20)])
+    assert sub2.cancel()
+    gate2.set()
+    with pytest.raises(CancelledError):
+        sub2.wait(timeout=10.0)
+    # dropped tasks RELEASE their successors, so a cancelled CHAIN
+    # drains completely: both retiring engines must reach inflight 0
+    # and fold into the context totals (the workers keep pumping them)
+    deadline = time.monotonic() + 10.0
+    while ctx._ndtd_live and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not ctx._ndtd_live, \
+        [(e.inflight(), e.stats()) for e in ctx._ndtd_live]
+    st = ctx.native_dtd_stats()
+    assert st.get("dropped_cancelled", 0) > 0
+    assert st.get("inflight", 0) == 0
+    parsec.fini(ctx)
+
+
+def test_poison_body_aborts_and_releases_native_waiters():
+    """A raising body on the native engine aborts the pool: wait()
+    raises the error, a throttle-parked inserter is released, and the
+    engine drains via cancel instead of hanging."""
+    if not _native.available():
+        pytest.skip("native core unavailable")
+    mca_param.set("dtd.window_size", 16)
+    mca_param.set("dtd.threshold_size", 8)
+    try:
+        ctx = parsec.init(nb_cores=2)
+        ctx.start()
+        S = LocalCollection("p", {("x",): 0})
+        tp = dtd.Taskpool("poison")
+        ctx.add_taskpool(tp)
+        gate = threading.Event()
+
+        def poison(x):
+            gate.wait(10.0)
+            raise ValueError("native poison")
+        tp.insert_task(poison, dtd.TileArg(S, ("x",), dtd.INOUT))
+        assert tp._native is not None
+        for _ in range(14):
+            tp.insert_task(lambda x: x + 1,
+                           dtd.TileArg(S, ("x",), dtd.INOUT))
+        rel = {}
+
+        def inserter():
+            t0 = time.monotonic()
+            try:
+                tp.insert_tasks(lambda x: x + 1,
+                                [(dtd.TileArg(S, ("x",), dtd.INOUT),)
+                                 for _ in range(8)])
+                rel["outcome"] = "returned"
+            except RuntimeError as exc:
+                rel["outcome"] = "raised"
+                rel["msg"] = str(exc)
+            rel["dt"] = time.monotonic() - t0
+        th = threading.Thread(target=inserter)
+        th.start()
+        time.sleep(0.3)
+        assert "outcome" not in rel         # parked in the native window
+        gate.set()
+        th.join(5.0)
+        assert rel.get("outcome") == "raised", rel
+        assert "native poison" in rel.get("msg", "")
+        with pytest.raises(RuntimeError, match="native poison"):
+            tp.wait()
+        parsec.fini(ctx)
+    finally:
+        mca_param.unset("dtd.window_size")
+        mca_param.unset("dtd.threshold_size")
+
+
+# ---------------------------------------------------------------------------
+# observability hookup
+# ---------------------------------------------------------------------------
+
+@register_native_body
+def _noop():
+    return None
+
+
+def test_counters_statusz_and_completed_total(nctx):
+    from parsec_tpu.profiling import metrics as metrics_mod
+    tp = _native_pool(nctx, "obs")
+    tp.insert_tasks(_noop, [() for _ in range(300)])
+    tp.wait()
+    st = nctx.native_dtd_stats()
+    assert st["inserted"] == 300
+    assert st["completed_native"] == 300    # registered no-op body:
+    assert st["completed_python"] == 0      # null tasks skip Python
+    assert st["ready_pushed"] == 300
+    assert st["ring_highwater"] >= 300
+    sz = nctx.statusz()
+    assert sz["native_dtd"]["inserted"] == 300
+    if metrics_mod.enabled():
+        d = metrics_mod.registry().to_dict()
+        rows = d["parsec_tasks_completed_total"]["values"]
+        mine = [r["value"] for r in rows
+                if r["labels"]["rank"] == str(nctx.my_rank)]
+        assert mine and max(mine) >= 300
+        nrows = d["parsec_native_dtd"]["values"]
+        keys = {r["labels"]["key"] for r in nrows}
+        assert {"inserted", "completed_native", "stolen",
+                "ring_highwater"} <= keys
+
+
+def test_counters_survive_pool_termination(nctx):
+    """Folded totals: a finished pool's counters stay in the context
+    aggregate (parsec_tasks_completed_total must be monotonic)."""
+    for i in range(3):
+        tp = _native_pool(nctx, f"fold{i}")
+        tp.insert_tasks(_noop, [() for _ in range(100)])
+        tp.wait()
+    st = nctx.native_dtd_stats()
+    assert st["inserted"] == 300
+    assert st["completed_native"] == 300

@@ -185,12 +185,19 @@ def _null_body():
     return None
 
 
-@pytest.mark.parametrize("release_batch", [1, 0])
-def test_no_lost_wakeups_concurrent_complete(release_batch):
+@pytest.mark.parametrize("release_batch,native", [(1, 0), (0, 0), (1, 1)])
+def test_no_lost_wakeups_concurrent_complete(release_batch, native):
     """Chains (serial last-writer links) + wide fan-out draining through
     4 workers: every completion releases successors concurrently with
     further insertion. A lost wakeup or a dropped activation hangs
-    wait() / loses a chain increment."""
+    wait() / loses a chain increment. The native=1 arm drives the same
+    shape through the runtime.native_dtd engine (ISSUE 10): chain links
+    become native successor edges, the fan-out drains through the
+    per-worker plifo queues + steal."""
+    from parsec_tpu import _native
+    if native and not _native.available():
+        pytest.skip("native core unavailable")
+    mca_param.set("runtime.native_dtd", native)
     mca_param.set("runtime.release_batch", release_batch)
     try:
         ctx = parsec.init(nb_cores=4)
@@ -211,6 +218,8 @@ def test_no_lost_wakeups_concurrent_complete(release_batch):
                         device=DeviceType.CPU)
         tp.wait()
         assert all(S.data_of(("c", j)) == n_chain for j in range(4))
+        assert (tp._native is not None) == bool(native)
         parsec.fini(ctx)
     finally:
         mca_param.unset("runtime.release_batch")
+        mca_param.unset("runtime.native_dtd")

@@ -1,14 +1,21 @@
 """Null-task rate smoke (the taskrate bench's tier-1 guard): a gross
 per-task-overhead regression in the insert → schedule → select →
-dispatch → release path fails fast here, long before a chip capture.
+dispatch → release path fails fast here, long before a chip capture —
+parametrized across ``runtime.native_dtd`` so BOTH engines (the native
+C++ hot loop and the instrumented Python fallback) hold their floor.
 The floor is deliberately LENIENT (CI containers are slow and shared);
-the measured rate on this container is ~5-10k tasks/s."""
+the measured rate on this container is ~5-10k tasks/s Python and
+~500k+/s native."""
 
 import time
 
+import pytest
+
 import parsec_tpu as parsec
+from parsec_tpu import _native
 from parsec_tpu.core.task import DeviceType
 from parsec_tpu import dtd
+from parsec_tpu.dsl.dtd_native import register_native_body
 from parsec_tpu.profiling.pins_modules import new_module
 from parsec_tpu.utils import mca_param
 
@@ -20,26 +27,42 @@ FLOOR_TASKS_PER_SEC = 300
 N_TASKS = 1500
 
 
+@register_native_body
 def _null_body():
     return None
 
 
-def test_null_task_rate_floor():
-    ctx = parsec.init(nb_cores=4)
-    ctx.start()
-    tp = dtd.Taskpool("taskrate_smoke")
-    ctx.add_taskpool(tp)
-    t0 = time.perf_counter()
-    tasks = tp.insert_tasks(_null_body, [() for _ in range(N_TASKS)],
-                            device=DeviceType.CPU)
-    tp.wait()
-    dt = time.perf_counter() - t0
-    parsec.fini(ctx)
-    assert len(tasks) == N_TASKS and all(t is not None for t in tasks)
-    rate = N_TASKS / dt
-    assert rate > FLOOR_TASKS_PER_SEC, \
-        f"null-task rate {rate:.0f}/s under the {FLOOR_TASKS_PER_SEC}/s " \
-        f"floor — gross runtime-overhead regression"
+@pytest.mark.parametrize("native", [0, 1])
+def test_null_task_rate_floor(native):
+    if native and not _native.available():
+        pytest.skip("native core unavailable")
+    mca_param.set("runtime.native_dtd", native)
+    try:
+        ctx = parsec.init(nb_cores=4)
+        ctx.start()
+        tp = dtd.Taskpool("taskrate_smoke")
+        ctx.add_taskpool(tp)
+        t0 = time.perf_counter()
+        tasks = tp.insert_tasks(_null_body, [() for _ in range(N_TASKS)],
+                                device=DeviceType.CPU)
+        tp.wait()
+        dt = time.perf_counter() - t0
+        engaged = tp._native is not None
+        nstats = ctx.native_dtd_stats()
+        parsec.fini(ctx)
+        assert len(tasks) == N_TASKS and all(t is not None for t in tasks)
+        assert engaged == bool(native)
+        if native:
+            # the registered no-op body never enters Python
+            assert nstats["completed_native"] == N_TASKS
+            assert nstats["completed_python"] == 0
+        rate = N_TASKS / dt
+        assert rate > FLOOR_TASKS_PER_SEC, \
+            f"null-task rate {rate:.0f}/s under the " \
+            f"{FLOOR_TASKS_PER_SEC}/s floor — gross runtime-overhead " \
+            f"regression (engine={'native' if native else 'python'})"
+    finally:
+        mca_param.unset("runtime.native_dtd")
 
 
 def test_overhead_module_reports_stage_breakdown():
