@@ -1243,6 +1243,20 @@ def _section_recovery():
     return {"recovery": measure_recovery()}
 
 
+def _section_elastic():
+    """Elastic-capacity sawtooth (ISSUE 11): an open-loop decode load
+    ramps low -> high -> low while the autoscaler (serving.autoscale=
+    act) grows the serving mesh 2 -> 4 ranks and drains it back to 2
+    under live traffic — fresh ranks admitted beyond the original
+    world size, tenants rebalanced through the checkpoint vehicle.
+    Records per-phase offered-vs-completed rates (ramp tracking), the
+    p99 of tenant-migration routing pauses, bitwise verification of
+    every finished request + the migrated shards' digests, and that
+    scale-down never reported a drained rank as a failure."""
+    from parsec_tpu.serving.elastic_bench import measure_elastic
+    return {"elastic": measure_elastic()}
+
+
 def _section_serving():
     """Mixed-tenant serving bench (ISSUE 8): continuous-batching decode
     under an open-loop load from weighted tenants on a 2-rank mesh —
@@ -1269,6 +1283,7 @@ SECTIONS = {
     "recovery": _section_recovery,
     "compile_amortization": _section_compile_amortization,
     "serving": _section_serving,
+    "elastic": _section_elastic,
     "observability": _section_observability,
 }
 
@@ -1287,6 +1302,7 @@ _SECTION_KEYS = {
     "recovery": ("recovery",),
     "compile_amortization": ("compile_amortization",),
     "serving": ("serving",),
+    "elastic": ("elastic",),
     "observability": ("observability",),
 }
 
@@ -1358,6 +1374,10 @@ _GFLOPS_GUARD_KEYS = ("value", "gemm_panel_fused_gflops",
                       # serving sustained requests/s rides the same
                       # drop guard
                       "serving_requests_per_sec",
+                      # ISSUE 11: worst-phase ramp tracking (completed/
+                      # offered %) of the elastic sawtooth — a drop
+                      # means the autoscaler stopped keeping up
+                      "elastic_ramp_tracking_pct",
                       # null-task rate WITH the observability plane on
                       # — a drop means spans/metrics got expensive
                       "obs_tasks_per_sec")
@@ -1379,6 +1399,9 @@ _LATENCY_GUARD_KEYS = ("eager_1k_p50_us", "rdv_1M_p50_us",
                        # serving: the well-behaved tenants' p99 under a
                        # faulty mixed-tenant load must not creep up
                        "serving_p99_ms",
+                       # ISSUE 11: tenant-migration routing-pause p99 —
+                       # a rise means rescales got more disruptive
+                       "elastic_migration_pause_p99_ms",
                        # ISSUE 9 acceptance: the always-on registry +
                        # span path's A/B cost on the null-task rate —
                        # lower-is-better, so it rides the rise guard
@@ -1595,6 +1618,13 @@ def _compact_summary(result):
             "serving_shed": pick("serving", "shed_count"),
             "serving_quarantined": pick("serving", "quarantine_count"),
             "serving_isolation": pick("serving", "isolation_check"),
+            "elastic_ramp_tracking_pct": pick("elastic",
+                                              "ramp_tracking_pct"),
+            "elastic_migration_pause_p99_ms": pick(
+                "elastic", "migration_pause_p99_ms"),
+            "elastic_bitwise_ok": pick("elastic", "bitwise"),
+            "elastic_peak_world": pick("elastic", "peak_world"),
+            "elastic_drain_clean": pick("elastic", "drain_clean"),
             "obs_overhead_pct": pick("observability",
                                      "obs_overhead_pct"),
             "obs_tasks_per_sec": pick("observability",

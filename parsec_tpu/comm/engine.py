@@ -34,7 +34,10 @@ class AMTag(enum.IntEnum):
     #                       (data/recovery.exchange_completed)
     CLOCK = 11            # clock-offset pingpong (distributed-trace
     #                       timestamp alignment, profiling/spans.py)
-    FIRST_USER_TAG = 12
+    ELASTIC = 12          # elastic-capacity control plane: autoscaler
+    #                       heartbeats, drain/adopt/migrate commands and
+    #                       their acks (serving/elastic.py)
+    FIRST_USER_TAG = 13
 
 MAX_REGISTERED_TAGS = 32     # PARSEC_MAX_REGISTERED_TAGS (parsec_comm_engine.h:24)
 
@@ -364,6 +367,17 @@ class CommEngine:
         """False once ``rank`` is known dead (failure detection).
         Engines without failure detection report every peer alive."""
         return True
+
+    def world_status(self) -> Dict[str, Any]:
+        """Capacity view of the rank set (the ``statusz`` capacity
+        block and the elastic controller both read this): configured =
+        the world size this engine was built with, world = the current
+        (possibly grown) size, plus live / departed (orderly drain) /
+        dead (failure) partitions. Engines without failure detection or
+        elasticity report a full static mesh."""
+        return {"configured": self.nb_ranks, "world": self.nb_ranks,
+                "live": list(range(self.nb_ranks)), "departed": [],
+                "dead": []}
 
     def recover_exchange(self, token: str, payload: Any, dead_ranks,
                          timeout: float = 60.0) -> Dict[int, Any]:

@@ -17,6 +17,12 @@ Modes (``comm.fault_inject``):
   the peers' view) but the PROCESS SURVIVES, so a single test harness
   can still collect its state. Locally the engine runs the same
   peer-death sweep, aborting the victim's own taskpools.
+- ``slowjoin`` — adversarial timing on the ELASTIC scale-up path: the
+  victim's rejoin/wireup handshake stalls for
+  ``comm.fault_inject_delay_s`` seconds (seed-jittered to
+  ``[delay, 2*delay)``) before connecting out. A delay past
+  ``comm.rejoin_timeout`` makes the survivors abandon the joiner —
+  the autoscaler-wedge regression scenario.
 
 The trigger is ``comm.fault_inject_after`` counted units on
 ``comm.fault_inject_rank``.  ``comm.fault_inject_seed`` adds a
@@ -30,6 +36,7 @@ from __future__ import annotations
 import hashlib
 import os
 import threading
+import time
 from typing import Optional
 
 from ..utils import mca_param
@@ -38,8 +45,15 @@ from ..utils.debug import warning
 mca_param.register("comm.fault_inject", "off",
                    help="failure injection mode: off | drop (victim "
                         "goes silent but survives) | kill (victim "
-                        "hard-exits, the SIGKILL analog)",
-                   choices=("off", "drop", "kill"))
+                        "hard-exits, the SIGKILL analog) | slowjoin "
+                        "(the victim's rejoin/wireup handshake stalls "
+                        "by comm.fault_inject_delay_s, seed-jittered)",
+                   choices=("off", "drop", "kill", "slowjoin"))
+mca_param.register("comm.fault_inject_delay_s", 0.0,
+                   help="slowjoin mode: seconds the victim's "
+                        "rejoin/wireup handshake stalls before its "
+                        "first connect (seed-jittered to [d, 2d); "
+                        "0 = slowjoin disabled)")
 mca_param.register("comm.fault_inject_rank", -1,
                    help="victim rank of the injected failure (-1 = "
                         "disabled)")
@@ -65,16 +79,22 @@ class FaultInjector:
     threads (task units) or send paths (frame units)."""
 
     def __init__(self, rank: int, mode: str, after: int, unit: str,
-                 seed: int):
+                 seed: int, delay_s: float = 0.0):
         self.rank = rank
         self.mode = mode
         self.unit = unit
+        h = 0
         if seed:
             h = int.from_bytes(
                 hashlib.sha256(f"{seed}:{rank}".encode()).digest()[:4],
                 "big")
             after = after + (h % max(after, 1))
         self.trigger = after
+        # slowjoin: hash-derived bounded delay — deterministic per
+        # (seed, rank), stretched to [delay, 2*delay) like the trigger
+        self.join_delay_s = float(delay_s)
+        if seed and delay_s > 0:
+            self.join_delay_s = delay_s * (1.0 + (h % 1000) / 1000.0)
         self._count = 0
         self._fired = False
         self._lock = threading.Lock()
@@ -85,12 +105,19 @@ class FaultInjector:
         mode = str(mca_param.get("comm.fault_inject", "off")).lower()
         victim = int(mca_param.get("comm.fault_inject_rank", -1))
         after = int(mca_param.get("comm.fault_inject_after", 0))
-        if mode == "off" or victim != rank or after <= 0:
+        delay_s = float(mca_param.get("comm.fault_inject_delay_s", 0.0))
+        if mode == "off" or victim != rank:
+            return None
+        if mode == "slowjoin":
+            if delay_s <= 0:
+                return None
+        elif after <= 0:
             return None
         return cls(rank, mode,
                    after,
                    str(mca_param.get("comm.fault_inject_unit", "tasks")),
-                   int(mca_param.get("comm.fault_inject_seed", 0)))
+                   int(mca_param.get("comm.fault_inject_seed", 0)),
+                   delay_s=delay_s)
 
     def attach(self, engine) -> None:
         self._engine = engine
@@ -107,11 +134,35 @@ class FaultInjector:
             self._tick()
         return self._fired and self.mode == "drop"
 
+    def on_join_handshake(self) -> None:
+        """slowjoin tick point: called once by the joiner's
+        rejoin/wireup path BEFORE its first connect — the bounded stall
+        that makes the scale-up path testable under adversarial timing
+        (a delay past ``comm.rejoin_timeout`` means the survivors
+        abandon this joiner while its process is still alive)."""
+        if self.mode != "slowjoin" or self.join_delay_s <= 0:
+            return
+        with self._lock:
+            if self._fired:
+                return               # stall exactly once
+            self._fired = True
+        warning("faultinject",
+                "rank %d: slowjoin stalls the wireup handshake %.3fs",
+                self.rank, self.join_delay_s)
+        time.sleep(self.join_delay_s)
+
     @property
     def fired(self) -> bool:
         return self._fired
 
     def _tick(self) -> None:
+        if self.mode == "slowjoin":
+            # timing-only injection: the stall fires in
+            # on_join_handshake; task/frame ticks must never convert
+            # it into a drop/kill (trigger is 0 in this mode — a
+            # victim that never runs the rejoin wireup would
+            # otherwise go_silent on its first completed task)
+            return
         with self._lock:
             if self._fired:
                 return

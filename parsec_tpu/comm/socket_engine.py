@@ -77,6 +77,17 @@ mca_param.register("comm.rejoin_timeout", 60.0,
                    help="seconds wait_rejoin blocks for a replacement "
                         "rank before raising (the survivor-side "
                         "rendezvous bound before recovery replay)")
+mca_param.register("comm.elastic", 0,
+                   help="elastic mesh mode (serving autoscale): every "
+                        "rank keeps its wireup listener open for the "
+                        "life of the engine, FRESH ranks beyond the "
+                        "original world size are admitted (the peer "
+                        "table, termdet waves, barriers and recovery "
+                        "allgathers grow to the enlarged live set), and "
+                        "an orderly BYE (drain) removes a rank from the "
+                        "live set WITHOUT the failure path; 0 = the "
+                        "static mesh (rejoin still replaces dead ranks "
+                        "under comm.rejoin)")
 mca_param.register("comm.thread_multiple", 0,
                    help="MPI_THREAD_MULTIPLE analog (parsec_param_comm_"
                         "thread_multiple, remote_dep.h:166): worker "
@@ -113,10 +124,27 @@ class SocketCommEngine(CommEngine):
     """parsec_comm_engine_t implementation over localhost TCP."""
 
     def __init__(self, rank: int, nb_ranks: int, base_port: int = 27450,
-                 host: str = "127.0.0.1", rejoin: bool = False):
+                 host: str = "127.0.0.1", rejoin: bool = False,
+                 join_peers: Optional[List[int]] = None):
         super().__init__(rank, nb_ranks)
         self.host = host
         self.base_port = base_port
+        # elastic capacity: the world size this engine was BUILT with
+        # (the statusz "configured" row) — self.nb_ranks may grow as
+        # fresh ranks are admitted (comm.elastic); departed ranks left
+        # via an orderly drain (BYE), distinct from failures
+        self._nb_ranks0 = nb_ranks
+        self._departed: set = set()
+        # elastic join: the LIVE peer set a fresh/replacement rank
+        # wires up to (None = every other rank in range(nb_ranks) — the
+        # static-mesh rejoin default). On an elastic mesh some slots in
+        # that range may be drained-and-empty; connecting to them would
+        # wedge the joiner until the wireup deadline. CALLER ORDER is
+        # preserved: the controller puts itself first, so a joiner it
+        # has ABANDONED sticks in the controller's deny-retry loop and
+        # never partially joins the other peers (world-size divergence)
+        self._join_peers = ([int(p) for p in join_peers]
+                            if join_peers is not None else None)
         self._socks: Dict[int, socket.socket] = {}
         self._rxbuf: Dict[int, bytearray] = {}
         self._txbuf: Dict[int, bytearray] = {}   # guarded by _send_locks
@@ -169,6 +197,12 @@ class SocketCommEngine(CommEngine):
         self._rejoin_listener: Optional[socket.socket] = None
         self._rejoin_evts: Dict[int, threading.Event] = {}
         self._rejoin_lock = threading.Lock()
+        # ABANDONED joiner ids (wait_rejoin timed out and the caller
+        # gave up on the slot): a late arrival is denied instead of
+        # silently admitted into a mesh whose controller no longer
+        # routes to it — admitting it would inflate every barrier
+        # quorum with a rank that never participates
+        self._abandoned: set = set()
         self._recover_state: Dict[str, Dict] = {}
         self._recover_futs: Dict[str, object] = {}
         self._silenced = False
@@ -263,9 +297,15 @@ class SocketCommEngine(CommEngine):
         reopen on death detection — comm.rejoin); retried until the
         wireup deadline, since survivors open their listeners only once
         they detect the death."""
+        if self.fault is not None:
+            # slowjoin injection: the handshake stalls HERE, before the
+            # first connect — peers past comm.rejoin_timeout abandon us
+            self.fault.on_join_handshake()
         timeout = float(mca_param.get("comm.wireup_timeout_s", 30.0))
         deadline = time.monotonic() + timeout
-        for peer in range(self.nb_ranks):
+        peers = self._join_peers if self._join_peers is not None \
+            else range(self.nb_ranks)
+        for peer in peers:
             if peer == self.rank:
                 continue
             while True:
@@ -294,6 +334,16 @@ class SocketCommEngine(CommEngine):
                             f"out (is comm.rejoin enabled there?)")
                     time.sleep(0.05)
             self._register_peer(peer, s)
+        if self._join_peers is not None:
+            # in-range slots we were told NOT to join are drained-and-
+            # empty: record them departed so this rank's live set (and
+            # hence barrier quorums / termdet waves) agrees with the
+            # rest of the mesh; a later joiner reusing such a slot is
+            # admitted through the normal rejoin path
+            absent = set(range(self.nb_ranks)) \
+                - set(self._join_peers) - {self.rank}
+            self._dead_peers.update(absent)
+            self._departed.update(absent)
         debug_verbose(2, "comm", "rank %d: rejoined mesh (%d peers)",
                       self.rank, len(self._socks))
 
@@ -331,9 +381,18 @@ class SocketCommEngine(CommEngine):
         except OSError:
             pass
 
+    def _elastic_enabled(self) -> bool:
+        return str(mca_param.cached_get("comm.elastic", 0)).lower() \
+            not in ("0", "off", "false")
+
     def _accept_rejoin(self, lst: socket.socket) -> None:
-        """Admit a replacement rank (comm thread): it identifies itself
-        with its adopted rank id, which must currently be dead."""
+        """Admit a replacement or FRESH rank (comm thread): it
+        identifies itself with its rank id. A currently-dead (or
+        drained) id is a rejoin — the slot is adopted; under
+        ``comm.elastic`` an id at or beyond the current world size is a
+        GROW — the peer table, live set, and every collective quorum
+        extend to the enlarged world. A live id is denied."""
+        elastic = self._elastic_enabled()
         while True:
             try:
                 s, _addr = lst.accept()
@@ -347,7 +406,21 @@ class SocketCommEngine(CommEngine):
                         self.rank, exc)
                 s.close()
                 continue
-            if peer not in self._dead_peers:
+            if peer in self._abandoned:
+                # the controller gave up on this joiner (wait_rejoin
+                # timed out — e.g. a slowjoin stall): deny, so the
+                # late arrival cannot skew quorums; its own wireup
+                # deadline ends it
+                warning("comm", "rank %d: abandoned joiner rank %d "
+                        "denied", self.rank, peer)
+                try:
+                    s.sendall(b"\x00")
+                except OSError:
+                    pass
+                s.close()
+                continue
+            grow = elastic and peer >= self.nb_ranks
+            if not grow and peer not in self._dead_peers:
                 # deny explicitly (the replacement retries — e.g. we
                 # have not detected its predecessor's death yet)
                 warning("comm", "rank %d: rejoin for live rank %d "
@@ -367,37 +440,67 @@ class SocketCommEngine(CommEngine):
                 continue
             self._register_peer(peer, s)
             self._sel.register(s, selectors.EVENT_READ, peer)
+            if grow:
+                # fresh rank beyond the original world: _live_ranks,
+                # barrier quorums, termdet waves and the RECOVER
+                # allgather all range over nb_ranks — one assignment
+                # (comm thread, like every handler) grows them all
+                self.nb_ranks = max(self.nb_ranks, peer + 1)
             self._dead_peers.discard(peer)
             self._bye_peers.discard(peer)
+            self._departed.discard(peer)
+            # the quorum landscape changed (grow: new generation;
+            # rejoin: live set restored) — pre-admit generations whose
+            # entrants are all in must release now, not at timeout
+            self._maybe_release_barrier()
             if not self._dead_peers:
-                # mesh whole again: new taskpools may launch
+                # mesh whole again: new taskpools may launch. Elastic
+                # meshes keep the listener open for the next joiner.
                 self._peer_failure = None
-                self._close_rejoin_listener()
+                if not elastic:
+                    self._close_rejoin_listener()
             with self._rejoin_lock:
                 evt = self._rejoin_evts.setdefault(peer,
                                                    threading.Event())
             evt.set()
-            warning("comm", "rank %d: rank %d rejoined the mesh",
-                    self.rank, peer)
+            warning("comm", "rank %d: rank %d %s the mesh (world %d)",
+                    self.rank, peer, "grew" if grow else "rejoined",
+                    self.nb_ranks)
 
     def wait_rejoin(self, rank: int,
                     timeout: Optional[float] = None) -> bool:
-        """Block until a replacement for dead ``rank`` has been
-        admitted (survivor-side rendezvous before planning replay).
+        """Block until a replacement for dead ``rank`` — or, on an
+        elastic mesh, a FRESH joiner adopting that id — has been
+        admitted (the survivor/autoscaler-side rendezvous).
         ``timeout`` defaults to the ``comm.rejoin_timeout`` MCA knob;
         expiry raises a :class:`TimeoutError` naming the knob so a
-        too-slow respawner is diagnosable instead of a bare False
-        propagating into a confusing replay failure."""
+        too-slow (or slowjoin-stalled) joiner is ABANDONED with a
+        diagnosable error instead of a bare False propagating into a
+        confusing replay failure or a wedged autoscaler loop."""
         if timeout is None:
             timeout = float(mca_param.get("comm.rejoin_timeout", 60.0))
         with self._rejoin_lock:
             evt = self._rejoin_evts.setdefault(rank, threading.Event())
         if not evt.wait(timeout):
             raise TimeoutError(
-                f"rank {self.rank}: no replacement for dead rank {rank} "
-                f"within {timeout:.1f}s — raise the comm.rejoin_timeout "
-                "MCA knob if the respawner needs longer")
+                f"rank {self.rank}: no replacement/joiner for rank "
+                f"{rank} within {timeout:.1f}s — raise the "
+                "comm.rejoin_timeout MCA knob if the respawner needs "
+                "longer")
         return True
+
+    def abandon_join(self, rank: int) -> None:
+        """Give up on an expected joiner (after a wait_rejoin timeout):
+        a late arrival under this id is DENIED at the handshake. The
+        id can be re-armed with :meth:`allow_join` before a fresh
+        spawn reuses it. Set-membership writes are GIL-atomic; the
+        accept path reads on the comm thread."""
+        self._abandoned.add(int(rank))
+
+    def allow_join(self, rank: int) -> None:
+        """Re-arm a previously-abandoned joiner id (the controller is
+        about to spawn a fresh process for it)."""
+        self._abandoned.discard(int(rank))
 
     def acknowledge_failure(self) -> None:
         self._peer_failure = None
@@ -452,6 +555,12 @@ class SocketCommEngine(CommEngine):
                                  name=f"parsec-comm-{self.rank}", daemon=True)
             self._thread = t
             t.start()
+            if self._elastic_enabled():
+                # elastic mesh: the wireup listener stays open for the
+                # life of the engine so fresh ranks can join at any
+                # time (opened ON the comm thread — listener + selector
+                # state are comm-thread-only by construction)
+                self._post_cmd(("listen",))
 
     def disable(self) -> None:
         super().disable()
@@ -555,6 +664,8 @@ class SocketCommEngine(CommEngine):
                     self._deliver_activation(tp, src, msg)
             elif kind == "peer_dead":  # ("peer_dead", peer, why) — posted
                 self._mark_peer_dead(cmd[1], cmd[2])  # by worker threads
+            elif kind == "listen":     # elastic: (re)open the wireup
+                self._open_rejoin_listener()          # listener
             elif kind == "go_silent":  # drop-mode fault injection: the
                 # victim "crashes" from the peers' view — every peer
                 # socket torn down, no BYE, local pools aborted through
@@ -927,6 +1038,12 @@ class SocketCommEngine(CommEngine):
         if peer in self._dead_peers or peer == self.rank:
             return
         self._dead_peers.add(peer)
+        with self._rejoin_lock:
+            # this slot may be re-admitted later (rejoin or elastic
+            # slot reuse): a stale SET event from a previous admission
+            # would make the next wait_rejoin return before the new
+            # joiner actually connected
+            self._rejoin_evts.pop(peer, None)
         s = self._socks.get(peer)
         if s is not None:
             try:
@@ -944,11 +1061,16 @@ class SocketCommEngine(CommEngine):
         if peer in self._bye_peers:
             # the peer announced orderly shutdown: a send failing
             # against its closing socket (EPIPE on a late termdet ack)
-            # is teardown, not death — no job-kill. But anything still
-            # IN FLIGHT toward that peer can never complete and must
-            # fail promptly (not time out): sweep it with an orderly-
-            # shutdown diagnostic and abort only the taskpools those
-            # entries belong to (barriers stay untouched — see below).
+            # is teardown, not death — no job-kill. On an elastic mesh
+            # this IS the scale-down drain: the rank leaves the live
+            # set but is recorded DEPARTED, never a failure
+            # (_peer_failure stays None, no taskpool abort sweep, no
+            # quarantine downstream). Anything still IN FLIGHT toward
+            # that peer can never complete and must fail promptly (not
+            # time out): sweep it with an orderly-shutdown diagnostic
+            # and abort only the taskpools those entries belong to
+            # (barriers stay untouched — see below).
+            self._departed.add(peer)
             exc = ConnectionError(
                 f"rank {self.rank}: peer rank {peer} shut down with "
                 f"requests in flight ({why})")
@@ -962,11 +1084,26 @@ class SocketCommEngine(CommEngine):
             else:
                 debug_verbose(2, "comm", "rank %d: post-BYE teardown "
                               "for peer %d (%s)", self.rank, peer, why)
-            # barriers are NOT failed here: whether a departed peer
-            # strands one is not locally decidable (an already-entered
-            # peer doesn't — rank 0 still releases). A peer that BYEs
-            # without entering a barrier others wait in is a collective-
-            # ordering bug; the 60 s barrier timeout names that case.
+            # in-flight termdet waves this rank coordinates can never
+            # hear from the departed peer — shrink them to the live set
+            # (same fail-safe as the death path: a partial wave can
+            # only FAIL to terminate, never falsely terminate)
+            for name, ws in list(self._waves.items()):
+                if peer in ws.live and peer not in ws.replied:
+                    ws.live.discard(peer)
+                    ws.pending -= 1
+                    if ws.pending == 0:
+                        self._finish_wave(name, ws)
+            # the live quorum shrank: a barrier of the NEW generation
+            # may already be complete (entrants that processed this
+            # departure first) — re-check
+            self._maybe_release_barrier()
+            # barriers of the OLD generation are NOT failed here:
+            # whether a departed peer strands one is not locally
+            # decidable (an already-entered peer doesn't — rank 0
+            # still releases). A peer that BYEs without entering a
+            # barrier others wait in is a collective-ordering bug; the
+            # 60 s barrier timeout names that case.
             return
         exc = ConnectionError(
             f"rank {self.rank}: peer rank {peer} died ({why})")
@@ -1820,7 +1957,7 @@ class SocketCommEngine(CommEngine):
             if self._peer_failure is not None:
                 # a dead peer can never enter the barrier — fail fast
                 raise ConnectionError(str(self._peer_failure))
-            self._barrier_gen = len(self._dead_peers)
+            self._barrier_gen = self._barrier_generation()
             self.send_am(AMTag.BARRIER, self._td_coordinator(),
                          {"op": "enter", "gen": self._barrier_gen})
             released = self._barrier_release.wait(timeout=60.0)
@@ -1845,16 +1982,42 @@ class SocketCommEngine(CommEngine):
         elif msg.get("gen", 0) == self._barrier_gen:
             self._barrier_release.set()
 
+    def _barrier_generation(self):
+        """Barrier/quorum generation: (deaths+departures, world size).
+        A death, a drain, AND an elastic grow each change the live
+        quorum — entries from before any of them stay quarantined in
+        their own generation and can never release a post-rescale
+        barrier early (or vice versa)."""
+        return (len(self._dead_peers), self.nb_ranks)
+
     def _maybe_release_barrier(self) -> None:
-        """Release the current-generation barrier when its live quorum
-        is in (comm thread; also re-checked when a death advances the
-        generation this rank would collect for)."""
-        g = len(self._dead_peers)
-        if self._barrier_counts.get(g, 0) >= len(self._live_ranks()):
-            self._barrier_counts[g] = 0
-            for r in self._live_ranks():
-                self.send_am(AMTag.BARRIER, r,
-                             {"op": "release", "gen": g})
+        """Release ANY generation whose quorum is in (comm thread;
+        re-checked when a death/departure/grow changes the live set).
+        A generation ``(deaths, world)`` had live quorum
+        ``world − deaths`` when it was current — checking every
+        bucket against its OWN quorum releases a barrier whose
+        entrants ALL entered before a grow was admitted (the common
+        overlap: admission is a point event, barriers entered just
+        before it would otherwise stall against the post-grow quorum
+        until the 60 s timeout). KNOWN LIMIT: entrants split ACROSS
+        the admission instant land in different buckets ((d, w) vs
+        (d, w+1)) and neither reaches quorum — that barrier times out
+        loudly and the caller retries; merging buckets here would risk
+        a false early release against stale abandoned entries. The
+        elastic controller therefore serializes rescales against its
+        own collective ops. Releases are generation-tagged, so a
+        stale bucket firing can never wake a waiter of a different
+        generation."""
+        for g, cnt in list(self._barrier_counts.items()):
+            if not cnt:
+                continue
+            quorum = max(1, g[1] - g[0]) if isinstance(g, tuple) \
+                else len(self._live_ranks())
+            if cnt >= quorum:
+                self._barrier_counts[g] = 0
+                for r in self._live_ranks():
+                    self.send_am(AMTag.BARRIER, r,
+                                 {"op": "release", "gen": g})
 
     def peer_alive(self, rank: int) -> bool:
         return rank not in self._dead_peers
@@ -1985,6 +2148,21 @@ class SocketCommEngine(CommEngine):
         for r in sorted(want):
             self.send_am(AMTag.RECOVER, r,
                          {"op": "result", "token": token, "data": data})
+
+    def world_status(self) -> Dict[str, Any]:
+        """Capacity view of the rank set (statusz + elastic
+        controller): configured = the world size this engine was BUILT
+        with, world = the current (possibly grown) size; departed =
+        orderly drains (scale-down / BYE), dead = failures. Reads are
+        GIL-snapshot views of comm-thread state — consistent enough
+        for an operator surface."""
+        departed = set(self._departed)
+        dead = set(self._dead_peers) - departed
+        return {"configured": self._nb_ranks0,
+                "world": self.nb_ranks,
+                "live": self._live_ranks(),
+                "departed": sorted(departed),
+                "dead": sorted(dead)}
 
     def wire_stats(self) -> Dict[str, int]:
         """Frame-level wire counters (header+payload bytes on the socket);

@@ -127,7 +127,6 @@ class Context:
         self.nb_cores = nb_cores
         self.comm = comm            # comm engine (None = single process)
         self.my_rank = comm.rank if comm is not None else 0
-        self.nb_ranks = comm.nb_ranks if comm is not None else 1
 
         vp_ids = _parse_vpmap(nb_cores)
         self.streams = [ExecutionStream(self, i, vp_ids[i])
@@ -238,6 +237,14 @@ class Context:
         debug_verbose(3, "context",
                       "context up: %d streams, sched=%s",
                       nb_cores, self.scheduler.name)
+
+    @property
+    def nb_ranks(self) -> int:
+        """The CURRENT world size — read through to the comm engine
+        (elastic meshes grow/shrink it live; a snapshot taken at
+        context construction would route new cross-rank taskpools and
+        collections against a stale world)."""
+        return self.comm.nb_ranks if self.comm is not None else 1
 
     # ------------------------------------------------------------------ API
     def add_taskpool(self, tp: Taskpool) -> None:
@@ -483,11 +490,50 @@ class Context:
         }
         if self.serving is not None:
             out["serving"] = self.serving.report()
+        out["capacity"] = self._capacity_block()
         if self.trace is not None:
             out["trace_dropped"] = self.trace.dropped()
         nstats = self.native_dtd_stats()
         if nstats:
             out["native_dtd"] = nstats
+        return out
+
+    def _capacity_block(self) -> Dict:
+        """The statusz ``capacity`` block: configured vs live world
+        size, a per-rank role map (self/joined/draining/departed/dead),
+        and — when an elastic controller is attached — the autoscaler's
+        desired count, last decision, and remaining cooldown. The
+        operator's view of elasticity state without running the bench."""
+        comm = self.comm
+        if comm is not None and hasattr(comm, "world_status"):
+            ws = comm.world_status()
+        else:
+            ws = {"configured": self.nb_ranks, "world": self.nb_ranks,
+                  "live": list(range(self.nb_ranks)), "departed": [],
+                  "dead": []}
+        departed = set(ws.get("departed") or ())
+        dead = set(ws.get("dead") or ())
+        el = getattr(self.serving, "elastic", None) \
+            if self.serving is not None else None
+        draining = set(el.draining_ranks()) if el is not None else set()
+        roles = {}
+        for r in range(int(ws.get("world", self.nb_ranks))):
+            if r == self.my_rank:
+                roles[r] = "self"
+            elif r in dead:
+                roles[r] = "dead"
+            elif r in departed:
+                roles[r] = "departed"
+            elif r in draining:
+                roles[r] = "draining"
+            else:
+                roles[r] = "joined"
+        out = {"configured_world": ws.get("configured"),
+               "world": ws.get("world"),
+               "live_world": len(ws.get("live") or ()),
+               "roles": roles}
+        if el is not None:
+            out["autoscaler"] = el.status()
         return out
 
     def metrics_text(self) -> str:

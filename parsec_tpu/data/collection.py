@@ -53,12 +53,21 @@ class DataCollection:
 
 class LocalCollection(DataCollection):
     """Dict-backed single-rank collection — the simplest data_of/write
-    storage, used by tests and as DTD scratch space."""
+    storage, used by tests and as DTD scratch space. ``myrank`` is the
+    OWNING rank: in a multi-rank context, tasks whose placement derives
+    from a local collection (serving decode pools on a worker rank of
+    an elastic mesh) must land on the rank that holds the tiles — the
+    old hardwired ``rank_of == 0`` silently shipped every such task to
+    rank 0."""
 
-    def __init__(self, name: str = "local", init: Optional[Dict] = None):
-        super().__init__(name=name)
+    def __init__(self, name: str = "local", init: Optional[Dict] = None,
+                 myrank: int = 0):
+        super().__init__(name=name, myrank=myrank)
         self._store: Dict[Any, Any] = dict(init or {})
         self._lock = threading.Lock()
+
+    def rank_of(self, key) -> int:
+        return self.myrank
 
     def data_of(self, key) -> Any:
         with self._lock:

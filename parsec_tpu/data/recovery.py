@@ -615,6 +615,22 @@ def remap_collection_ranks(dc, remap: Dict[int, int]):
     return dc
 
 
+def clear_remap(dc):
+    """Undo every :func:`remap_collection_ranks` layer on ``dc``,
+    restoring the original ``rank_of``. The elastic grow path uses this
+    when a previously-drained rank's slot is re-admitted and the
+    collection's natural placement becomes valid again (a grow→shrink
+    cycle composing remaps forever would otherwise pin every tile on
+    the first adopter). No-op for collections never remapped."""
+    orig = getattr(dc, "_pre_remap_rank_of", None)
+    if orig is None:
+        return dc
+    dc.rank_of = orig
+    del dc._pre_remap_rank_of
+    dc._rank_remap = {}
+    return dc
+
+
 def _pre_remap_rank(dc, key) -> int:
     """The owner a tile had BEFORE any shrink remap — lost-tile identity
     is defined by the ORIGINAL distribution."""

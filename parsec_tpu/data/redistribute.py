@@ -74,6 +74,25 @@ def build_redistribute_ptg(src: TiledMatrix, dst: TiledMatrix,
     return tp
 
 
+def build_rebalance(src: TiledMatrix, new_dist, my_rank: int = 0,
+                    name: str = "rebalance"):
+    """Elastic-capacity rebalance of a DISTRIBUTED collection onto a
+    changed rank set (ISSUE 11 scale-up): build the destination matrix
+    with the same tile geometry under ``new_dist`` (e.g. a block-cyclic
+    map over the ENLARGED live set) and the PTG redistribute taskpool
+    that moves every tile to its new owner — each tile crosses ranks
+    exactly once, as task-sourced dependencies the comm layer delivers
+    over the grown mesh. Every rank must build and register the SAME
+    pool (same ``name``); after ``ctx.wait()`` the returned ``dst`` is
+    the rebalanced collection. (Rank-local tenant shards migrate
+    through the checkpoint vehicle instead — ``serving/elastic.py``.)
+
+    Returns ``(taskpool, dst)``."""
+    dst = TiledMatrix(src.m, src.n, src.mb, src.nb, dist=new_dist,
+                      myrank=my_rank, name=f"{src.name}@rebal")
+    return build_redistribute_ptg(src, dst, name=name), dst
+
+
 def _overlaps(lo: int, hi: int, tile: int):
     """Tile indices whose [idx*tile, (idx+1)*tile) intersects [lo, hi)."""
     return range(lo // tile, (hi - 1) // tile + 1)

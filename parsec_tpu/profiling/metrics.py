@@ -240,6 +240,33 @@ class MetricsRegistry:
         return self._family(name, help_, "histogram", labelnames,
                             _Histogram)
 
+    def prune_ranks(self, gone_ranks, label: str = "rank") -> int:
+        """Unexport every child whose ``label`` value names a rank in
+        ``gone_ranks`` — the elastic-capacity pruning pass: when the
+        live set shrinks (a rank drained or died), its rank-labeled
+        children (wire counters of a loopback fabric, per-rank capacity
+        gauges, pool/tenant rows of a departed rank) must not linger in
+        ``/metrics`` forever. Caller-held references keep working
+        (``_Family.remove`` semantics). Returns the number of children
+        pruned; a rank re-admitted later simply re-creates its children
+        on the next record/scrape."""
+        gone = {str(int(r)) for r in gone_ranks}
+        if not gone:
+            return 0
+        n = 0
+        with self._lock:
+            families = list(self._families.values())
+        for fam in families:
+            if label not in fam.labelnames:
+                continue
+            idx = fam.labelnames.index(label)
+            for labels, _child in fam.samples():
+                key = tuple(labels[name] for name in fam.labelnames)
+                if key[idx] in gone:
+                    fam.remove_key(key)
+                    n += 1
+        return n
+
     def register_collector(self, fn: Callable[[], None]) -> None:
         """``fn`` runs at every scrape and sets gauges from live state."""
         with self._lock:
@@ -398,6 +425,14 @@ def install_context_collectors(context) -> Callable[[], None]:
     g_cc = reg.gauge("parsec_compile_cache",
                      "compile-cache hit/miss counters "
                      "(utils.compile_cache.cache_stats)", ("key",))
+    g_cap = reg.gauge("parsec_capacity",
+                      "elastic-capacity state (configured/world/live/"
+                      "departed/dead rank counts from the comm "
+                      "engine's world_status, plus the autoscaler's "
+                      "desired count when a controller is attached)",
+                      ("rank", "key"))
+
+    pruned_ranks: set = set()         # gone ranks already swept
 
     def _prune() -> None:
         for fam, keys in owned.items():
@@ -468,6 +503,33 @@ def install_context_collectors(context) -> Callable[[], None]:
                 setg(g_cc, v, key=k)
         except Exception:  # noqa: BLE001 — optional surface
             pass
+        comm = ctx.comm
+        if comm is not None and hasattr(comm, "world_status"):
+            ws = comm.world_status()
+            for k in ("configured", "world"):
+                setg(g_cap, ws.get(k, 0), rank=rank, key=k)
+            for k in ("live", "departed", "dead"):
+                setg(g_cap, len(ws.get(k) or ()), rank=rank, key=k)
+            el = getattr(srv, "elastic", None) if srv is not None \
+                else None
+            if el is not None:
+                setg(g_cap, el.desired, rank=rank, key="desired")
+            # elastic-capacity pruning (the live set shrank): children
+            # labeled with a drained/dead rank — wire counters of an
+            # in-process loopback fabric, stale pool/tenant rows, a
+            # departed rank's capacity gauges — must not linger in
+            # /metrics forever. Own-rank children are never pruned,
+            # and each gone rank is swept ONCE (the scrape after the
+            # shrink), not re-scanned on every later scrape of a
+            # long-lived context; a re-admitted rank drops out of the
+            # swept set so a later departure prunes it again.
+            gone = (set(ws.get("departed") or ()) |
+                    set(ws.get("dead") or ())) - {ctx.my_rank}
+            pruned_ranks.intersection_update(gone)
+            fresh = gone - pruned_ranks
+            if fresh:
+                reg.prune_ranks(fresh)
+                pruned_ranks.update(fresh)
         # prune children for pools/tenants that disappeared since the
         # last scrape — the per-request pool gauges would otherwise
         # accumulate one frozen child-set per finished submission

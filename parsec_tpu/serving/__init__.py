@@ -19,6 +19,10 @@ by ``bench.py --section serving`` via :mod:`.serving_bench`.
 
 from .runtime import (AdmissionRejected, DeadlineExceeded, ServingRuntime,
                       Submission, Tenant, TenantQuarantined, enable)
+from .elastic import (AutoscalePolicy, ElasticController, ElasticWorker,
+                      Signals)
 
 __all__ = ["AdmissionRejected", "DeadlineExceeded", "ServingRuntime",
-           "Submission", "Tenant", "TenantQuarantined", "enable"]
+           "Submission", "Tenant", "TenantQuarantined", "enable",
+           "AutoscalePolicy", "ElasticController", "ElasticWorker",
+           "Signals"]

@@ -253,8 +253,8 @@ class KVCacheCollection(LocalCollection):
     first and :meth:`drop_request` releases a finished request's tiles
     outright. Host (numpy) tiles pass through untracked."""
 
-    def __init__(self, name: str, hbm=None):
-        super().__init__(name)
+    def __init__(self, name: str, hbm=None, myrank: int = 0):
+        super().__init__(name, myrank=myrank)
         self.hbm = hbm
         self._clock = 0
 
@@ -324,8 +324,13 @@ class DecodeEngine:
         self.model = model or DecodeModel(self.cfg)
         self.tenant = tenant
         self.submit_kwargs = submit_kwargs
-        self.state = LocalCollection(f"{name}_state")
-        self.kv = KVCacheCollection(f"{name}_kv", hbm=ctx.hbm)
+        # collections OWNED by this context's rank: a decode engine on
+        # a worker rank of an elastic mesh must place its steps locally
+        # (rank_of = 0 would ship every task to the front-end rank)
+        self.state = LocalCollection(f"{name}_state",
+                                     myrank=ctx.my_rank)
+        self.kv = KVCacheCollection(f"{name}_kv", hbm=ctx.hbm,
+                                    myrank=ctx.my_rank)
         self.tp = None
         self.submission = None
         self.pending: Dict[int, PendingRequest] = {}

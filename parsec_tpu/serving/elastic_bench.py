@@ -1,0 +1,568 @@
+"""Elastic-capacity sawtooth benchmark (``bench.py --section elastic``).
+
+The robustness proof of ISSUE 11: an open-loop decode load ramps
+low → high → low while the autoscaler (``serving/elastic.py``, mode
+``act``) grows the serving mesh from 2 ranks toward 4 and drains it
+back to 2 — all under live traffic.
+
+Topology: rank 0 is the front end (router + ElasticController); the
+serving ranks run one :class:`~parsec_tpu.serving.decode.DecodeEngine`
+per hosted tenant behind an :class:`~parsec_tpu.serving.elastic.
+ElasticWorker` agent. Requests route over ``AMTag.ELASTIC`` to the
+tenant's current owner; each completion returns the decode state
+vector, verified BITWISE against the float32 reference replay after
+the load ends. Per-request service time is modeled explicitly
+(``work_ms`` on the worker's request thread) so per-rank capacity is a
+deliberate parameter, not an accident of host speed — the decode
+payload itself stays the real kernel for the bitwise contract.
+
+Tenants also carry a persistent 4-tile profile shard that MIGRATES
+through the checkpoint vehicle on every rebalance; a sha256 digest at
+the end proves zero bitwise divergence of persistent state across all
+rescales.
+
+Reported: per-phase offered vs completed rates (the ramp-tracking
+evidence), ``ramp_tracking_pct`` (the worst phase's completed/offered
+percentage), ``migration_pause_p99_ms`` (p99 of the routing-pause
+windows around tenant migrations), ``bitwise`` over every finished
+request + the shard digests, the world-size timeline, and
+``drain_clean`` (no drained rank ever reported as a failure)."""
+
+from __future__ import annotations
+
+import hashlib
+import multiprocessing as mp
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..comm.pingpong import _free_port_base
+from ..utils.stats import pctl as _pctl
+
+_TENANTS = ("t0", "t1", "t2", "t3")
+_DECODE_STEPS = 8
+_SHARD_TILES = 4
+
+
+def _shard_tiles(tenant: str) -> Dict:
+    """Deterministic tenant-profile shard (the migrated persistent
+    state): 4 tiles of 64 float32s derived from the tenant name."""
+    seed = int.from_bytes(hashlib.sha256(
+        tenant.encode()).digest()[:4], "big")
+    rng = np.random.default_rng(seed)
+    return {(i,): rng.standard_normal(64).astype(np.float32)
+            for i in range(_SHARD_TILES)}
+
+
+def _shard_digest(tiles: Dict) -> str:
+    h = hashlib.sha256()
+    for k in sorted(tiles):
+        h.update(np.ascontiguousarray(tiles[k]).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# worker rank
+# ---------------------------------------------------------------------------
+
+def _worker_main(rank: int, world: int, base_port: int, ckpt_dir: str,
+                 work_ms: float, q, live=None) -> None:
+    """One serving rank: DecodeEngine per hosted tenant, shards
+    migrated through the checkpoint vehicle, completions pushed back
+    to the front end with the decode state for bitwise verification."""
+    import traceback
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from ..comm.socket_engine import SocketCommEngine
+        from ..core import context as ctx_mod
+        from ..data.checkpoint import CheckpointManager
+        from ..data.collection import LocalCollection
+        from ..serving.decode import DecodeConfig, DecodeEngine
+        from ..serving.elastic import ElasticWorker
+        from ..utils import mca_param
+
+        mca_param.set("comm.elastic", 1)
+        mca_param.set("runtime.stage_reads", "0")
+        mca_param.set("comm.stage_recv", "0")
+        mca_param.set("device.tpu.enabled", False)
+        # a joiner into a LIVE mesh (live peer list provided — incl. a
+        # reused drained slot like rank 1) takes the rejoin wireup; only
+        # the original mesh members do the static full-mesh wireup
+        engine = SocketCommEngine(rank, world, base_port=base_port,
+                                  rejoin=(live is not None),
+                                  join_peers=live)
+        ctx = ctx_mod.init(nb_cores=2, comm=engine)
+        ctx.start()
+        mgr = CheckpointManager(ckpt_dir, my_rank=rank, nb_ranks=1)
+        cfg = DecodeConfig()
+        engines: Dict[str, DecodeEngine] = {}
+        shards: Dict[str, LocalCollection] = {}
+        inflight: List = []      # (PendingRequest, rid, tenant, src)
+        lock = threading.Lock()
+        processing: Dict[str, int] = {}
+
+        def on_adopt(tenant: str, step) -> None:
+            dc = LocalCollection(f"{tenant}_shard")
+            if step is None:
+                for k, v in _shard_tiles(tenant).items():
+                    dc.write_tile(k, v)
+            else:
+                mgr.restore(step, {tenant: dc})
+            shards[tenant] = dc
+            eng = DecodeEngine(ctx, f"{tenant}_r{rank}s{step or 0}",
+                               cfg=cfg, tenant=tenant)
+            eng.start()
+            engines[tenant] = eng
+
+        def on_drop(tenant: str, step):
+            # quiesce: wait for this tenant's in-flight decodes (the
+            # router paused new traffic before asking)
+            deadline = time.monotonic() + 20.0
+            while time.monotonic() < deadline:
+                with lock:
+                    busy = processing.get(tenant, 0) or any(
+                        t == tenant for (_r, _i, t, _s) in inflight)
+                if not busy:
+                    break
+                time.sleep(0.01)
+            eng = engines.pop(tenant, None)
+            if eng is not None:
+                eng.close()
+            dc = shards.pop(tenant)
+            mgr.save(step, {tenant: dc})     # the checkpoint-cut vehicle
+            return step
+
+        def on_request(src: int, msg: Dict) -> None:
+            tenant = msg["tenant"]
+            with lock:
+                processing[tenant] = processing.get(tenant, 0) + 1
+            try:
+                if work_ms > 0:
+                    time.sleep(work_ms / 1e3)   # modeled service time
+                eng = engines.get(tenant)
+                if eng is None:
+                    worker.channel.send(src, "done", rid=msg["rid"],
+                                        error="tenant not here")
+                    return
+                try:
+                    req = eng.request(msg["rid"], msg["steps"])
+                except Exception as exc:  # noqa: BLE001 — admission
+                    worker.channel.send(src, "done", rid=msg["rid"],
+                                        error=str(exc)[:120])
+                    return
+                with lock:
+                    inflight.append((req, msg["rid"], tenant, src))
+            finally:
+                with lock:
+                    processing[tenant] -= 1
+
+        def backlog() -> float:
+            with lock:
+                return float(len(inflight)) + worker._reqs.qsize()
+
+        worker = ElasticWorker(ctx, controller_rank=0,
+                               on_adopt=on_adopt, on_drop=on_drop,
+                               on_request=on_request,
+                               backlog_fn=backlog)
+
+        def digest_op(src: int, msg: Dict) -> None:
+            dc = shards.get(msg["tenant"])
+            d = (None if dc is None else
+                 _shard_digest({k: dc.data_of(k) for k in dc.keys()}))
+            worker.channel.send(src, "ack", token=msg["token"],
+                                digest=d)
+
+        worker.channel.on("shard_digest", digest_op)
+
+        stop = threading.Event()
+
+        def completer() -> None:
+            while not stop.is_set():
+                done = []
+                with lock:
+                    for item in list(inflight):
+                        if item[0].done_evt.is_set():
+                            inflight.remove(item)
+                            done.append(item)
+                for req, rid, tenant, src in done:
+                    eng = engines.get(tenant)
+                    worker.channel.send(
+                        src, "done", rid=rid,
+                        state=np.asarray(req.result))
+                    if eng is not None:
+                        eng.release(req)
+                if not done:
+                    time.sleep(0.003)
+
+        ct = threading.Thread(target=completer, daemon=True)
+        ct.start()
+        worker.wait_drained(timeout=600.0)
+        stop.set()
+        ct.join(timeout=5.0)
+        for eng in engines.values():
+            eng.close()
+        worker.stop()
+        ctx.fini()                     # orderly BYE: peers see DEPARTED
+        q.put((rank, "ok", {}))
+    except BaseException as exc:  # noqa: BLE001 — report to parent
+        q.put((rank, "error", f"{exc}\n{traceback.format_exc()}"))
+
+
+# ---------------------------------------------------------------------------
+# front end: router + controller + sawtooth generator
+# ---------------------------------------------------------------------------
+
+class _Router:
+    """Open-loop request router on the front-end rank: each request
+    goes to its tenant's CURRENT owner; a tenant under migration parks
+    its requests and flushes them to the new owner on resume (that
+    window is the measured migration pause)."""
+
+    def __init__(self, ctrl, steps: int):
+        self.ctrl = ctrl
+        self.steps = steps
+        self.lock = threading.Lock()
+        self.outstanding: Dict[int, Dict] = {}   # rid -> record
+        self.completions: List[Dict] = []
+        self.lost: List[int] = []
+        self.rerouted = 0
+        self._retries: Dict[int, int] = {}
+        self.paused: set = set()
+        self.parked: Dict[str, List] = {}
+        ctrl.channel.on("done", self._on_done)
+        ctrl.set_router(self.per_rank_outstanding, self.pause,
+                        self.resume)
+
+    # -- controller hooks -------------------------------------------------
+    def per_rank_outstanding(self) -> Dict[int, float]:
+        out: Dict[int, float] = {}
+        with self.lock:
+            for rec in self.outstanding.values():
+                out[rec["rank"]] = out.get(rec["rank"], 0.0) + 1.0
+        return out
+
+    def pause(self, tenant: str) -> None:
+        with self.lock:
+            self.paused.add(tenant)
+            self.parked.setdefault(tenant, [])
+
+    def resume(self, tenant: str) -> None:
+        with self.lock:
+            self.paused.discard(tenant)
+            parked = self.parked.pop(tenant, [])
+        for rid, phase, t0 in parked:
+            self._send(rid, tenant, phase, t0)
+
+    # -- request path -----------------------------------------------------
+    def submit(self, rid: int, tenant: str, phase: int,
+               t0: Optional[float] = None) -> None:
+        # arrival time stamps HERE: a request parked through a
+        # migration pause must report the pause in its latency (same
+        # contract as the re-route path below)
+        if t0 is None:
+            t0 = time.monotonic()
+        with self.lock:
+            if tenant in self.paused:
+                self.parked[tenant].append((rid, phase, t0))
+                return
+        self._send(rid, tenant, phase, t0)
+
+    def _send(self, rid: int, tenant: str, phase: int,
+              t0: Optional[float] = None) -> None:
+        rank = self.ctrl.owner_of(tenant)
+        if rank is None:
+            with self.lock:
+                self.lost.append(rid)
+            return
+        with self.lock:
+            # a re-routed request keeps its ORIGINAL t0: the reported
+            # latency must include the bounced first leg — that delay
+            # is exactly the migration disruption being measured
+            self.outstanding[rid] = {"t0": (t0 if t0 is not None
+                                            else time.monotonic()),
+                                     "tenant": tenant, "rank": rank,
+                                     "phase": phase}
+        self.ctrl.channel.send(rank, "req", rid=rid, tenant=tenant,
+                               steps=self.steps)
+
+    def _on_done(self, src: int, msg: Dict) -> None:
+        rid = msg["rid"]
+        with self.lock:
+            rec = self.outstanding.pop(rid, None)
+        if rec is None:
+            return
+        if msg.get("error") is not None and "state" not in msg:
+            # a request caught mid-migration bounced off the OLD owner
+            # ("tenant not here"): re-route it to the current owner —
+            # migration must not lose traffic, only delay it
+            with self.lock:
+                n = self._retries.get(rid, 0)
+                if n < 3:
+                    self._retries[rid] = n + 1
+                    self.rerouted += 1
+                else:
+                    self.lost.append(rid)
+                    return
+            self.submit(rid, rec["tenant"], rec["phase"],
+                        t0=rec["t0"])
+            return
+        now = time.monotonic()
+        lat = now - rec["t0"]
+        rec.update({"t_done": now, "latency_s": lat, "rid": rid,
+                    "state": np.asarray(msg["state"])})
+        with self.lock:
+            self.completions.append(rec)
+        self.ctrl.record_latency(lat)
+
+
+def measure_elastic(low_s: float = 4.0, high_s: float = 14.0,
+                    tail_s: float = 12.0, low_rate: float = 8.0,
+                    high_rate: float = 70.0,
+                    work_ms: float = 35.0) -> Dict:
+    """The full sawtooth measurement (see module doc). Phase plan:
+    ``low_rate`` for ``low_s``, ``high_rate`` for ``high_s`` (the
+    autoscaler grows 2 → 4 ranks), ``low_rate`` again for ``tail_s``
+    (it drains back toward 2)."""
+    import tempfile
+    from ..comm.socket_engine import SocketCommEngine
+    from ..core import context as ctx_mod
+    from ..serving import runtime as srt
+    from ..serving.decode import DecodeConfig, DecodeModel, \
+        reference_decode
+    from ..serving.elastic import AutoscalePolicy, ElasticController
+    from ..utils import mca_param
+
+    mca_param.set("comm.elastic", 1)
+    mca_param.set("runtime.stage_reads", "0")
+    mca_param.set("comm.stage_recv", "0")
+    mca_param.set("device.tpu.enabled", False)
+    mca_param.set("serving.autoscale", "act")
+    mca_param.set("serving.autoscale_poll_s", 0.15)
+
+    ckpt_dir = tempfile.mkdtemp(prefix="parsec_elastic_")
+    mpx = mp.get_context("spawn")
+    q = mpx.Queue()
+    base_port = _free_port_base(5)
+    workers = []
+
+    def spawn(rank, world, live):
+        p = mpx.Process(target=_worker_main,
+                        args=(rank, world, base_port, ckpt_dir,
+                              work_ms, q, live))
+        p.start()
+        workers.append(p)
+
+    # the base mesh: front end + ONE serving rank (world size 2)
+    spawn(1, 2, None)
+    engine = SocketCommEngine(0, 2, base_port=base_port)
+    ctx = ctx_mod.init(nb_cores=4, comm=engine)
+    out: Dict = {}
+    ctrl = None
+    stop_sampler = None
+    st = None
+    try:
+        ctx.start()
+        rt = srt.enable(ctx)
+        policy = AutoscalePolicy(min_ranks=1, max_ranks=3,
+                                 up_backlog=6.0, down_backlog=1.0,
+                                 idle_rounds=3, cooldown_s=1.2)
+        ctrl = ElasticController(ctx, runtime=rt, spawn_rank=spawn,
+                                 tenants=_TENANTS, policy=policy,
+                                 mode="act")
+        router = _Router(ctrl, _DECODE_STEPS)
+        # seed the initial placement (everything on rank 1) — AFTER
+        # rank 1's worker agent heartbeats: socket admission precedes
+        # its ELASTIC handler registration, and an adopt op landing in
+        # that window would be silently dropped (same handshake
+        # grow_one performs for fresh ranks)
+        ctrl._wait_agent(1)
+        for t in _TENANTS:
+            dst = ctrl.placement[t]
+            ctrl.placement[t] = None
+            ctrl.migrate_tenant(t, dst)
+        seed_migrations = len(ctrl.migration_pauses_ms)
+        ctrl.start()
+
+        # world-size timeline sampler (the ramp-tracking evidence)
+        timeline: List = []
+        stop_sampler = threading.Event()
+
+        def sampler():
+            t0 = time.monotonic()
+            while not stop_sampler.is_set():
+                ws = engine.world_status()
+                timeline.append((round(time.monotonic() - t0, 2),
+                                 len(ws["live"])))
+                stop_sampler.wait(0.25)
+
+        st = threading.Thread(target=sampler, daemon=True)
+        st.start()
+
+        # ------------------------------------------------ sawtooth load
+        phases = [{"rate": low_rate, "dur": low_s},
+                  {"rate": high_rate, "dur": high_s},
+                  {"rate": low_rate, "dur": tail_s}]
+        rid = 0
+        t_start = time.monotonic()
+        for pi, ph in enumerate(phases):
+            ph["t0"] = time.monotonic() - t_start
+            ph["submitted"] = 0
+            interval = 1.0 / ph["rate"]
+            next_t = time.monotonic()
+            end_t = next_t + ph["dur"]
+            while time.monotonic() < end_t:
+                rid += 1
+                router.submit(rid, _TENANTS[rid % len(_TENANTS)], pi)
+                ph["submitted"] += 1
+                next_t += interval
+                delay = next_t - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                # open-loop: a late server never slows arrivals
+            ph["t1"] = time.monotonic() - t_start
+        peak_world = max(w for (_t, w) in timeline) if timeline else 2
+
+        # drain the tail: outstanding requests finish (bounded)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            with router.lock:
+                left = len(router.outstanding)
+            if left == 0:
+                break
+            time.sleep(0.05)
+        ctrl.stop()
+        stop_sampler.set()
+        st.join(timeout=3.0)
+        final_world = len(engine.world_status()["live"])
+
+        # ------------------------------------------- per-phase tracking
+        with router.lock:
+            comps = list(router.completions)
+            lost = len(router.lost)
+        rows = []
+        tracking = []
+        for pi, ph in enumerate(phases):
+            window = ph["t1"] - ph["t0"]
+            in_window = [c for c in comps
+                         if ph["t0"] <= (c["t_done"] - t_start)
+                         < ph["t1"]]
+            done_rate = len(in_window) / window if window else 0.0
+            offered = ph["submitted"] / window if window else 0.0
+            lats = [c["latency_s"] * 1e3 for c in comps
+                    if c["phase"] == pi]
+            pct = 100.0 * min(1.0, done_rate / offered) if offered \
+                else 100.0
+            tracking.append(pct)
+            rows.append({"phase": pi,
+                         "offered_per_sec": round(offered, 1),
+                         "completed_per_sec": round(done_rate, 1),
+                         "tracking_pct": round(pct, 1),
+                         "p50_ms": round(_pctl(lats, 0.5), 1)
+                         if lats else None,
+                         "p99_ms": round(_pctl(lats, 0.99), 1)
+                         if lats else None})
+
+        # ------------------------------------------------- verification
+        model = DecodeModel(DecodeConfig())
+        bad = 0
+        for c in comps:
+            ref = reference_decode(model, c["rid"], _DECODE_STEPS)
+            if c["state"].shape != ref.shape or \
+                    not np.all(c["state"] == ref):
+                bad += 1
+        # persistent tenant shards: bitwise across every rescale. A
+        # tenant that ended the run UNPLACED (late adopt failure) or
+        # whose digest probe fails IS the finding — record FAIL, do
+        # not crash the section out of its own verification
+        shard_ok = True
+        for t in _TENANTS:
+            owner = ctrl.owner_of(t)
+            if owner is None:
+                shard_ok = False
+                continue
+            try:
+                token, slot = ctrl._new_ack()
+                ctrl.channel.send(owner, "shard_digest", tenant=t,
+                                  token=token)
+                ack = ctrl._wait_ack(token, slot, 20.0,
+                                     f"shard digest of {t}")
+            except Exception:  # noqa: BLE001 — probe failure = FAIL
+                shard_ok = False
+                continue
+            if ack.get("digest") != _shard_digest(_shard_tiles(t)):
+                shard_ok = False
+
+        ws = engine.world_status()
+        drain_clean = (engine._peer_failure is None and
+                       not ws["dead"] and
+                       rt.stats.get("quarantined", 0) == 0)
+        migrations = ctrl.migration_pauses_ms[seed_migrations:]
+        bitwise_ok = bad == 0 and shard_ok and bool(comps)
+
+        ctrl.shutdown_workers()
+        out.update({
+            "phases": rows,
+            "ramp_tracking_pct": round(min(tracking), 1)
+            if tracking else None,
+            "requests_completed": len(comps),
+            "requests_lost": lost,
+            "requests_rerouted": router.rerouted,
+            "migrations": len(migrations),
+            "migration_pause_p99_ms": round(_pctl(migrations, 0.99), 2)
+            if migrations else None,
+            "migration_pause_max_ms": round(max(migrations), 2)
+            if migrations else None,
+            "bitwise": "OK" if bitwise_ok else "FAIL",
+            "bitwise_bad": bad,
+            "shard_digest_ok": shard_ok,
+            "drain_clean": drain_clean,
+            # live counts INCLUDE the front end (rank 0), so these are
+            # world sizes: the sawtooth target is 2 -> 4 -> 2
+            "peak_world": int(peak_world),
+            "final_world": int(final_world),
+            "world_timeline": _compress_timeline(timeline),
+            "failed_joins": ctrl.failed_joins,
+            "decisions": [
+                {k: d[k] for k in ("from", "to", "reason", "ok")}
+                for d in ctrl.decisions if d["acted"]][:16],
+            "work_ms": work_ms,
+        })
+    finally:
+        # mid-bench exceptions must not leave the autoscaler ACTING
+        # (spawning workers!) against a context being finalized, nor
+        # the sampler thread running — the success path's stop calls
+        # above are idempotent re-runs of these
+        if ctrl is not None:
+            ctrl.stop()
+        if stop_sampler is not None:
+            stop_sampler.set()
+            if st is not None:
+                st.join(timeout=3.0)
+        try:
+            ctx.fini()
+        finally:
+            for p in workers:
+                p.join(timeout=20.0)
+                if p.is_alive():
+                    p.terminate()
+            # comm.elastic changes engine BEHAVIOR (permanent wireup
+            # listeners, grow semantics) — it must not leak into later
+            # sections measured in this process
+            for knob in ("serving.autoscale", "serving.autoscale_poll_s",
+                         "comm.elastic"):
+                mca_param.unset(knob)
+            import shutil
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+    return out
+
+
+def _compress_timeline(timeline: List) -> List:
+    """(t, live) samples → change points only (driver-facing size)."""
+    out: List = []
+    for t, w in timeline:
+        if not out or out[-1][1] != w:
+            out.append([t, w])
+    return out

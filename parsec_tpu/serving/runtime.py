@@ -115,7 +115,8 @@ class Tenant:
         self._waiters = 0
         self.stats = {"submitted": 0, "completed": 0, "failed": 0,
                       "rejected": 0, "shed": 0, "cancelled": 0,
-                      "rows_admitted": 0, "rows_retired": 0}
+                      "rows_admitted": 0, "rows_retired": 0,
+                      "parked": 0}
 
     def __repr__(self) -> str:
         return (f"<Tenant {self.name} w={self.weight} "
@@ -192,7 +193,13 @@ class _PoolAdmission:
             ten.inflight += n
             self.admitted += n
             ten.stats["rows_admitted"] += n
+            if park_t0 is not None:
+                # admission-park counter: one of the autoscaler's
+                # scale-up signals (serving/elastic.py) — parks piling
+                # up mean the tenant windows are the bottleneck
+                ten.stats["parked"] += 1
         if park_t0 is not None:
+            self.runtime._bump("parked")
             self._record_park(tp, ten, park_t0, n)
 
     def _record_park(self, tp: Taskpool, ten: Tenant,
@@ -300,7 +307,12 @@ class ServingRuntime:
         self._stop = threading.Event()
         self.stats = {"submitted": 0, "completed": 0, "failed": 0,
                       "rejected": 0, "shed": 0, "quarantined": 0,
-                      "cancelled": 0, "deadline_cancelled": 0}
+                      "cancelled": 0, "deadline_cancelled": 0,
+                      "parked": 0}
+        # elastic-capacity controller (serving/elastic.py) — attached
+        # by ElasticController so statusz/metrics can surface the
+        # autoscaler's state next to the tenant report
+        self.elastic = None
         self._stats_lock = threading.Lock()
         if strict_fair is None:
             strict_fair = str(mca_param.get(
@@ -650,6 +662,8 @@ class ServingRuntime:
         sched = self.ctx.scheduler
         if hasattr(sched, "pool_stats"):
             out["pools"] = sched.pool_stats()
+        if self.elastic is not None:
+            out["elastic"] = self.elastic.status()
         return out
 
 

@@ -33,17 +33,10 @@ import numpy as np
 
 from .decode import DecodeConfig, DecodeEngine
 from ..comm.pingpong import _free_port_base
+from ..utils.stats import pctl as _pctl
 
 _DECODE_STEPS = 8           # decode steps per request
 _CHAIN_TILES = 8            # distributed tenant: tiles per rank round
-
-
-def _pctl(xs: List[float], q: float) -> Optional[float]:
-    if not xs:
-        return None
-    s = sorted(xs)
-    idx = min(len(s) - 1, int(round(q * (len(s) - 1))))
-    return s[idx]
 
 
 def _lat_row(lats_ms: List[float], n_submitted: int, n_rejected: int,

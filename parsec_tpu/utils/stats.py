@@ -1,0 +1,15 @@
+"""Tiny shared statistics helpers for bench/serving reporting."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+def pctl(xs: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (q in [0, 1]) of ``xs``; None when
+    empty. ONE definition — the serving, elastic, and autoscaler p99
+    figures must never diverge on the index formula."""
+    if not xs:
+        return None
+    s = sorted(xs)
+    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]

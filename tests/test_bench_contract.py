@@ -371,6 +371,47 @@ def test_amort_section_registered():
     assert compact["detail"]["amort_wf_warm_compiles"] == 7
 
 
+def test_elastic_section_registered():
+    """--section elastic is a first-class section (ISSUE 11 bench
+    contract): registry, error keys, compact summary, and the guards
+    stay wired together — ramp tracking rides the throughput
+    drop-guard, the migration-pause p99 the latency rise-guard, and
+    the bitwise/drain rows land in the summary."""
+    bench = _load_bench()
+    assert "elastic" in bench.SECTIONS
+    assert bench._SECTION_KEYS["elastic"] == ("elastic",)
+    assert "elastic_ramp_tracking_pct" in bench._GFLOPS_GUARD_KEYS
+    assert "elastic_migration_pause_p99_ms" in bench._LATENCY_GUARD_KEYS
+    result = _fat_result()
+    result["detail"]["extra_configs"]["elastic"] = {
+        "ramp_tracking_pct": 81.8, "migration_pause_p99_ms": 50.9,
+        "bitwise": "OK", "peak_world": 4, "final_world": 2,
+        "drain_clean": True}
+    compact = json.loads(bench._compact_summary(result))
+    d = compact["detail"]
+    assert d["elastic_ramp_tracking_pct"] == 81.8
+    assert d["elastic_migration_pause_p99_ms"] == 50.9
+    assert d["elastic_bitwise_ok"] == "OK"
+    assert d["elastic_peak_world"] == 4
+    assert d["elastic_drain_clean"] is True
+
+
+def test_elastic_guard_rows_fire_in_both_directions():
+    bench = _load_bench()
+    prior = {"elastic_ramp_tracking_pct": 85.0,
+             "elastic_migration_pause_p99_ms": 50.0}
+    out = bench._compare_captures(
+        {"elastic_ramp_tracking_pct": 60.0,       # -29%: stopped
+         "elastic_migration_pause_p99_ms": 90.0},  # +80%: disruptive
+        prior)
+    assert "elastic_ramp_tracking_pct" in out["throughput_regression"]
+    assert "elastic_migration_pause_p99_ms" in out["latency_regression"]
+    # within-band changes stay quiet
+    assert bench._compare_captures(
+        {"elastic_ramp_tracking_pct": 82.0,
+         "elastic_migration_pause_p99_ms": 53.0}, prior) == {}
+
+
 def test_observability_section_registered():
     """--section observability is a first-class section (ISSUE 9 bench
     contract): registry, error keys, compact summary, and the
