@@ -128,11 +128,8 @@ def _rank_main(rank: int, nb_ranks: int, base_port: int, rounds: int,
 
         for key, val in cfg.items():
             mca_param.set(key, val)
-        # host-payload wire benchmark: no accelerator staging, and the
-        # rank fleet must never touch (or contend for) an exclusive chip
-        mca_param.set("runtime.stage_reads", "0")
-        mca_param.set("comm.stage_recv", "0")
-        mca_param.set("device.tpu.enabled", False)
+        from ..utils.benchenv import pin_wire_bench_env
+        pin_wire_bench_env()
         engine = SocketCommEngine(rank, nb_ranks, base_port=base_port)
         ctx = ctx_mod.init(nb_cores=2, comm=engine)
         A = _DistVec(nb_ranks, nb_ranks, rank)

@@ -92,6 +92,10 @@ class CommEngine:
         # collections by name + in-flight fetch futures
         self._exposed_colls: Dict[str, Any] = {}
         self._fetch_futures: Dict[int, Any] = {}
+        # req ids whose reply should stage per segment into device
+        # memory (fetch_tiles(stage=True) — the HBM remote stage-in);
+        # transports without segmented replies simply never read it
+        self._fetch_stage: Dict[int, bool] = {}
         self._fetch_next = 0
         self._fetch_lock = threading.Lock()
         self.tag_register(AMTag.TILE_FETCH, self._on_tile_fetch)
@@ -292,22 +296,25 @@ class CommEngine:
         self.send_am(AMTag.TILE_FETCH, src, reply)
 
     def fetch_tile(self, dc, key, owner: int, timeout: float = 120.0,
-                   scope: str = ""):
+                   scope: str = "", stage: bool = False):
         """Blocking GET of tile ``key`` of collection ``dc`` from
         ``owner`` (local reads short-circuit). ``scope`` must match the
         owner's :meth:`expose_collection` scope (the taskpool name).
         The caller is responsible for ordering (the tile must be final
         on the owner)."""
         return self.fetch_tiles(dc, [(key, owner)], timeout=timeout,
-                                scope=scope)[0]
+                                scope=scope, stage=stage)[0]
 
     def fetch_tiles(self, dc, keys_owners, timeout: float = 120.0,
-                    scope: str = "") -> list:
+                    scope: str = "", stage: bool = False) -> list:
         """Concurrent multi-tile GET: fire every request, then wait —
         one link round trip for the batch instead of one per tile
         (sequential blocking fetches on a ~100 ms-class link serialize
         brutally). ``keys_owners``: iterable of (key, owner); local
-        tiles resolve inline. Returns values in order."""
+        tiles resolve inline. Returns values in order. ``stage=True``
+        asks transports with segmented replies to reassemble each tile
+        with per-segment H2D straight into device memory (the HBM
+        remote stage-in — the value then arrives as a device array)."""
         from ..core.future import Future
         slots: list = []
         reqs: list = []
@@ -327,6 +334,8 @@ class CommEngine:
                 req = self._fetch_next
                 self._fetch_next += 1
                 self._fetch_futures[req] = fut
+                if stage:
+                    self._fetch_stage[req] = True
             reqs.append(req)
             self.send_am(AMTag.TILE_FETCH, owner,
                          {"name": dc.name, "scope": scope,
@@ -361,6 +370,7 @@ class CommEngine:
             with self._fetch_lock:
                 for req in reqs:
                     self._fetch_futures.pop(req, None)
+                    self._fetch_stage.pop(req, None)
         return out
 
     def peer_alive(self, rank: int) -> bool:
@@ -539,12 +549,34 @@ def resolve_column_tiles(task, dc, keys, dtype=None) -> list:
     owner's comm thread (``CommEngine.fetch_tiles``) under the caller's
     dataflow-ordering guarantee (CTL-gather). The shared helper of the
     direct-memory gathered-operand pattern (build_potrf_left UPDATE,
-    build_geqrf_hh PANEL/REDUCE)."""
+    build_geqrf_hh PANEL/REDUCE).
+
+    With an HBM manager active (``device.hbm_budget_mb``) and staging
+    on, remote tiles are treated as a STAGE-IN SOURCE: the segmented
+    fetch lands per segment in device memory and the tile is accounted
+    straight into its HBM slot (``HBMManager.fetch_tiles``) — no host
+    copy is materialized between the wire and the chip, and device-
+    resident operands are returned as device arrays (owner-computes
+    reads of remote tiles stop paying the host round trip)."""
     import numpy as np
     dtype = dtype or np.float32
     ctx = task.taskpool.context
     if ctx is None or ctx.nb_ranks <= 1:
         return [np.asarray(dc.data_of(k), dtype=dtype) for k in keys]
     pairs = [(k, dc.rank_of(k)) for k in keys]
+    hbm = getattr(ctx, "hbm", None)
+    from . import device_plane
+    if hbm is not None and device_plane.pipeline_enabled() and \
+            ctx.stage_reads:
+        vals = hbm.fetch_tiles(dc, pairs, ctx.comm,
+                               scope=task.taskpool.name)
+        out = []
+        for v in vals:
+            if device_plane.is_device_array(v):
+                out.append(v if str(v.dtype) == str(np.dtype(dtype))
+                           else v.astype(dtype))
+            else:
+                out.append(np.asarray(v, dtype=dtype))
+        return out
     vals = ctx.comm.fetch_tiles(dc, pairs, scope=task.taskpool.name)
     return [np.asarray(v, dtype=dtype) for v in vals]

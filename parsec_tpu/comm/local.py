@@ -15,6 +15,7 @@ import queue
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
+from . import device_plane
 from .engine import AMTag, CommEngine
 
 
@@ -133,13 +134,28 @@ class LocalCommEngine(CommEngine):
                                   refs) -> None:
         """Packed multi-target activation: N deps of ONE produced value
         to one rank ride a single loopback message carrying the payload
-        once (the reference's one-data-per-(dep, rank) aggregation)."""
+        once (the reference's one-data-per-(dep, rank) aggregation).
+
+        Device-direct (``comm.device_direct`` + a registered comm mesh,
+        compiled/spmd.py): a device-resident value moves as an XLA
+        device-to-device ``device_put`` onto the CONSUMER rank's device
+        (the ICI edge on real hardware) and the activation is accounted
+        at its CONTROL-frame size — the payload never touches host
+        memory or the wire counters."""
         tp = task.taskpool
         monitor = tp.monitor
         monitor.outgoing_message_start(target_rank)
-        msg = {"taskpool": tp.name, "targets": self._targets_of(refs),
-               "value": refs[0].value}
-        nbytes = self.payload_bytes(refs[0].value)
+        value = refs[0].value
+        targets = self._targets_of(refs)
+        msg = {"taskpool": tp.name, "targets": targets}
+        dev = device_plane.direct_device_for(target_rank)
+        if dev is not None and device_plane.has_device(value):
+            value = device_plane.place_value(value, dev)
+            msg["dev_direct"] = True
+            nbytes = device_plane.control_bytes(targets)
+        else:
+            nbytes = self.payload_bytes(value)
+        msg["value"] = value
         self.record_msg("sent", "activate", target_rank, nbytes)
         self._span_sent(self._span_attach(tp, task, msg), target_rank,
                         nbytes)
@@ -160,13 +176,26 @@ class LocalCommEngine(CommEngine):
         value = next(iter(rank_refs.values()))[0].value
         msg["value"] = value
         nbytes = self.payload_bytes(value)
+        direct = device_plane.has_device(value)
         bsp = self._span_attach(tp, task, msg)
         for c in bcast_live_children(topo, parts, self.rank, fanout,
                                      self.peer_alive):
             monitor.outgoing_message_start(c)
-            self.record_msg("sent", "bcast", c, nbytes)
-            self._span_sent(bsp, c, nbytes)
-            self.send_am(AMTag.ACTIVATE, c, msg)
+            cmsg, cnb = msg, nbytes
+            if direct:
+                dev = device_plane.direct_device_for(c)
+                if dev is not None:
+                    # per-TREE-EDGE device-to-device copy: each child
+                    # gets the value on ITS device, the wire carries
+                    # only the control frame
+                    cmsg = dict(msg)
+                    cmsg["value"] = device_plane.place_value(value, dev)
+                    cmsg["dev_direct"] = True
+                    cnb = device_plane.control_bytes(
+                        msg.get("targets_by_rank", {}))
+            self.record_msg("sent", "bcast", c, cnb)
+            self._span_sent(bsp, c, cnb)
+            self.send_am(AMTag.ACTIVATE, c, cmsg)
             monitor.outgoing_message_end(c)
 
     def install_activate_handler(self, context) -> None:
@@ -189,7 +218,11 @@ class LocalCommEngine(CommEngine):
                     return
             tp.monitor.incoming_message_start(src_rank)
             value = msg["value"]
-            nbytes = self.payload_bytes(value)
+            direct = msg.get("dev_direct", False)
+            nbytes = device_plane.control_bytes(msg["targets_by_rank"]
+                                                if "bcast" in msg
+                                                else msg["targets"]) \
+                if direct else self.payload_bytes(value)
             if "bcast" in msg:
                 b = msg["bcast"]
                 children = bcast_live_children(
@@ -200,9 +233,19 @@ class LocalCommEngine(CommEngine):
                                            nbytes)
                 for c in children:
                     tp.monitor.outgoing_message_start(c)
+                    cmsg = msg
+                    if direct:
+                        dev = device_plane.direct_device_for(c)
+                        if dev is not None:
+                            # forwarded tree edge: re-place the payload
+                            # onto the CHILD's device (D2D), bytes stay
+                            # off the wire accounting
+                            cmsg = dict(msg)
+                            cmsg["value"] = device_plane.place_value(
+                                value, dev)
                     self.record_msg("sent", "bcast", c, nbytes)
                     self._span_sent(msg.get("span"), c, nbytes)
-                    self.send_am(AMTag.ACTIVATE, c, msg)
+                    self.send_am(AMTag.ACTIVATE, c, cmsg)
                     tp.monitor.outgoing_message_end(c)
                 self.record_msg("recv", "bcast", src_rank, nbytes)
             else:

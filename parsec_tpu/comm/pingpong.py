@@ -118,20 +118,28 @@ def _build_chain(hops: int, A, device: bool = False):
 
 def _rank_main(rank: int, nb_ranks: int, base_port: int, hops: int,
                payload_f32: int, eager_limit: int, q,
-               device: bool = False) -> None:
+               device: bool = False, knobs=None) -> None:
     try:
         from ..comm.socket_engine import SocketCommEngine
         from ..core import context as ctx_mod
         from ..utils import mca_param
 
+        knobs = dict(knobs or {})
+
+        from ..utils.benchenv import pin_wire_bench_env
+
         mca_param.set("comm.eager_limit", eager_limit)
         if not device:
-            # host-payload latency rows measure the WIRE: without this,
-            # stage-through reads + receive staging route every payload
-            # through the accelerator (measured 3.8 ms -> ~170 ms/hop
-            # through the axon tunnel)
-            mca_param.set("runtime.stage_reads", "0")
-            mca_param.set("comm.stage_recv", "0")
+            # host-payload latency rows measure the WIRE: without the
+            # shared pins, stage-through reads + receive staging route
+            # every payload through the accelerator (measured 3.8 ms ->
+            # ~170 ms/hop through the axon tunnel). tpu_off=False: the
+            # pingpong never disables the device module (device rows
+            # need it, host rows never touch it).
+            pin_wire_bench_env(tpu_off=False, overrides=knobs)
+        elif knobs:
+            for _k, _v in knobs.items():
+                mca_param.set(_k, _v)
         engine = SocketCommEngine(rank, nb_ranks, base_port=base_port)
         ctx = ctx_mod.init(nb_cores=1, comm=engine)
         A = _AlternatingVec(hops, nb_ranks, rank, payload_f32,
@@ -161,19 +169,24 @@ def _rank_main(rank: int, nb_ranks: int, base_port: int, hops: int,
 def measure_latency(payload_bytes: int = 1024, hops: int = 200,
                     eager_limit: int = 256 * 1024,
                     timeout: float = 300.0,
-                    device_payload: bool = False) -> Dict:
+                    device_payload: bool = False,
+                    knobs: Dict = None) -> Dict:
     """Spawn 2 ranks, bounce a ``payload_bytes`` array ``hops`` times,
     return percentile activate→data latencies in microseconds.
     ``device_payload=True``: the payload lives on the accelerator at
-    each end — hops measure the full device→wire→device path (D2H
-    snapshot at send, comm-thread device_put at receive)."""
+    each end — hops measure the full device→wire→device path (async
+    segmented D2H at send, per-segment device_put at receive under
+    ``comm.device_pipeline``; the round-5 blocking snapshot/restage
+    path under ``=0`` — the bench's A/B arms). ``knobs``: extra MCA
+    params pinned in BOTH rank processes (e.g. the device-plane A/B
+    arm and a matched ``comm.segment_bytes``)."""
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     base_port = _free_port_base()
     payload_f32 = max(payload_bytes // 4, 1)
     procs = [ctx.Process(target=_rank_main,
                          args=(r, 2, base_port, hops, payload_f32,
-                               eager_limit, q, device_payload))
+                               eager_limit, q, device_payload, knobs))
              for r in range(2)]
     for p in procs:
         p.start()
@@ -204,4 +217,81 @@ def measure_latency(payload_bytes: int = 1024, hops: int = 200,
         "p90_us": float(np.percentile(hop_us, 90)),
         "p99_us": float(np.percentile(hop_us, 99)),
         "total_s": max(r["total_s"] for r in results.values()),
+    }
+
+
+def measure_ici_latency(payload_bytes: int = 1 << 16, hops: int = 64,
+                        timeout: float = 120.0) -> Dict:
+    """Same-mesh device-direct hop (the ICI row): two loopback ranks in
+    ONE process whose comm mesh is registered over the visible jax
+    devices (``compiled.spmd.register_comm_mesh``), bouncing a
+    device-resident payload with ``comm.device_direct`` forced on. Each
+    hop moves the tile as an XLA device-to-device ``device_put`` — the
+    payload never touches host memory, and the engines' wire counters
+    see only CONTROL frames. Returns hop percentiles plus the measured
+    per-hop wire bytes and the payload size (the host-bypass proof:
+    wire bytes ≈ control-frame size ≪ payload)."""
+    import jax
+    import parsec_tpu as parsec
+    from ..compiled import spmd
+    from ..termdet import FourCounterTermdet
+    from ..utils import mca_param
+    from .local import LocalCommEngine
+
+    # this harness runs INSIDE the bench process: snapshot the knob
+    # overrides and any registered comm mesh, and restore them after —
+    # unset() would destroy a caller's explicit pins
+    _KNOBS = ("comm.device_direct", "comm.stage_recv",
+              "runtime.stage_reads")
+    saved = {k: mca_param.override_of(k) for k in _KNOBS}
+    saved_mesh = spmd.comm_mesh()
+    mca_param.set("comm.device_direct", "1")
+    mca_param.set("comm.stage_recv", "0")
+    mca_param.set("runtime.stage_reads", "0")
+    spmd.register_comm_mesh(spmd.make_mesh())
+    engines = LocalCommEngine.make_fabric(2)
+    ctxs, tps, times = [], [], []
+    try:
+        for r in range(2):
+            ctx = parsec.init(nb_cores=1, comm=engines[r])
+            A = _AlternatingVec(hops, 2, r, max(payload_bytes // 4, 1),
+                                device=True)
+            tp, hop_times = _build_chain(hops, A, device=True)
+            tp.monitor = FourCounterTermdet(comm=engines[r])
+            ctxs.append(ctx)
+            tps.append(tp)
+            times.append(hop_times)
+            ctx.add_taskpool(tp)
+        for ctx in ctxs:
+            ctx.start()
+        for ctx in ctxs:
+            if not ctx.wait(timeout=timeout):
+                raise RuntimeError("ICI pingpong did not terminate")
+        stats = engines[0].stats
+        msgs = max(stats["activations_sent"], 1)
+        wire_per_hop = stats["bytes_sent"] / msgs
+    finally:
+        for ctx in ctxs:
+            parsec.fini(ctx)
+        if saved_mesh is not None:
+            spmd.register_comm_mesh(saved_mesh[0], saved_mesh[1])
+        else:
+            spmd.unregister_comm_mesh()
+        for key in _KNOBS:
+            mca_param.restore_override(key, saved[key])
+    per_rank = [t[2:] if len(t) > 4 else list(t) for t in times]
+    hop_us = []
+    for t in per_rank:
+        d = np.diff(np.asarray(t)) / 2 * 1e6
+        hop_us.extend(d.tolist())
+    hop_us = np.asarray(hop_us) if hop_us else np.asarray([0.0])
+    return {
+        "payload_bytes": max(payload_bytes // 4, 1) * 4,
+        "hops": hops,
+        "devices": len(jax.devices()),
+        "p50_us": float(np.percentile(hop_us, 50)),
+        "p90_us": float(np.percentile(hop_us, 90)),
+        "wire_bytes_per_hop": round(float(wire_per_hop), 1),
+        "host_bypass": bool(wire_per_hop < max(payload_bytes // 8,
+                                               4096)),
     }

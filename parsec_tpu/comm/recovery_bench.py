@@ -150,9 +150,8 @@ def _rank_main(rank: int, nb_ranks: int, base_port: int, n_tiles: int,
         from ..data import recovery
         from ..utils import mca_param
 
-        mca_param.set("runtime.stage_reads", "0")
-        mca_param.set("comm.stage_recv", "0")
-        mca_param.set("device.tpu.enabled", False)
+        from ..utils.benchenv import pin_wire_bench_env
+        pin_wire_bench_env()
         if rank == victim:
             # drop (go-silent) rather than kill: the victim process
             # survives to report, while peers see a crashed rank
@@ -253,9 +252,8 @@ def _baseline_main(rank: int, nb_ranks: int, base_port: int,
         from ..core import context as ctx_mod
         from ..utils import mca_param
 
-        mca_param.set("runtime.stage_reads", "0")
-        mca_param.set("comm.stage_recv", "0")
-        mca_param.set("device.tpu.enabled", False)
+        from ..utils.benchenv import pin_wire_bench_env
+        pin_wire_bench_env()
         engine = SocketCommEngine(rank, nb_ranks, base_port=base_port)
         ctx = ctx_mod.init(nb_cores=2, comm=engine)
         X = DistVec("X", n_tiles, nb_ranks, rank, _init)

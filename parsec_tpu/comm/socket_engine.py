@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .engine import AMTag, CommEngine
 from .collectives import BcastTopology, bcast_live_children
+from . import device_plane
 from ..utils import mca_param
 from ..utils.debug import debug_verbose, warning
 
@@ -1251,29 +1252,14 @@ class SocketCommEngine(CommEngine):
         the comm boundary — the calling worker thread pays the D2H sync,
         not the comm thread, and the wire then ships raw array bytes.
         (Reference: datatype pack/unpack, parsec_comm_engine.h:113-183.)
-        numpy arrays, scalars and containers pass through.
+        numpy arrays, scalars and containers pass through; device arrays
+        start their D2H ASYNCHRONOUSLY before any is awaited and are
+        memoized by identity, so shared references snapshot (and pickle)
+        once — see :func:`~.device_plane.snapshot_host`.
         ``_dev_seen``: a one-element list set True when any device array
         was snapshotted — the sender-side tag that tells the receiver
         this payload belongs on the device (stage_recv_value)."""
-        import numpy as np
-        if value is None or isinstance(
-                value, (bool, int, float, complex, str, bytes, bytearray,
-                        np.ndarray, np.generic)):
-            return value
-        if isinstance(value, tuple):
-            return tuple(SocketCommEngine.wire_value(v, _dev_seen)
-                         for v in value)
-        if isinstance(value, list):
-            return [SocketCommEngine.wire_value(v, _dev_seen)
-                    for v in value]
-        if isinstance(value, dict):
-            return {k: SocketCommEngine.wire_value(v, _dev_seen)
-                    for k, v in value.items()}
-        if hasattr(value, "__array__"):     # jax.Array et al.
-            if _dev_seen is not None:
-                _dev_seen[0] = True
-            return np.asarray(value)
-        return value
+        return device_plane.snapshot_host(value, _dev_seen)
 
     def mem_register(self, buffer: Any) -> int:
         with self._mem_lock:
@@ -1381,11 +1367,16 @@ class SocketCommEngine(CommEngine):
         k+1 leaves, so a forwarding chain overlaps its receive of k+1
         with the children's receive of k (the pipelined-rendezvous
         overlap; remote_dep_mpi.c:1963-2118's GET/PUT legs collapse
-        into the stream)."""
+        into the stream). ``raws`` is either a raw-buffer list or a
+        :class:`~.device_plane.DeviceStreamSource`, whose segments are
+        resolved from async D2H fetches just before they ship — the
+        pipelined device staging (D2H of k overlaps the send of k−1)."""
         seg_b = max(4096, int(mca_param.cached_get("comm.segment_bytes",
                                                    128 * 1024)))
         direct = self._thread_multiple()
-        for seq, views in enumerate(self._segments(raws, seg_b)):
+        seg_iter = raws.segments(seg_b) if hasattr(raws, "segments") \
+            else self._segments(raws, seg_b)
+        for seq, views in enumerate(seg_iter):
             data = [pickle.PickleBuffer(v) for v in views]
             msg = {"sid": sid, "seq": seq, "data": data}
             seg_nb = sum(v.nbytes for v in views)
@@ -1420,24 +1411,39 @@ class SocketCommEngine(CommEngine):
         # (remote_dep_mpi.c:1089-1139) — a packed msg ranks by its most
         # urgent target
         msg["priority"] = max(t["priority"] for t in targets)
-        dev_seen = [False]
-        value = self.wire_value(refs[0].value, dev_seen)
-        if dev_seen[0]:
-            # receiver stages this payload back onto its device (the
-            # consumer side of a device-resident dataflow edge)
-            msg["dev"] = True
-        nbytes = self.payload_bytes(value)
+        rdv_push = str(mca_param.cached_get("comm.rdv_push", 1)).lower() \
+            not in ("0", "off", "false")
         eager_limit = int(mca_param.cached_get("comm.eager_limit", 256 * 1024))
         raws = None
-        if value is not None and nbytes > eager_limit:
-            if str(mca_param.cached_get("comm.rdv_push", 1)).lower() \
-                    not in ("0", "off", "false"):
-                raws = self._attach_stream(msg, value)
-            else:
-                msg["value_handle"] = self.mem_register(value)
-                msg["nbytes"] = nbytes
+        src = device_plane.make_stream_source(
+            refs[0].value, eager_limit, self._encode_value) \
+            if rdv_push else None
+        if src is not None:
+            # pipelined device stream (comm.device_pipeline): the head
+            # pickles _DevSlot placeholders, the bytes follow as
+            # DATA_SEG frames resolved from ASYNC per-segment D2H — no
+            # whole-value host snapshot ever happens
+            sid = self._new_sid()
+            msg["stream"] = {"sid": sid, **src.header()}
+            msg["nbytes"] = nbytes = src.total
+            msg["dev"] = True
+            raws = src
         else:
-            msg["value"] = value
+            dev_seen = [False]
+            value = self.wire_value(refs[0].value, dev_seen)
+            if dev_seen[0]:
+                # receiver stages this payload back onto its device (the
+                # consumer side of a device-resident dataflow edge)
+                msg["dev"] = True
+            nbytes = self.payload_bytes(value)
+            if value is not None and nbytes > eager_limit:
+                if rdv_push:
+                    raws = self._attach_stream(msg, value)
+                else:
+                    msg["value_handle"] = self.mem_register(value)
+                    msg["nbytes"] = nbytes
+            else:
+                msg["value"] = value
         self.record_msg("sent", "activate", target_rank, nbytes)
         self._span_sent(self._span_attach(tp, task, msg), target_rank,
                         nbytes)
@@ -1464,32 +1470,48 @@ class SocketCommEngine(CommEngine):
         tp = task.taskpool
         monitor = tp.monitor
         msg, parts, topo, fanout = self._bcast_envelope(tp, rank_refs)
-        dev_seen = [False]
         first = next(iter(rank_refs.values()))[0]
-        value = self.wire_value(first.value, dev_seen)
-        if dev_seen[0]:
-            msg["dev"] = True
-        nbytes = self.payload_bytes(value)
+        rdv_push = str(mca_param.cached_get("comm.rdv_push", 1)).lower() \
+            not in ("0", "off", "false")
         eager_limit = int(mca_param.cached_get("comm.eager_limit",
                                                256 * 1024))
-        if nbytes > eager_limit and \
-                str(mca_param.cached_get("comm.rdv_push", 1)).lower() \
-                in ("0", "off", "false"):
-            # comm.rdv_push=0 selects the classic registered-memory
-            # GET/PUT protocol, which cannot pipeline a payload down
-            # the tree (each hop would have to re-register and serve
-            # its own GETs) — honor the knob: one packed classic
-            # activation per consumer rank, no tree
-            for target_rank, refs in rank_refs.items():
-                self.remote_dep_activate_multi(task, target_rank, refs)
-            return
-        if nbytes > eager_limit:
-            raws = self._attach_stream(msg, value)
+        src = device_plane.make_stream_source(
+            first.value, eager_limit, self._encode_value) \
+            if rdv_push else None
+        if src is not None:
+            # pipelined device stream down the tree: forwarding nodes
+            # re-send the raw segments WITHOUT restaging (bytes only —
+            # no D2H/H2D round trip per hop); only local consumption
+            # stages
+            sid = self._new_sid()
+            msg["stream"] = {"sid": sid, **src.header()}
+            msg["nbytes"] = src.total
+            msg["dev"] = True
+            nbytes = src.total
+            raws = src
         else:
-            # below-eager: inline, without _attach_stream's throwaway
-            # trial serialization
-            msg["value"] = value
-            raws = None
+            dev_seen = [False]
+            value = self.wire_value(first.value, dev_seen)
+            if dev_seen[0]:
+                msg["dev"] = True
+            nbytes = self.payload_bytes(value)
+            if nbytes > eager_limit and not rdv_push:
+                # comm.rdv_push=0 selects the classic registered-memory
+                # GET/PUT protocol, which cannot pipeline a payload down
+                # the tree (each hop would have to re-register and serve
+                # its own GETs) — honor the knob: one packed classic
+                # activation per consumer rank, no tree
+                for target_rank, refs in rank_refs.items():
+                    self.remote_dep_activate_multi(task, target_rank,
+                                                   refs)
+                return
+            if nbytes > eager_limit:
+                raws = self._attach_stream(msg, value)
+            else:
+                # below-eager: inline, without _attach_stream's
+                # throwaway trial serialization
+                msg["value"] = value
+                raws = None
         children = bcast_live_children(topo, parts, self.rank, fanout,
                                        self.peer_alive)
         from ..utils import debug_history
@@ -1561,7 +1583,14 @@ class SocketCommEngine(CommEngine):
         state = {"sid": st["sid"], "buf": bytearray(st["nbytes"]),
                  "got": 0, "nbytes": st["nbytes"], "head": st["head"],
                  "sizes": st["sizes"], "msg": msg, "src": src,
-                 "tp": None, "fwd": ()}
+                 "tp": None, "fwd": (), "dev": st.get("dev"),
+                 # pipelined H2D: device-slot bytes are device_put as
+                 # their segments arrive (overlapping the receive of
+                 # the next segment); the host buf still fills in
+                 # parallel — forwarders and fallbacks read it
+                 "stager": device_plane.make_stager(
+                     st, tagged=msg.get("dev", False)),
+                 "fetch": None}
         self._rx_streams[st["sid"]] = state
         return state
 
@@ -1585,6 +1614,9 @@ class SocketCommEngine(CommEngine):
                 self.record_msg("sent", "seg", c, seg_nb)
                 self._send_frame(c, AMTag.DATA_SEG, out)
         buf, got = state["buf"], state["got"]
+        stager = state.get("stager")
+        if stager is not None:
+            stager.feed(got, msg["data"])
         for d in msg["data"]:
             n = d.nbytes if isinstance(d, memoryview) else len(d)
             buf[got:got + n] = d
@@ -1602,6 +1634,21 @@ class SocketCommEngine(CommEngine):
             views.append(mv[off:off + sz])
             off += sz
         value = pickle.loads(state["head"], buffers=views)
+        if state.get("dev"):
+            # device-slot resolution: the stager's on-device assemblies
+            # where segments staged cleanly, host views over the
+            # reassembly buffer otherwise (bit-identical either way)
+            slots = device_plane.resolve_dev_slots(
+                state["buf"], sum(state["sizes"]), state["dev"],
+                state.get("stager"))
+            value = device_plane.substitute_slots(value, slots)
+        if state.get("fetch") is not None:
+            # segmented TILE_FETCH reply: resolve the requester's future
+            with self._fetch_lock:
+                fut = self._fetch_futures.pop(state["fetch"], None)
+            if fut is not None and not fut.is_ready():
+                fut.set(("ok", value))
+            return
         msg = state["msg"]
         msg.pop("stream", None)
         tp = state["tp"]
@@ -1715,31 +1762,20 @@ class SocketCommEngine(CommEngine):
         from device-resident operands instead of paying a synchronous
         H2D at dispatch — the receive half of the reference's
         registered-memory PUT landing in device-visible memory
-        (remote_dep_mpi.c:1594-1729). Gated by ``comm.stage_recv``:
+        (remote_dep_mpi.c:1594-1729). Gated by ``comm.stage_recv``
+        through the shared :func:`~.device_plane.should_stage` gate:
         ``auto`` stages only payloads the SENDER tagged device-resident
         (``tagged``) on an accelerator backend — staging host-born
         payloads onto a slow link makes things WORSE (measured: a host
         pingpong over the tunnel went 3.8 ms -> 145 ms/hop when every
-        payload was device_put); ``1`` forces, ``0`` disables."""
-        import sys
+        payload was device_put); ``1`` forces, ``0`` disables. Never
+        initializes a backend from the comm thread. Values already
+        staged per segment by the pipelined rx path arrive as jax
+        arrays and pass through untouched."""
         import numpy as np
-        mode = str(mca_param.cached_get("comm.stage_recv", "auto"))
-        if mode in ("0", "off", "false"):
+        if not device_plane.should_stage(tagged):
             return value
-        if mode == "auto" and not tagged:
-            return value
-        # never INITIALIZE a backend from the comm thread: staging only
-        # applies when this process already uses jax (importing it here
-        # would spin up the accelerator runtime inside host-only rank
-        # processes — and raise/block on exclusive-access chips)
-        if "jax" not in sys.modules:
-            return value
-        try:
-            import jax
-            if mode == "auto" and jax.default_backend() == "cpu":
-                return value
-        except Exception:  # noqa: BLE001 — staging is best-effort
-            return value
+        import jax
 
         def stage(v):
             if isinstance(v, np.ndarray) and v.nbytes >= 4096:
@@ -1807,6 +1843,65 @@ class SocketCommEngine(CommEngine):
             st[1]()
         if msg.get("done_tag") is not None:
             self.send_am(msg["done_tag"], src, msg["handle"])
+
+    # ------------------------------------------ one-sided tile fetch
+    def _on_tile_fetch(self, src: int, msg: Any) -> None:
+        """Socket upgrade of the base tile-fetch service: replies above
+        the eager limit stream as DATA_SEG frames — device tiles leave
+        through the same pipelined per-segment async D2H as activation
+        payloads instead of one blocking whole-tile snapshot, and a
+        requester that asked for staging (``fetch_tiles(stage=True)``,
+        the HBM remote stage-in) reassembles them with per-segment H2D
+        straight into device memory."""
+        if msg.get("reply"):
+            st = msg.get("stream")
+            if st is not None:
+                state = self._open_rx_stream(src, msg)
+                state["fetch"] = msg["req"]
+                with self._fetch_lock:
+                    if not self._fetch_stage.pop(msg["req"], False):
+                        state["stager"] = None
+                return
+            if "error" in msg:
+                # the owner may have failed AFTER a stream-header reply
+                # (mid-stream send error): drop any rx stream opened
+                # for this request, or its reassembly buffer would
+                # outlive the failed future forever
+                for sid, state in list(self._rx_streams.items()):
+                    if state.get("fetch") == msg["req"]:
+                        del self._rx_streams[sid]
+            return super()._on_tile_fetch(src, msg)
+        rdv_push = str(mca_param.cached_get("comm.rdv_push", 1)).lower() \
+            not in ("0", "off", "false")
+        src_obj = None
+        if rdv_push:
+            try:
+                ident = (msg.get("scope", ""), msg["name"])
+                ref = self._exposed_colls.get(ident)
+                dc = ref() if ref is not None else None
+                if dc is not None:
+                    eager_limit = int(mca_param.cached_get(
+                        "comm.eager_limit", 256 * 1024))
+                    src_obj = device_plane.make_stream_source(
+                        dc.data_of(tuple(msg["key"])), eager_limit,
+                        self._encode_value)
+            except Exception:  # noqa: BLE001 — the base serve path
+                src_obj = None  # owns lookup-error shaping
+        if src_obj is None:
+            # small/host/error cases: the base protocol (lookup, error
+            # shaping, inline np reply) stays single-sourced
+            return super()._on_tile_fetch(src, msg)
+        try:
+            sid = self._new_sid()
+            reply = {"reply": True, "req": msg["req"], "dev": True,
+                     "stream": {"sid": sid, **src_obj.header()}}
+            self.send_am(AMTag.TILE_FETCH, src, reply)
+            self._send_stream((src,), sid, src_obj)
+        except Exception as exc:  # noqa: BLE001 — cross the wire, not die
+            # the requester drops its half-open rx stream on this reply
+            self.send_am(AMTag.TILE_FETCH, src,
+                         {"reply": True, "req": msg["req"],
+                          "error": str(exc)[:500]})
 
     def _on_dtd_control(self, src: int, msg: Dict) -> None:
         """Route DTD control messages (flush writebacks/acks) to the

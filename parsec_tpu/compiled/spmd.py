@@ -68,6 +68,70 @@ def shard_stores(stores: Dict[str, Any], mesh, axis: str = "tiles"):
 
 
 # ---------------------------------------------------------------------------
+# comm-mesh registry: the same-mesh detection of the device-direct data
+# plane (comm.device_direct). When the runtime's comm ranks map onto the
+# devices of ONE JAX mesh (the loopback fabric: one process, per-rank
+# chips; a single-controller pod slice the same way), a dep between two
+# ranks is an intra-mesh edge — the tile can move as an XLA device-to-
+# device transfer (jax.device_put onto the consumer's device, riding
+# ICI on real hardware) and only a control frame needs the wire.
+# ---------------------------------------------------------------------------
+
+_COMM_MESH = None
+
+
+def register_comm_mesh(mesh, rank_devices=None) -> None:
+    """Declare that comm rank ``r`` computes on ``rank_devices[r]``
+    (default: the mesh's devices in flat order, round-robin). The
+    device-direct path (``comm.device_direct=auto``) engages only once
+    a mesh is registered — detection, not hope."""
+    global _COMM_MESH
+    devs = list(rank_devices) if rank_devices is not None \
+        else list(mesh.devices.flat)
+    _COMM_MESH = (mesh, devs)
+
+
+def unregister_comm_mesh() -> None:
+    global _COMM_MESH
+    _COMM_MESH = None
+
+
+def comm_mesh():
+    """The registered ``(mesh, rank_devices)`` pair, or None."""
+    return _COMM_MESH
+
+
+def comm_mesh_device(rank: int):
+    """The device comm rank ``rank`` computes on under the registered
+    comm mesh, or None when no mesh is registered."""
+    if _COMM_MESH is None:
+        return None
+    devs = _COMM_MESH[1]
+    return devs[rank % len(devs)] if devs else None
+
+
+def same_mesh(src_rank: int, dst_rank: int) -> bool:
+    """Do both endpoints of a dep sit on one registered mesh whose
+    devices this process can address (the device-direct eligibility
+    test)? Multi-controller placements (a device owned by another
+    process) route through the wire instead. Shares the locality
+    predicate with the routing path (``device_plane.local_device``) so
+    detection can never drift from what routing actually does."""
+    from ..comm.device_plane import local_device
+    return local_device(comm_mesh_device(src_rank)) and \
+        local_device(comm_mesh_device(dst_rank))
+
+
+def mesh_of_value(value):
+    """The mesh a sharded value lives on (NamedSharding), or None —
+    the collection-sharding detection hook: a runtime that stores its
+    tiles mesh-sharded can register that mesh as the comm mesh."""
+    sh = getattr(value, "sharding", None)
+    mesh = getattr(sh, "mesh", None)
+    return mesh
+
+
+# ---------------------------------------------------------------------------
 # preferential-pjit compilation helper
 # ---------------------------------------------------------------------------
 

@@ -84,7 +84,11 @@ class HBMManager:
                       # eviction-policy split: victims chosen by the
                       # plan's next-use schedule (Belady) vs the LRU
                       # fallback (no schedule info on the victim)
-                      "evict_belady": 0, "evict_lru": 0}
+                      "evict_belady": 0, "evict_lru": 0,
+                      # owner-computes reads served by the remote
+                      # stage-in path (fetch_tiles: segmented fetch
+                      # straight into an HBM slot, no host copy kept)
+                      "remote_stage_in": 0}
 
     # ---------------------------------------------------------- internal
     def _zone_for(self, dev) -> ZoneAllocator:
@@ -304,6 +308,65 @@ class HBMManager:
                 dev = self._device_of(value)
                 e["offset"] = self._reserve(_nbytes(value), (key,), dev)
                 e["device"] = dev
+
+    def fetch_tiles(self, dc, keys_owners, comm, scope: str = "",
+                    next_use: Optional[int] = None,
+                    protect: Tuple[Hashable, ...] = ()) -> list:
+        """Owner-computes remote stage-in (ROADMAP item 1): resolve a
+        batch of collection tiles into DEVICE residency, treating
+        "remote chip" as a stage-in source. Local (or already-tracked)
+        tiles stage from the collection; remote tiles issue ONE
+        concurrent segmented fetch (``CommEngine.fetch_tiles(...,
+        stage=True)`` — per-segment H2D on the comm thread) and are
+        accounted straight into their HBM slots with the ``next_use``
+        hint intact, instead of materializing a host copy first.
+
+        Entries are keyed per ``scope`` (the gathering taskpool's name
+        — the cross-rank registry identity), so a tile re-gathered
+        across waves of one pool stays resident while a later pool can
+        never read a stale cached version. The same dataflow-ordering
+        contract as ``fetch_tile`` applies: the tile must be final on
+        its owner when this is called (CTL-gather). Returns values in
+        order."""
+        import weakref
+        pairs = list(keys_owners)
+        my_rank = getattr(comm, "rank", 0)
+        single = getattr(comm, "nb_ranks", 1) <= 1
+        dc_ref = weakref.ref(dc)
+
+        def _sweep_tag(_k, _host, dc_ref=dc_ref):
+            # no write-back: a fetched INPUT tile spills by dropping to
+            # host only. The dc weakref default is the context sweep's
+            # liveness tag (_hbm_entry_dead) — entries die with their
+            # collection.
+            return None
+
+        out: Dict[int, Any] = {}
+        fetch_slots, fetch_pairs = [], []
+        for i, (key, owner) in enumerate(pairs):
+            k = tuple(key) if isinstance(key, (tuple, list)) else (key,)
+            mkey = ("fetch", scope, id(dc), k)
+            with self._lock:
+                have = mkey in self._entries
+            if have:
+                out[i] = self.ensure(mkey, protect=protect,
+                                     next_use=next_use)
+            elif owner == my_rank or single:
+                out[i] = self.ensure(mkey, value=dc.data_of(key),
+                                     protect=protect, next_use=next_use,
+                                     spill=_sweep_tag)
+            else:
+                fetch_slots.append((i, mkey))
+                fetch_pairs.append((key, owner))
+        if fetch_pairs:
+            vals = comm.fetch_tiles(dc, fetch_pairs, scope=scope,
+                                    stage=True)
+            for (i, mkey), v in zip(fetch_slots, vals):
+                out[i] = self.ensure(mkey, value=v, protect=protect,
+                                     next_use=next_use, spill=_sweep_tag)
+                with self._lock:
+                    self.stats["remote_stage_in"] += 1
+        return [out[i] for i in range(len(pairs))]
 
     def value(self, key: Hashable) -> Any:
         """Current value (device or spilled host) without staging."""

@@ -72,6 +72,16 @@ class TPUDevice(Device):
         # inline-CPU device (reference GFLOPS table device_cuda_module.c:53)
         self.weight = 100.0 if self.platform != "cpu" else 2.0
         self.name = f"tpu{self.jax_device.id}"
+        if self.platform != "cpu":
+            # comm staging target: the pipelined receive path (per-
+            # segment device_put) and the HBM remote stage-in land
+            # bytes straight on this module's chip instead of bouncing
+            # through jax's default device (first accelerator module
+            # wins; CPU meshes keep uncommitted default placement —
+            # committing test arrays to one virtual device would make
+            # mixed-placement jits raise)
+            from ..comm import device_plane
+            device_plane.set_stage_target(self.jax_device)
         self._jit_cache: Dict[Any, Callable] = {}
         self._cache_lock = threading.Lock()
         # batching manager (progress_stream analog): workers enqueue

@@ -41,6 +41,15 @@ import numpy as np
 from ..comm.pingpong import _free_port_base
 from ..utils.stats import pctl as _pctl
 
+# ISSUE 12: the sawtooth runs with the device data plane ON (PR 11
+# flagged tile migration paying the 107 ms host hop as its remaining
+# item) — shard/KV tiles that are device-resident now take the
+# pipelined segmented path instead of the blocking snapshot. The bench
+# mesh itself is tpu-off with host tiles, so the pin is about capturing
+# the SHIPPED default, and migration-pause p99 is re-recorded under it
+# (PARITY elastic row).
+_DEVICE_PLANE_KNOBS = {"comm.device_pipeline": "1"}
+
 _TENANTS = ("t0", "t1", "t2", "t3")
 _DECODE_STEPS = 8
 _SHARD_TILES = 4
@@ -84,10 +93,8 @@ def _worker_main(rank: int, world: int, base_port: int, ckpt_dir: str,
         from ..serving.elastic import ElasticWorker
         from ..utils import mca_param
 
-        mca_param.set("comm.elastic", 1)
-        mca_param.set("runtime.stage_reads", "0")
-        mca_param.set("comm.stage_recv", "0")
-        mca_param.set("device.tpu.enabled", False)
+        from ..utils.benchenv import pin_wire_bench_env
+        pin_wire_bench_env(overrides=_DEVICE_PLANE_KNOBS | {"comm.elastic": 1})
         # a joiner into a LIVE mesh (live peer list provided — incl. a
         # reused drained slot like rank 1) takes the rejoin wireup; only
         # the original mesh members do the static full-mesh wireup
@@ -335,10 +342,8 @@ def measure_elastic(low_s: float = 4.0, high_s: float = 14.0,
     from ..serving.elastic import AutoscalePolicy, ElasticController
     from ..utils import mca_param
 
-    mca_param.set("comm.elastic", 1)
-    mca_param.set("runtime.stage_reads", "0")
-    mca_param.set("comm.stage_recv", "0")
-    mca_param.set("device.tpu.enabled", False)
+    from ..utils.benchenv import pin_wire_bench_env
+    pin_wire_bench_env(overrides=_DEVICE_PLANE_KNOBS | {"comm.elastic": 1})
     mca_param.set("serving.autoscale", "act")
     mca_param.set("serving.autoscale_poll_s", 0.15)
 

@@ -129,9 +129,8 @@ def _peer_main(rank: int, nb_ranks: int, base_port: int, rounds: int,
         from ..core import context as ctx_mod
         from ..utils import mca_param
 
-        mca_param.set("runtime.stage_reads", "0")
-        mca_param.set("comm.stage_recv", "0")
-        mca_param.set("device.tpu.enabled", False)
+        from ..utils.benchenv import pin_wire_bench_env
+        pin_wire_bench_env()
         if kill_after > 0:
             mca_param.set("comm.fault_inject", "kill")
             mca_param.set("comm.fault_inject_rank", rank)
@@ -205,9 +204,8 @@ def _run_phase(faulty: bool, duration_s: float, nb_ranks: int = 2,
     from ..serving import runtime as srt
     from ..utils import mca_param
 
-    mca_param.set("runtime.stage_reads", "0")
-    mca_param.set("comm.stage_recv", "0")
-    mca_param.set("device.tpu.enabled", False)
+    from ..utils.benchenv import pin_wire_bench_env
+    pin_wire_bench_env()
     mca_param.set("sched", "wfq")
 
     rounds = max(8, int(duration_s / max(delay_s, 1e-4)) // _CHAIN_TILES)

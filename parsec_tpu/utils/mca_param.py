@@ -150,6 +150,26 @@ class ParamRegistry:
                 p.override, p.has_override = None, False
                 self._generation += 1
 
+    def override_of(self, name: str) -> tuple:
+        """``(has_override, value)`` — the runtime-override layer only
+        (env/file/default layers are process-fixed). The save half of a
+        save/restore pair for harnesses that must pin knobs temporarily
+        inside a LIVE process (see :meth:`restore_override`): plain
+        unset() would destroy a caller's explicit pin."""
+        with self._lock:
+            p = self._params.get(name)
+            if p is None or not p.has_override:
+                return (False, None)
+            return (True, p.override)
+
+    def restore_override(self, name: str, saved: tuple) -> None:
+        """Restore a knob to its :meth:`override_of` snapshot."""
+        had, value = saved
+        if had:
+            self.set(name, value)
+        else:
+            self.unset(name)
+
     def generation(self) -> int:
         """Monotonic counter bumped by set()/unset(): hot paths cache a
         resolved value keyed by this instead of re-resolving per call
@@ -195,6 +215,8 @@ register = _registry.register
 get = _registry.get
 set = _registry.set
 unset = _registry.unset
+override_of = _registry.override_of
+restore_override = _registry.restore_override
 dump = _registry.dump
 generation = _registry.generation
 cached_get = _registry.cached_get

@@ -65,7 +65,15 @@ def _fat_result():
                         "rdv_1M_p50_us": 3521.0,
                         "device_64k_p50_us": 132313.2,
                         "device_64k_link_us": 120000.0,
-                        "device_64k_runtime_us": 12313.2},
+                        "device_64k_runtime_us": 12313.2,
+                        # ISSUE 12 device-plane rows
+                        "device_64k_nopipe_p50_us": 232313.2,
+                        "host_64k_p50_us": 31000.5,
+                        "device_hop_ratio": 4.27,
+                        "device_64k_overlap_pct": 38.2,
+                        "device_pipeline_ab_ok": True,
+                        "ici_64k_p50_us": 787.8,
+                        "ici_64k_wire_bytes_per_hop": 148.0},
             "extra_configs": extras,
         },
     }
@@ -172,6 +180,36 @@ def test_native_taskrate_keys_registered_and_guarded():
         "p99_ms": 13.7}
     compact = json.loads(bench._compact_summary(result))
     assert compact["detail"]["serving_native_ratio"] == 2.26
+
+
+def test_device_plane_keys_registered_and_guarded():
+    """ISSUE 12 bench contract: the device-plane rows land in the
+    compact summary, and the device hop p50, the device/host hop RATIO
+    and the ICI hop all ride the latency rise-guard — the device-direct
+    win cannot silently regress."""
+    bench = _load_bench()
+    for key in ("device_64k_p50_us", "device_hop_ratio",
+                "ici_64k_p50_us"):
+        assert key in bench._LATENCY_GUARD_KEYS, key
+    compact = json.loads(bench._compact_summary(_fat_result()))
+    d = compact["detail"]
+    assert d["device_hop_ratio"] == 4.27
+    assert d["device_64k_nopipe_p50_us"] == 232313.2
+    assert d["ici_64k_p50_us"] == 787.8
+    assert d["ici_64k_wire_bytes_per_hop"] == 148.0
+    # full-detail-only rows stay OUT of the size-capped compact line
+    assert "device_64k_overlap_pct" not in d
+    assert "host_64k_p50_us" not in d
+    prior = {"device_64k_p50_us": 10000.0, "device_hop_ratio": 3.0,
+             "ici_64k_p50_us": 800.0}
+    out = bench._compare_captures(
+        {"device_64k_p50_us": 10500.0,       # +5%: inside the band
+         "device_hop_ratio": 4.9,            # +63%: ratio fires
+         "ici_64k_p50_us": 780.0}, prior)    # improvement: quiet
+    reg = out["latency_regression"]
+    assert "device_hop_ratio" in reg, reg
+    assert "ici_64k_p50_us" not in reg and \
+        "device_64k_p50_us" not in reg, reg
 
 
 def test_compare_captures_flags_latency_rise_only_on_worsening():

@@ -1,0 +1,568 @@
+"""Device-direct data plane (ISSUE 12): wire_value/stage_recv round
+trips on nested mixed host/device containers (shared-ref dedup, dev-tag
+propagation), segmented device payloads reassembling bitwise with
+``comm.device_pipeline`` on AND off, a binomial forwarding-node case,
+the same-mesh ICI loopback path, and the HBM remote stage-in."""
+
+import multiprocessing as mp
+import os
+import pickle
+import socket
+
+import numpy as np
+import pytest
+
+from parsec_tpu.comm import device_plane as dp
+from parsec_tpu.comm.socket_engine import SocketCommEngine
+from parsec_tpu.utils import mca_param
+
+_MP_SKIP = pytest.mark.skipif(
+    os.environ.get("PARSEC_SKIP_MP") == "1",
+    reason="multiprocess tests disabled")
+
+
+# ------------------------------------------------------------ unit layer
+
+def test_wire_value_nested_dedup_and_tag():
+    """Nested tuple/list/dict mixing host numpy and device arrays:
+    device leaves snapshot to host with the dev tag set, host leaves
+    pass through UNTOUCHED (same object), and a device array referenced
+    twice snapshots to ONE numpy object — protocol-5 pickle then ships
+    its bytes once (the shared-ref dedup)."""
+    import jax.numpy as jnp
+    a = jnp.arange(4096, dtype=jnp.float32)
+    h = np.arange(32, dtype=np.float64)
+    val = {"x": a, "seq": [a, h, (a, {"inner": h, "s": "str"}, 5)]}
+    seen = [False]
+    out = SocketCommEngine.wire_value(val, seen)
+    assert seen[0] is True
+    assert isinstance(out["x"], np.ndarray)
+    assert out["x"] is out["seq"][0] is out["seq"][2][0]
+    assert out["seq"][1] is h            # host leaves pass by identity
+    assert out["seq"][2][1]["inner"] is h
+    assert out["seq"][2][2] == 5
+    np.testing.assert_array_equal(out["x"], np.asarray(a))
+    bufs = []
+    pickle.dumps(out, protocol=5, buffer_callback=bufs.append)
+    # one out-of-band buffer per DISTINCT array: a + h, not 3*a + 2*h
+    assert len(bufs) == 2, [b.raw().nbytes for b in bufs]
+    # host-only containers never set the tag
+    seen2 = [False]
+    SocketCommEngine.wire_value({"h": h, "t": (1, 2)}, seen2)
+    assert seen2[0] is False
+
+
+def _roundtrip_stream(val, eager_limit=64 * 1024, seg_bytes=16 * 1024,
+                      stage=False):
+    """Sender→receiver simulation of one device stream at the byte
+    level (the exact _send_stream / _on_data_seg / _finish_stream
+    dataflow, without sockets)."""
+    src = dp.make_stream_source(val, eager_limit,
+                                SocketCommEngine._encode_value)
+    if src is None:
+        return None
+    hdr = src.header()
+    stager = dp.make_stager({"sid": 0, **hdr}, tagged=True) \
+        if stage else None
+    buf = bytearray(src.total)
+    got = 0
+    for views in src.segments(seg_bytes):
+        if stager is not None:
+            stager.feed(got, views)
+        for v in views:
+            mv = v if isinstance(v, memoryview) else memoryview(v)
+            mv = mv.cast("B") if mv.ndim != 1 or mv.itemsize != 1 else mv
+            buf[got:got + mv.nbytes] = mv
+            got += mv.nbytes
+    assert got == src.total
+    views = []
+    off = 0
+    mv = memoryview(buf)
+    for sz in hdr["sizes"]:
+        views.append(mv[off:off + sz])
+        off += sz
+    skel = pickle.loads(hdr["head"], buffers=views)
+    slots = dp.resolve_dev_slots(buf, sum(hdr["sizes"]), hdr["dev"],
+                                 stager)
+    return dp.substitute_slots(skel, slots)
+
+
+@pytest.mark.parametrize("stage", [False, True])
+def test_stream_source_roundtrip_bitwise(stage):
+    """Mixed container through the segmented device stream: bitwise
+    reassembly with the per-segment stager (stage=True forces H2D on
+    CPU via comm.stage_recv=1) AND through the host fallback, shared
+    slots resolving to one object."""
+    import jax
+    import jax.numpy as jnp
+    big = jnp.arange(50000, dtype=jnp.float32)          # 200 KB
+    oddsz = jnp.arange(777, dtype=jnp.float64)          # pad-forcing
+    hosts = np.arange(100, dtype=np.float32)
+    val = {"t": big, "pair": (big, oddsz), "h": hosts, "n": 7}
+    if stage:
+        mca_param.set("comm.stage_recv", "1")
+    try:
+        final = _roundtrip_stream(val, stage=stage)
+    finally:
+        mca_param.unset("comm.stage_recv")
+    assert final is not None, "stream source should engage above eager"
+    np.testing.assert_array_equal(np.asarray(final["t"]),
+                                  np.asarray(big))
+    np.testing.assert_array_equal(np.asarray(final["pair"][1]),
+                                  np.asarray(oddsz))
+    np.testing.assert_array_equal(final["h"], hosts)
+    assert final["n"] == 7
+    assert final["t"] is final["pair"][0]      # dedup round-trips
+    if stage:
+        assert isinstance(final["t"], jax.Array)
+
+
+def test_stream_source_respects_pipeline_knob_and_eager():
+    import jax.numpy as jnp
+    big = jnp.arange(50000, dtype=jnp.float32)
+    assert dp.make_stream_source(
+        big, 64 * 1024, SocketCommEngine._encode_value) is not None
+    # below the eager limit: inline path (async snapshot), no stream
+    assert dp.make_stream_source(
+        big, 1 << 20, SocketCommEngine._encode_value) is None
+    mca_param.set("comm.device_pipeline", "0")
+    try:
+        assert dp.make_stream_source(
+            big, 64 * 1024, SocketCommEngine._encode_value) is None
+    finally:
+        mca_param.unset("comm.device_pipeline")
+    # host-only payloads never take the device stream
+    assert dp.make_stream_source(
+        np.zeros(1 << 18, np.float32), 64 * 1024,
+        SocketCommEngine._encode_value) is None
+
+
+def test_stager_misaligned_feed_falls_back_bitwise():
+    """A forwarder's merged catch-up segment can split a device raw at
+    a non-element boundary: the stager must mark the slot fallback (not
+    assemble garbage) and the host buffer must still serve it bitwise."""
+    import jax.numpy as jnp
+    big = jnp.arange(50000, dtype=jnp.float32)
+    src = dp.make_stream_source(big, 64 * 1024,
+                                SocketCommEngine._encode_value)
+    hdr = src.header()
+    mca_param.set("comm.stage_recv", "1")
+    try:
+        stager = dp.make_stager({"sid": 0, **hdr}, tagged=True)
+        assert stager is not None
+        buf = bytearray(src.total)
+        got = 0
+        for views in src.segments(16 * 1024):
+            for v in views:
+                mv = v if isinstance(v, memoryview) else memoryview(v)
+                mv = mv.cast("B") if mv.ndim != 1 or mv.itemsize != 1 \
+                    else mv
+                buf[got:got + mv.nbytes] = mv
+                got += mv.nbytes
+        # one merged catch-up blob at offset 0 ending mid-element, then
+        # the rest — the first chunk is misaligned at its tail
+        cut = sum(hdr["sizes"]) + 6
+        stager.feed(0, [memoryview(buf)[:cut]])
+        stager.feed(cut, [memoryview(buf)[cut:]])
+        slots = dp.resolve_dev_slots(buf, sum(hdr["sizes"]),
+                                     hdr["dev"], stager)
+    finally:
+        mca_param.unset("comm.stage_recv")
+    final = dp.substitute_slots(
+        pickle.loads(hdr["head"],
+                     buffers=[memoryview(buf)[:sum(hdr["sizes"])]]),
+        slots)
+    assert isinstance(final, np.ndarray)       # fallback, not device
+    np.testing.assert_array_equal(np.asarray(final), np.asarray(big))
+
+
+# ------------------------------------------------- same-mesh ICI (direct)
+
+def test_device_direct_gating_and_placement():
+    """auto = off without a registered comm mesh; registering one (the
+    same-mesh detection, compiled/spmd.py) turns it on; place_value is
+    bitwise pure data movement; =0 always wins."""
+    import jax
+    import jax.numpy as jnp
+    from parsec_tpu.compiled import spmd
+
+    assert spmd.comm_mesh() is None
+    assert dp.direct_device_for(1) is None       # auto without a mesh
+    spmd.register_comm_mesh(spmd.make_mesh())
+    try:
+        dev = dp.direct_device_for(1)
+        assert dev is not None
+        assert spmd.same_mesh(0, 1)
+        v = {"a": jnp.arange(256.0), "b": np.arange(8)}
+        placed = dp.place_value(v, dev)
+        assert isinstance(placed["a"], jax.Array)
+        np.testing.assert_array_equal(np.asarray(placed["a"]),
+                                      np.asarray(v["a"]))
+        assert placed["b"] is v["b"]             # host leaves untouched
+        mca_param.set("comm.device_direct", "0")
+        assert dp.direct_device_for(1) is None
+    finally:
+        mca_param.unset("comm.device_direct")
+        spmd.unregister_comm_mesh()
+    assert dp.direct_device_for(1) is None
+
+
+def test_ici_loopback_hop_bypasses_host():
+    """The bench's ICI row mechanism: 2 loopback ranks over a
+    registered comm mesh bounce a 64 KB device payload device-to-device
+    — the wire counters see only control frames."""
+    from parsec_tpu.comm.pingpong import measure_ici_latency
+    r = measure_ici_latency(payload_bytes=1 << 16, hops=8)
+    assert r["host_bypass"], r
+    assert r["wire_bytes_per_hop"] < 4096
+    assert r["p50_us"] > 0
+
+
+# ------------------------------------------------------ HBM stage-in
+
+class _FakeComm:
+    rank = 0
+    nb_ranks = 2
+
+    def __init__(self, tile):
+        self.tile = tile
+        self.calls = []
+
+    def fetch_tiles(self, dc, pairs, timeout=120.0, scope="",
+                    stage=False):
+        self.calls.append((list(pairs), scope, stage))
+        return [self.tile for _ in pairs]
+
+
+class _OneTileDC:
+    name = "dc"
+
+    def __init__(self, local):
+        self.local = local
+
+    def data_of(self, key):
+        assert tuple(key) == (0,), key           # only tile 0 is local
+        return self.local
+
+    def rank_of(self, key):
+        return key[0] % 2
+
+
+def test_hbm_fetch_tiles_remote_stage_in():
+    """Remote tiles stage straight into HBM slots (segmented fetch with
+    stage=True), next-use hints intact, re-gathers within one scope hit
+    the slot without a second wire trip, and the stats row counts."""
+    import jax
+    from parsec_tpu.device.hbm import HBMManager
+
+    remote = np.arange(1024, dtype=np.float32)
+    local = np.arange(1024, 2048, dtype=np.float32)
+    dc = _OneTileDC(local)
+    comm = _FakeComm(remote)
+    mgr = HBMManager(8 << 20)
+    vals = mgr.fetch_tiles(dc, [((0,), 0), ((1,), 1)], comm,
+                           scope="tp0", next_use=5)
+    assert comm.calls == [([((1,), 1)], "tp0", True)]
+    assert isinstance(vals[0], jax.Array) and isinstance(vals[1],
+                                                         jax.Array)
+    np.testing.assert_array_equal(np.asarray(vals[0]), local)
+    np.testing.assert_array_equal(np.asarray(vals[1]), remote)
+    assert mgr.stats["remote_stage_in"] == 1
+    ent = mgr._entries[("fetch", "tp0", id(dc), (1,))]
+    assert ent["next_use"] == 5                  # hint survived
+    # second gather in the SAME scope: slot hit, no second fetch
+    vals2 = mgr.fetch_tiles(dc, [((1,), 1)], comm, scope="tp0")
+    assert len(comm.calls) == 1
+    assert vals2[0] is vals[1]
+    # a DIFFERENT scope never reads the cached slot (stale-version
+    # protection): it re-fetches
+    mgr.fetch_tiles(dc, [((1,), 1)], comm, scope="tp1")
+    assert len(comm.calls) == 2
+
+
+def test_hbm_fetch_entries_sweepable():
+    """Fetched entries carry the dc-weakref liveness tag the context
+    sweep uses — a dead collection's staged tiles are reclaimed."""
+    from parsec_tpu.core.context import _hbm_entry_dead
+    from parsec_tpu.device.hbm import HBMManager
+
+    dc = _OneTileDC(np.arange(16, dtype=np.float32))
+    comm = _FakeComm(np.arange(64, dtype=np.float32))
+    mgr = HBMManager(1 << 20)
+    mgr.fetch_tiles(dc, [((1,), 1)], comm, scope="tp0")
+    key = ("fetch", "tp0", id(dc), (1,))
+    assert not _hbm_entry_dead(key, mgr._entries[key])
+    del dc
+    import gc
+    gc.collect()
+    assert _hbm_entry_dead(key, mgr._entries[key])
+    assert mgr.sweep(_hbm_entry_dead) == 1
+
+
+# -------------------------------------------------- socket round trips
+# (child processes; scenario fns must be module-level for spawn pickling)
+
+def _free_port_base() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    base = s.getsockname()[1]
+    s.close()
+    return 20000 + (base % 20000)
+
+
+def _child_main(fn_name, rank, nb_ranks, base_port, q, knobs, kwargs):
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from parsec_tpu.comm.socket_engine import SocketCommEngine
+        from parsec_tpu.core import context as ctx_mod
+
+        for k, v in (knobs or {}).items():
+            mca_param.set(k, v)
+        engine = SocketCommEngine(rank, nb_ranks, base_port=base_port)
+        ctx = ctx_mod.init(nb_cores=2, comm=engine)
+        result = globals()[fn_name](ctx, engine, rank, nb_ranks,
+                                    **kwargs)
+        engine.sync()
+        ctx.fini()
+        q.put((rank, "ok", result))
+    except BaseException as exc:  # noqa: BLE001 — report to parent
+        import traceback
+        q.put((rank, "error", f"{exc}\n{traceback.format_exc()}"))
+
+
+def _run_ranks(fn_name, nb_ranks, knobs=None, timeout=120.0, **kwargs):
+    ctx = mp.get_context("spawn")
+    base_port = _free_port_base()
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_child_main,
+                         args=(fn_name, r, nb_ranks, base_port, q,
+                               knobs, kwargs))
+             for r in range(nb_ranks)]
+    for p in procs:
+        p.start()
+    results = {}
+    try:
+        for _ in range(nb_ranks):
+            rank, status, payload = q.get(timeout=timeout)
+            if status != "ok":
+                raise AssertionError(f"rank {rank} failed:\n{payload}")
+            results[rank] = payload
+    finally:
+        for p in procs:
+            p.join(timeout=10.0)
+            if p.is_alive():
+                p.terminate()
+    return results
+
+
+class _DistVec:
+    def __init__(self, n, nb_ranks, my_rank):
+        self.n = n
+        self.nb_ranks = nb_ranks
+        self.my_rank = my_rank
+        self.dc_id = 9
+        self.v = {}
+
+    def _k(self, key):
+        return key[0] if isinstance(key, (tuple, list)) else key
+
+    def rank_of(self, key):
+        return self._k(key) % self.nb_ranks
+
+    def data_of(self, key):
+        return self.v[self._k(key)]
+
+    def write_tile(self, key, value):
+        self.v[self._k(key)] = value
+
+
+def scenario_device_stream_chain(ctx, engine, rank, nb_ranks,
+                                 n=60000, steps=4):
+    """Device-resident rendezvous payloads (240 KB > 64 KB eager) bounce
+    between ranks as NESTED containers mixing device and host arrays:
+    every hop takes the segmented device stream when the pipeline is on
+    (the knob parametrizes the test), and the end value must be bitwise
+    whatever the knob says."""
+    import jax.numpy as jnp
+    from parsec_tpu.dsl import ptg
+
+    mca_param.set("comm.eager_limit", 64 * 1024)
+    mca_param.set("comm.segment_bytes", 32 * 1024)
+    A = _DistVec(steps, nb_ranks, rank)
+    if A.rank_of((0,)) == rank:
+        A.v[0] = np.zeros(n, dtype=np.float32)
+    tp = ptg.Taskpool("devchain", A=A, N=steps)
+    tp.task_class(
+        "STEP", params=("k",),
+        space=lambda g: ((k,) for k in range(g.N)),
+        affinity=lambda g, k: (g.A, (k,)),
+        flows=[ptg.FlowSpec(
+            "T", ptg.RW,
+            ins=[ptg.In(data=lambda g, k: (g.A, (0,)),
+                        guard=lambda g, k: k == 0),
+                 ptg.In(src=("STEP", lambda g, k: (k - 1,), "T"),
+                        guard=lambda g, k: k > 0)],
+            outs=[ptg.Out(dst=("STEP", lambda g, k: (k + 1,), "T"),
+                          guard=lambda g, k: k < g.N - 1),
+                  ptg.Out(data=lambda g, k: (g.A, (g.N - 1,)),
+                          guard=lambda g, k: k == g.N - 1)])])
+
+    @tp.task_class_by_name("STEP").body(batchable=False)
+    def step_body(task, T):
+        if isinstance(T, dict):            # unwrap the shipped container
+            arr, tag, shared = T["x"], T["tag"], T["x2"]
+            # the device payload is referenced TWICE in the container:
+            # the dedup must survive the wire on every path
+            assert np.array_equal(np.asarray(shared), np.asarray(arr))
+            assert tag == "host-meta"
+            assert np.array_equal(T["meta"],
+                                  np.arange(4, dtype=np.int64))
+        else:
+            arr = T
+        dev = jnp.asarray(arr) + 1.0       # device-resident result
+        # a top-level dict return is a flow-name map (device._normalize)
+        # — nest the mixed container under the flow name
+        return {"T": {"x": dev, "x2": dev, "tag": "host-meta",
+                      "meta": np.arange(4, dtype=np.int64)}}
+
+    ctx.add_taskpool(tp)
+    ctx.start()
+    assert ctx.wait(timeout=90), f"rank {rank}: chain hung"
+    last = steps - 1
+    if A.rank_of((last,)) == rank:
+        final = A.v[last]
+        arr = np.asarray(final["x"] if isinstance(final, dict)
+                         else final)
+        np.testing.assert_array_equal(
+            arr, np.full(n, float(steps), dtype=np.float32))  # bitwise
+    return engine.wire_stats()["segs_recv"]
+
+
+@_MP_SKIP
+@pytest.mark.parametrize("pipeline", ["1", "0"])
+def test_device_stream_chain_bitwise(pipeline):
+    res = _run_ranks("scenario_device_stream_chain", 2,
+                     knobs={"comm.device_pipeline": pipeline})
+    # both regimes ride the segmented wire (the knob changes STAGING,
+    # not the transport): segments flowed either way
+    assert sum(res.values()) > 0
+
+
+@_MP_SKIP
+def test_device_stream_chain_staged_recv():
+    """comm.stage_recv=1 forces the per-segment H2D stager on CPU: the
+    chain must still be bitwise with device-staged arrivals."""
+    _run_ranks("scenario_device_stream_chain", 2,
+               knobs={"comm.device_pipeline": "1",
+                      "comm.stage_recv": "1"})
+
+
+def scenario_device_bcast(ctx, engine, rank, nb_ranks, n=60000):
+    """One device-resident value broadcast to every other rank down a
+    binomial tree: the FORWARDING node re-sends raw segments without
+    restaging (no D2H/H2D on the relay), and every leaf reassembles
+    bitwise."""
+    import jax.numpy as jnp
+    from parsec_tpu.dsl import ptg
+
+    mca_param.set("comm.eager_limit", 64 * 1024)
+    mca_param.set("comm.segment_bytes", 32 * 1024)
+    mca_param.set("comm.bcast_topology", "binomial")
+    A = _DistVec(nb_ranks, nb_ranks, rank)
+    tp = ptg.Taskpool("devbcast", A=A, P=nb_ranks)
+    tp.task_class(
+        "SRC", params=("k",),
+        space=lambda g: ((0,),),
+        affinity=lambda g, k: (g.A, (0,)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(data=lambda g, k: (g.A, (0,)))],
+            outs=[ptg.Out(dst=("SINK", lambda g, k: [
+                (r,) for r in range(1, g.P)], "X"))])])
+    tp.task_class(
+        "SINK", params=("r",),
+        space=lambda g: ((r,) for r in range(1, g.P)),
+        affinity=lambda g, r: (g.A, (r,)),
+        flows=[ptg.FlowSpec(
+            "X", ptg.RW,
+            ins=[ptg.In(src=("SRC", lambda g, r: (0,), "X"))],
+            outs=[ptg.Out(data=lambda g, r: (g.A, (r,)))])])
+    if rank == 0:
+        A.v[0] = np.zeros(1, dtype=np.float32)
+
+    @tp.task_class_by_name("SRC").body(batchable=False)
+    def src_body(task, X):
+        return jnp.arange(n, dtype=jnp.float32) * 0.5
+
+    @tp.task_class_by_name("SINK").body(batchable=False)
+    def sink_body(task, X):
+        return np.asarray(X)
+
+    ctx.add_taskpool(tp)
+    ctx.start()
+    assert ctx.wait(timeout=90), f"rank {rank}: bcast hung"
+    if rank != 0:
+        got = np.asarray(A.v[rank])
+        np.testing.assert_array_equal(
+            got, np.arange(n, dtype=np.float32) * np.float32(0.5))
+    bk = engine.stats_by_kind.get("bcast", {})
+    return {"fwd_payloads": bk.get("sent_msgs", 0),
+            "segs_sent": engine.wire_stats()["segs_sent"]}
+
+
+@_MP_SKIP
+def test_device_bcast_binomial_forwarding_bitwise():
+    res = _run_ranks("scenario_device_bcast", 4,
+                     knobs={"comm.device_pipeline": "1"})
+    # binomial over 4 ranks: root egress capped by fanout=2; total tree
+    # edges = P-1, so SOME non-root rank forwarded (and must have
+    # re-sent segments — forwarding without restaging)
+    fwd = [r["fwd_payloads"] for rk, r in sorted(res.items()) if rk != 0]
+    assert sum(fwd) >= 1, res
+    assert all(r["segs_sent"] > 0 for rk, r in res.items()
+               if r["fwd_payloads"]), res
+
+
+def scenario_hbm_stage_in_potrf(ctx, engine, rank, nb_ranks, n=192,
+                                nb=32):
+    """The flagship left-looking POTRF with the HBM manager active and
+    stage-through reads forced: UPDATE's gathered remote operands go
+    through HBMManager.fetch_tiles (segmented fetch → device slot) and
+    the factorization must still be numerically correct."""
+    from parsec_tpu.algorithms.potrf import build_potrf_left
+    from parsec_tpu.data.matrix import TiledMatrix, TwoDimBlockCyclic
+    from parsec_tpu.device.hbm import HBMManager
+
+    mca_param.set("runtime.stage_reads", "1")
+    mca_param.set("comm.stage_recv", "1")
+    ctx.hbm = HBMManager(64 << 20)
+    rng = np.random.default_rng(0)
+    M = rng.standard_normal((n, n)).astype(np.float64)
+    A_host = (M @ M.T + n * np.eye(n)).astype(np.float32)
+    dist = TwoDimBlockCyclic(P=nb_ranks, Q=1)
+    A = TiledMatrix.from_array(A_host.copy(), nb, nb, dist=dist,
+                               myrank=rank, name="A")
+    tp = build_potrf_left(A)
+    ctx.add_taskpool(tp)
+    ctx.start()
+    assert ctx.wait(timeout=90), f"rank {rank}: potrf hung"
+    L_ref = np.linalg.cholesky(A_host.astype(np.float64))
+    for (i, j) in A.local_keys():
+        if j > i:
+            continue
+        tile = np.asarray(A.data_of((i, j)), dtype=np.float64)
+        ref = L_ref[i * nb:(i + 1) * nb, j * nb:(j + 1) * nb]
+        if i == j:
+            tile = np.tril(tile)
+        err = np.linalg.norm(tile - ref) / max(1e-30,
+                                               np.linalg.norm(ref))
+        assert err < 1e-3, f"rank {rank} tile ({i},{j}) err {err}"
+    return ctx.hbm.stats["remote_stage_in"]
+
+
+@_MP_SKIP
+def test_hbm_remote_stage_in_potrf_2ranks():
+    res = _run_ranks("scenario_hbm_stage_in_potrf", 2)
+    # at least one rank's gathered operands crossed the wire into a slot
+    assert sum(res.values()) >= 1, res
