@@ -35,12 +35,33 @@ _build_error: Optional[str] = None
 
 BODY_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_uint32, ctypes.c_int32)
 
-#: pdtd_stats slot names, in the C ABI's out[16] order
+#: pdtd_stats slot names, in the C ABI's out[20] order. The obs_* rows
+#: are the native observability plane (ISSUE 13): records written to /
+#: dropped from the per-worker event rings, plus the current ring depth
+#: (a gauge — excluded from the context's retired-pool folding, like
+#: inflight/ready).
 PDTD_STAT_KEYS = (
     "inserted", "linked_deps", "ready_pushed", "popped", "stolen",
     "overflow_pushed", "completed_native", "completed_python",
     "released_edges", "output_drops", "dropped_cancelled",
-    "ring_highwater", "inflight", "ready", "pump_calls", "reserved")
+    "ring_highwater", "inflight", "ready", "pump_calls",
+    "obs_recorded", "obs_dropped", "obs_ring_depth",
+    "reserved", "reserved")
+
+#: numpy dtype mirroring the C PdtdObsRec (48-byte fixed stride): one
+#: binary record per completed native-engine task, expanded to the
+#: PR 9 trace-record format at scrape time (profiling/trace.py)
+OBS_REC_FIELDS = [("t0_ns", "<u8"), ("t1_ns", "<u8"), ("q_ns", "<u8"),
+                  ("span", "<u8"), ("seq", "<u4"), ("parent_seq", "<u4"),
+                  ("cls", "<u4"), ("worker", "<i4")]
+OBS_PARENT_NONE = 0xFFFFFFFF
+
+
+def obs_dtype():
+    import numpy as np
+    dt = np.dtype(OBS_REC_FIELDS)
+    assert dt.itemsize == 48, dt.itemsize   # must match the C struct
+    return dt
 
 
 def _src_hash() -> str:
@@ -121,7 +142,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pdtd_insert.argtypes = [p, u32, ctypes.POINTER(i32),
                                 ctypes.POINTER(ctypes.c_uint8),
                                 ctypes.POINTER(u32), ctypes.POINTER(u32),
-                                ctypes.POINTER(ctypes.c_uint8)]
+                                ctypes.POINTER(ctypes.c_uint8), u32]
     lib.pdtd_insert.restype = ctypes.c_int64
     lib.pdtd_arm.argtypes = [p, u32, u32]
     lib.pdtd_pump.argtypes = [p, ctypes.c_int, ctypes.POINTER(u32)]
@@ -131,10 +152,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pdtd_pump_batch.restype = ctypes.c_int
     lib.pdtd_complete.argtypes = [p, ctypes.c_int, u32,
                                   ctypes.POINTER(u32), i32,
-                                  ctypes.POINTER(i32)]
+                                  ctypes.POINTER(i32), u64, u64]
     lib.pdtd_complete.restype = ctypes.c_int
     lib.pdtd_complete_batch.argtypes = [p, ctypes.c_int,
-                                        ctypes.POINTER(u32), ctypes.c_int]
+                                        ctypes.POINTER(u32), ctypes.c_int,
+                                        ctypes.POINTER(u64)]
     lib.pdtd_complete_batch.restype = ctypes.c_int
     lib.pdtd_inflight.argtypes = [p]
     lib.pdtd_inflight.restype = u32
@@ -144,6 +166,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pdtd_wait_below.restype = u32
     lib.pdtd_cancel.argtypes = [p]
     lib.pdtd_stats.argtypes = [p, ctypes.POINTER(u64)]
+    # pdtd observability plane (ISSUE 13): per-worker event rings
+    lib.pdtd_obs_now.argtypes = []
+    lib.pdtd_obs_now.restype = u64
+    lib.pdtd_obs_enable.argtypes = [p, u64, u32]
+    lib.pdtd_obs_enable.restype = ctypes.c_int
+    lib.pdtd_obs_disable.argtypes = [p]
+    lib.pdtd_obs_drain.argtypes = [p, ctypes.c_int, p, u32]
+    lib.pdtd_obs_drain.restype = ctypes.c_int
     # foundation classes (reference parsec/class/*)
     lib.plifo_new.argtypes = [u32]
     lib.plifo_new.restype = p

@@ -44,6 +44,15 @@
 #include <unordered_map>
 #include <vector>
 
+// monotonic ns for the observability rings (same clock family as
+// Python's time.perf_counter — the loader still measures the exact
+// offset with a pdtd_obs_now handshake rather than assuming it)
+static inline uint64_t pdtd_now_ns() {
+  return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
 extern "C" {
 
 // ---------------------------------------------------------------------------
@@ -701,7 +710,49 @@ struct PdtdTask {
   bool done = false;
   bool armed = false;
   bool ready_deferred = false;    // goal met before arming
+  // observability slots (pdtd_obs_enable; untouched when obs is off):
+  // ready/select stamps feed the per-task queue-wait, parent_seq is the
+  // predecessor whose completion made this task ready — the dependency
+  // edge the span tree parents on (exactly complete_task's rule on the
+  // Python engine), cls is the insert-side class id the adapter
+  // expands to the task-class name at scrape time
+  uint64_t t_ready_ns = 0;
+  uint64_t t_sel_ns = 0;
+  uint32_t cls = 0;
+  uint32_t parent_seq = 0xffffffffu;
 };
+
+// one fixed-stride binary trace record per completed task (the PBT
+// per-stream-buffer model of parsec/profiling.c, one level lower than
+// the PR 9 Python rings): everything a span needs, formatted lazily at
+// scrape by the Python adapter (profiling/trace.py NativeRingAdapter)
+struct PdtdObsRec {
+  uint64_t t0_ns;       // select (body dispatch) stamp
+  uint64_t t1_ns;       // completion stamp
+  uint64_t q_ns;        // ready -> select queue wait
+  uint64_t span;        // span id: caller base | process-global counter
+  uint32_t seq;         // task id (the pool-local identity)
+  uint32_t parent_seq;  // releasing predecessor (0xffffffff = none)
+  uint32_t cls;         // insert-side class id
+  int32_t worker;
+};  // 48 bytes, natural alignment — mirrored by _native.OBS_DTYPE
+
+// per-worker SINGLE-PRODUCER ring: the owning worker appends lock-free
+// (slot write, then release-store of wpos); growth (up to cap_max) and
+// snapshot drains take the mutex. Once at cap_max the ring overwrites
+// its oldest record and advances the drop counter — bounded memory is
+// the contract, the drop counter is the honesty counter.
+struct PdtdObsRing {
+  std::mutex mu;                       // drain + growth only
+  std::unique_ptr<PdtdObsRec[]> buf;
+  uint32_t cap = 0;
+  std::atomic<uint64_t> wpos{0};
+};
+
+// process-global span-id counter shared by every engine: ids stay
+// unique across the one-pool-per-request serving churn without any
+// cross-engine coordination
+static std::atomic<uint64_t> g_obs_span{1};
 
 struct Pdtd {
   static constexpr uint32_t kSegBits = 12;
@@ -732,6 +783,14 @@ struct Pdtd {
       s_completed_python{0}, s_released{0}, s_drops{0}, s_dropped_cancel{0},
       s_ring_hw{0}, s_pump_calls{0};
 
+  // observability plane (pdtd_obs_enable): off by default — the hot
+  // loop pays ONE relaxed bool load per stamp site when off
+  std::atomic<bool> obs_on{false};
+  uint64_t obs_span_base = 0;
+  uint32_t obs_cap_max = 0;
+  std::vector<PdtdObsRing*> obs_rings;
+  std::atomic<uint64_t> s_obs_recorded{0}, s_obs_dropped{0};
+
   ~Pdtd() {
     for (uint32_t s = 0; s < kMaxSegs; ++s) {
       PdtdTask* seg = segs[s].load(std::memory_order_relaxed);
@@ -739,6 +798,55 @@ struct Pdtd {
       delete[] seg;
     }
     for (Plifo* q : queues) plifo_free(q);
+    for (PdtdObsRing* r : obs_rings) delete r;
+  }
+
+  // append one completion record to worker w's ring (single producer:
+  // the worker that popped the task). Growth ×4 up to obs_cap_max,
+  // then drop-oldest. The HEALTHY (non-wrapped) path is lock-free:
+  // slots are append-only, published by the release-store of wpos, so
+  // a concurrent drain can never read a torn record. Once the ring is
+  // full (the already-degraded dropping regime) each overwrite takes
+  // the ring mutex so drains stay exact — an uncontended lock per
+  // record, paid only after capacity is exhausted.
+  void obs_record(int w, uint32_t tid, PdtdTask* t, uint64_t t1) {
+    PdtdObsRing* r = obs_rings[w];
+    uint64_t wp = r->wpos.load(std::memory_order_relaxed);
+    if (wp >= r->cap && r->cap < obs_cap_max) {
+      std::lock_guard<std::mutex> lk(r->mu);
+      uint32_t ncap = r->cap * 4;
+      if (ncap > obs_cap_max || ncap < r->cap) ncap = obs_cap_max;
+      PdtdObsRec* nb = new (std::nothrow) PdtdObsRec[ncap];
+      if (nb != nullptr) {
+        for (uint64_t i = 0; i < wp; ++i) nb[i % ncap] = r->buf[i % r->cap];
+        r->buf.reset(nb);
+        r->cap = ncap;
+      }
+    }
+    if (wp >= r->cap) {
+      std::lock_guard<std::mutex> lk(r->mu);
+      s_obs_dropped.fetch_add(1, std::memory_order_relaxed);
+      obs_fill(r->buf[wp % r->cap], w, tid, t, t1);
+      r->wpos.store(wp + 1, std::memory_order_release);
+    } else {
+      obs_fill(r->buf[wp % r->cap], w, tid, t, t1);
+      r->wpos.store(wp + 1, std::memory_order_release);
+    }
+    s_obs_recorded.fetch_add(1, std::memory_order_relaxed);
+  }
+
+  void obs_fill(PdtdObsRec& rec, int w, uint32_t tid, PdtdTask* t,
+                uint64_t t1) {
+    rec.t0_ns = t->t_sel_ns;
+    rec.t1_ns = t1;
+    rec.q_ns = t->t_sel_ns > t->t_ready_ns ? t->t_sel_ns - t->t_ready_ns
+                                           : 0;
+    rec.span = obs_span_base |
+               g_obs_span.fetch_add(1, std::memory_order_relaxed);
+    rec.seq = tid;
+    rec.parent_seq = t->parent_seq;
+    rec.cls = t->cls;
+    rec.worker = w;
   }
 
   PdtdTask* task(uint32_t tid) {
@@ -762,6 +870,8 @@ struct Pdtd {
 
   void push_ready(int w, uint32_t tid) {
     s_ready_pushed.fetch_add(1, std::memory_order_relaxed);
+    if (obs_on.load(std::memory_order_relaxed))
+      task(tid)->t_ready_ns = pdtd_now_ns();
     if (plifo_push(queues[w], tid) != 0) {
       std::lock_guard<std::mutex> lk(overflow_mu);
       overflow.push_back(tid);
@@ -804,8 +914,11 @@ struct Pdtd {
   }
 
   // successor countdown of a completing (or insert-time-ready) task;
-  // returns how many successors became ready (pushed to worker w)
-  int release_succs(int w, const std::vector<uint32_t>& succs) {
+  // returns how many successors became ready (pushed to worker w).
+  // ``src`` is the completing task: when its arrival meets the goal it
+  // becomes the successor's span parent (the dependency edge).
+  int release_succs(int w, uint32_t src, const std::vector<uint32_t>& succs) {
+    bool obs = obs_on.load(std::memory_order_relaxed);
     int newly = 0;
     for (uint32_t sid : succs) {
       PdtdTask* s = task(sid);
@@ -814,6 +927,7 @@ struct Pdtd {
         std::lock_guard<std::mutex> lk(s->mu);
         s->arrived += 1;
         if (s->goal >= 0 && s->arrived == s->goal && !s->done) {
+          if (obs) s->parent_seq = src;
           armed = s->armed;
           if (armed) ready = true;
           else s->ready_deferred = true;
@@ -853,7 +967,9 @@ struct Pdtd {
       t->done = true;
       succs.swap(t->succs);
     }
-    release_succs(w, succs);
+    if (obs_on.load(std::memory_order_relaxed))
+      obs_record(w, tid, t, pdtd_now_ns());
+    release_succs(w, tid, succs);
     drop_preds(t->lpreds, nullptr, 0);
     s_completed_native.fetch_add(1, std::memory_order_relaxed);
     retire_one();
@@ -873,7 +989,7 @@ struct Pdtd {
       t->done = true;
       succs.swap(t->succs);
     }
-    release_succs(w, succs);
+    release_succs(w, tid, succs);
     drop_preds(t->lpreds, nullptr, 0);
     s_dropped_cancel.fetch_add(1, std::memory_order_relaxed);
     retire_one();
@@ -909,7 +1025,8 @@ void pdtd_free(void* ep) { delete static_cast<Pdtd*>(ep); }
 // the workers until pdtd_arm. Returns the first task id, or -1.
 int64_t pdtd_insert(void* ep, uint32_t n, const int32_t* prio,
                     const uint8_t* flags, const uint32_t* npreds,
-                    const uint32_t* preds, uint8_t* linked_out) {
+                    const uint32_t* preds, uint8_t* linked_out,
+                    uint32_t cls) {
   Pdtd* e = static_cast<Pdtd*>(ep);
   uint32_t first = e->ntasks.load(std::memory_order_relaxed);
   if (!e->ensure(first + n)) return -1;
@@ -919,6 +1036,7 @@ int64_t pdtd_insert(void* ep, uint32_t n, const int32_t* prio,
     PdtdTask* t = e->task(tid);
     t->priority = prio ? prio[i] : 0;
     t->flags = flags ? flags[i] : 1;
+    t->cls = cls;
     int64_t goal = 0;
     uint32_t np = npreds ? npreds[i] : 0;
     for (uint32_t k = 0; k < np; ++k, ++pi) {
@@ -992,6 +1110,7 @@ int pdtd_pump(void* ep, int worker, uint32_t* out_tid) {
   Pdtd* e = static_cast<Pdtd*>(ep);
   if (worker < 0 || worker >= e->nworkers) worker = 0;
   e->s_pump_calls.fetch_add(1, std::memory_order_relaxed);
+  bool obs = e->obs_on.load(std::memory_order_relaxed);
   bool ran = false;
   uint32_t tid;
   while (e->pop_ready(worker, &tid)) {
@@ -1001,6 +1120,7 @@ int pdtd_pump(void* ep, int worker, uint32_t* out_tid) {
       ran = true;
       continue;
     }
+    if (obs) t->t_sel_ns = pdtd_now_ns();
     if (t->flags & 1) {
       *out_tid = tid;
       return 1;
@@ -1022,6 +1142,7 @@ int pdtd_pump_batch(void* ep, int worker, uint32_t* out_tids, int cap,
   Pdtd* e = static_cast<Pdtd*>(ep);
   if (worker < 0 || worker >= e->nworkers) worker = 0;
   e->s_pump_calls.fetch_add(1, std::memory_order_relaxed);
+  bool obs = e->obs_on.load(std::memory_order_relaxed);
   bool ran = false;
   int n = 0;
   uint32_t tid;
@@ -1032,6 +1153,7 @@ int pdtd_pump_batch(void* ep, int worker, uint32_t* out_tids, int cap,
       ran = true;
       continue;
     }
+    if (obs) t->t_sel_ns = pdtd_now_ns();
     if (t->flags & 1) {
       out_tids[n++] = tid;
       continue;
@@ -1047,10 +1169,15 @@ int pdtd_pump_batch(void* ep, int worker, uint32_t* out_tids, int cap,
 // refcounted output drop. drops_out (capacity drops_cap) receives the
 // predecessor ids whose retained outputs just lost their last consumer;
 // info_out[0] = successors made ready, info_out[1] = this task's final
-// consumer count (0 → Python need not retain its outputs). Returns the
-// drop count, or -1 on a bad id.
+// consumer count (0 → Python need not retain its outputs). t0_ns/t1_ns
+// are the caller's BODY begin/end stamps for the event record (Python
+// bodies of one pump batch run long after the pop — the select stamp
+// would smear the whole batch's makespan over every task); 0 keeps the
+// engine's own select/now stamps. Returns the drop count, or -1 on a
+// bad id.
 int pdtd_complete(void* ep, int worker, uint32_t tid, uint32_t* drops_out,
-                  int32_t drops_cap, int32_t* info_out) {
+                  int32_t drops_cap, int32_t* info_out, uint64_t t0_ns,
+                  uint64_t t1_ns) {
   Pdtd* e = static_cast<Pdtd*>(ep);
   if (worker < 0 || worker >= e->nworkers) worker = 0;
   if (tid >= e->ntasks.load(std::memory_order_acquire)) return -1;
@@ -1062,7 +1189,11 @@ int pdtd_complete(void* ep, int worker, uint32_t tid, uint32_t* drops_out,
     t->done = true;
     succs.swap(t->succs);
   }
-  int newly = e->release_succs(worker, succs);
+  if (e->obs_on.load(std::memory_order_relaxed)) {
+    if (t0_ns) t->t_sel_ns = t0_ns;
+    e->obs_record(worker, tid, t, t1_ns ? t1_ns : pdtd_now_ns());
+  }
+  int newly = e->release_succs(worker, tid, succs);
   int nd = e->drop_preds(t->lpreds, drops_out, drops_cap);
   if (info_out) {
     info_out[0] = newly;
@@ -1076,11 +1207,14 @@ int pdtd_complete(void* ep, int worker, uint32_t tid, uint32_t* drops_out,
 // Batched completion for Python-bodied tasks that retained no outputs
 // and consumed none (no drop/consumer reporting needed — the null-task
 // and serving shapes): one GIL round-trip completes the whole batch.
-// Returns the number of successors made ready.
+// t01 (2n u64s, nullable) carries per-task body begin/end stamps for
+// the event records — see pdtd_complete. Returns the number of
+// successors made ready.
 int pdtd_complete_batch(void* ep, int worker, const uint32_t* tids,
-                        int n) {
+                        int n, const uint64_t* t01) {
   Pdtd* e = static_cast<Pdtd*>(ep);
   if (worker < 0 || worker >= e->nworkers) worker = 0;
+  bool obs = e->obs_on.load(std::memory_order_relaxed);
   int newly = 0;
   std::vector<uint32_t> succs;
   for (int i = 0; i < n; ++i) {
@@ -1094,7 +1228,15 @@ int pdtd_complete_batch(void* ep, int worker, const uint32_t* tids,
       t->done = true;
       succs.swap(t->succs);
     }
-    newly += e->release_succs(worker, succs);
+    if (obs) {
+      uint64_t t1 = 0;
+      if (t01 != nullptr) {
+        if (t01[2 * i]) t->t_sel_ns = t01[2 * i];
+        t1 = t01[2 * i + 1];
+      }
+      e->obs_record(worker, tid, t, t1 ? t1 : pdtd_now_ns());
+    }
+    newly += e->release_succs(worker, tid, succs);
     e->drop_preds(t->lpreds, nullptr, 0);
     e->s_completed_python.fetch_add(1, std::memory_order_relaxed);
     e->retire_one();
@@ -1140,24 +1282,118 @@ void pdtd_cancel(void* ep) {
   e->cv.notify_all();
 }
 
-void pdtd_stats(void* ep, uint64_t* out16) {
+void pdtd_stats(void* ep, uint64_t* out20) {
   Pdtd* e = static_cast<Pdtd*>(ep);
-  out16[0] = e->s_inserted.load(std::memory_order_relaxed);
-  out16[1] = e->s_linked.load(std::memory_order_relaxed);
-  out16[2] = e->s_ready_pushed.load(std::memory_order_relaxed);
-  out16[3] = e->s_popped.load(std::memory_order_relaxed);
-  out16[4] = e->s_stolen.load(std::memory_order_relaxed);
-  out16[5] = e->s_overflow.load(std::memory_order_relaxed);
-  out16[6] = e->s_completed_native.load(std::memory_order_relaxed);
-  out16[7] = e->s_completed_python.load(std::memory_order_relaxed);
-  out16[8] = e->s_released.load(std::memory_order_relaxed);
-  out16[9] = e->s_drops.load(std::memory_order_relaxed);
-  out16[10] = e->s_dropped_cancel.load(std::memory_order_relaxed);
-  out16[11] = e->s_ring_hw.load(std::memory_order_relaxed);
-  out16[12] = e->inflight.load(std::memory_order_acquire);
-  out16[13] = pdtd_ready(ep);
-  out16[14] = e->s_pump_calls.load(std::memory_order_relaxed);
-  out16[15] = 0;
+  out20[0] = e->s_inserted.load(std::memory_order_relaxed);
+  out20[1] = e->s_linked.load(std::memory_order_relaxed);
+  out20[2] = e->s_ready_pushed.load(std::memory_order_relaxed);
+  out20[3] = e->s_popped.load(std::memory_order_relaxed);
+  out20[4] = e->s_stolen.load(std::memory_order_relaxed);
+  out20[5] = e->s_overflow.load(std::memory_order_relaxed);
+  out20[6] = e->s_completed_native.load(std::memory_order_relaxed);
+  out20[7] = e->s_completed_python.load(std::memory_order_relaxed);
+  out20[8] = e->s_released.load(std::memory_order_relaxed);
+  out20[9] = e->s_drops.load(std::memory_order_relaxed);
+  out20[10] = e->s_dropped_cancel.load(std::memory_order_relaxed);
+  out20[11] = e->s_ring_hw.load(std::memory_order_relaxed);
+  out20[12] = e->inflight.load(std::memory_order_acquire);
+  out20[13] = pdtd_ready(ep);
+  out20[14] = e->s_pump_calls.load(std::memory_order_relaxed);
+  // observability-plane rows (0 while pdtd_obs_enable was never called)
+  out20[15] = e->s_obs_recorded.load(std::memory_order_relaxed);
+  out20[16] = e->s_obs_dropped.load(std::memory_order_relaxed);
+  uint64_t depth = 0;
+  for (PdtdObsRing* r : e->obs_rings) {
+    // cap is written under the ring mutex (growth, disable) — take it
+    // so a scrape can't read a torn/stale capacity mid-regrow
+    std::lock_guard<std::mutex> lk(r->mu);
+    uint64_t wp = r->wpos.load(std::memory_order_acquire);
+    depth += wp < r->cap ? wp : r->cap;
+  }
+  out20[17] = depth;
+  out20[18] = 0;
+  out20[19] = 0;
+}
+
+// ---------------------------------------------------------------------------
+// pdtd observability plane: per-worker single-producer event rings.
+// Enabled per engine BEFORE the first insert (the Python driver turns
+// it on exactly when a live Trace is installed); recording costs three
+// monotonic-clock reads and one 48-byte ring store per task, all off
+// the GIL. Records are drained (snapshot, non-consuming) at scrape/
+// dump time and expanded to the PR 9 trace-record format by
+// profiling/trace.py — observation never changes which engine runs.
+// ---------------------------------------------------------------------------
+
+// current monotonic ns — the Python side pairs one call with a
+// time.perf_counter() read to measure the clock offset exactly
+uint64_t pdtd_obs_now(void) { return pdtd_now_ns(); }
+
+// Enable the rings: span ids mint as (span_base | global counter);
+// each worker ring starts small and grows ×4 up to cap_max records,
+// then drop-oldest. Returns 0, or -1 on allocation failure.
+int pdtd_obs_enable(void* ep, uint64_t span_base, uint32_t cap_max) {
+  Pdtd* e = static_cast<Pdtd*>(ep);
+  if (e->obs_on.load(std::memory_order_relaxed)) return 0;
+  if (cap_max < 64) cap_max = 64;
+  e->obs_span_base = span_base;
+  e->obs_cap_max = cap_max;
+  uint32_t cap0 = cap_max < 1024 ? cap_max : 1024;
+  for (int w = 0; w < e->nworkers; ++w) {
+    PdtdObsRing* r = new (std::nothrow) PdtdObsRing();
+    if (r != nullptr) {
+      r->buf.reset(new (std::nothrow) PdtdObsRec[cap0]);
+      if (!r->buf) {
+        delete r;
+        r = nullptr;
+      } else {
+        r->cap = cap0;
+      }
+    }
+    if (r == nullptr) {
+      for (PdtdObsRing* q : e->obs_rings) delete q;
+      e->obs_rings.clear();
+      return -1;
+    }
+    e->obs_rings.push_back(r);
+  }
+  e->obs_on.store(true, std::memory_order_release);
+  return 0;
+}
+
+// Release the ring memory (counters survive). Called once the engine
+// is quiescent (pool folded, rings snapshotted) so a persistent
+// serving context does not pin one ring set per retired pool.
+void pdtd_obs_disable(void* ep) {
+  Pdtd* e = static_cast<Pdtd*>(ep);
+  e->obs_on.store(false, std::memory_order_release);
+  for (PdtdObsRing* r : e->obs_rings) {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->buf.reset();
+    r->cap = 0;
+  }
+}
+
+// Snapshot worker w's ring into out[cap_out] (oldest first, NOT
+// consumed — repeated dumps see the same events, like the Python trace
+// rings). Exact under concurrency: published append-only slots are
+// immutable, and overwrites (the wrapped regime) serialize against
+// this drain on the ring mutex — no torn records, no discard
+// heuristic. Returns the record count, -1 on a bad worker.
+int pdtd_obs_drain(void* ep, int worker, PdtdObsRec* out,
+                   uint32_t cap_out) {
+  Pdtd* e = static_cast<Pdtd*>(ep);
+  if (worker < 0 || worker >= (int)e->obs_rings.size()) return -1;
+  PdtdObsRing* r = e->obs_rings[worker];
+  std::lock_guard<std::mutex> lk(r->mu);
+  if (r->cap == 0) return 0;
+  uint64_t w2 = r->wpos.load(std::memory_order_acquire);
+  uint64_t n = w2 < r->cap ? w2 : r->cap;
+  if (n > cap_out) n = cap_out;
+  uint64_t start = w2 - n;
+  for (uint64_t i = 0; i < n; ++i)
+    out[i] = r->buf[(start + i) % r->cap];
+  return (int)n;
 }
 
 }  // extern "C"

@@ -177,6 +177,10 @@ class Context:
         self._ndtd_live: List = []
         self._ndtd_lock = threading.Lock()
         self._ndtd_totals: Dict[str, int] = {}
+        # per-tenant native completions (the tenant PINS module and the
+        # metrics collector fold these in at scrape — native pools never
+        # fire the per-task EXEC hooks, by design)
+        self._ndtd_tenant_totals: Dict[str, int] = {}
         self._active_taskpools: List[Taskpool] = []
         # name → taskpool, kept past termination: late control traffic
         # (DTD flush writebacks/acks) must still find its taskpool
@@ -424,8 +428,9 @@ class Context:
             if eng not in self._ndtd_live:
                 return
             self._ndtd_live.remove(eng)
-            for k, v in eng.stats().items():
-                if k in ("inflight", "ready"):
+            stats = eng.stats()
+            for k, v in stats.items():
+                if k in ("inflight", "ready", "obs_ring_depth"):
                     continue                    # gauges, not counters
                 if k == "ring_highwater":
                     self._ndtd_totals[k] = max(
@@ -433,6 +438,17 @@ class Context:
                 else:
                     self._ndtd_totals[k] = \
                         self._ndtd_totals.get(k, 0) + v
+            ten = getattr(eng.tp, "tenant_name", None) or "(untenanted)"
+            self._ndtd_tenant_totals[ten] = \
+                self._ndtd_tenant_totals.get(ten, 0) + \
+                stats.get("completed_native", 0) + \
+                stats.get("completed_python", 0)
+        # freeze the trace adapter's ring snapshot + free the C rings
+        # BEFORE dropping the per-task refs (the adapter keeps only the
+        # raw record arrays — expansion stays deferred to dump time)
+        obs_retire = getattr(eng, "obs_retire", None)
+        if obs_retire is not None:
+            obs_retire()
         eng.release_refs()
 
     def native_dtd_stats(self) -> Dict[str, int]:
@@ -448,6 +464,22 @@ class Context:
                     out[k] = max(out.get(k, 0), v)
                 else:
                     out[k] = out.get(k, 0) + v
+        return out
+
+    def native_tenant_stats(self) -> Dict[str, int]:
+        """Per-tenant native-engine completions (retired pools' folded
+        totals plus live engines): the scrape-time source the tenant
+        PINS module and the metrics collector merge, since native pools
+        never fire the per-task EXEC hooks."""
+        with self._ndtd_lock:
+            out = dict(self._ndtd_tenant_totals)
+            live = list(self._ndtd_live)
+        for eng in live:
+            st = eng.stats()
+            ten = getattr(eng.tp, "tenant_name", None) or "(untenanted)"
+            out[ten] = out.get(ten, 0) + \
+                st.get("completed_native", 0) + \
+                st.get("completed_python", 0)
         return out
 
     def _ndtd_pump(self, es: "ExecutionStream") -> bool:
@@ -493,6 +525,10 @@ class Context:
         out["capacity"] = self._capacity_block()
         if self.trace is not None:
             out["trace_dropped"] = self.trace.dropped()
+            # the native-ring share separately: a truncated NATIVE
+            # capture (in-engine ring wrap / evicted snapshot) must be
+            # loud on its own row, not hidden in the Python-ring total
+            out["trace_native_dropped"] = self.trace.native_dropped()
         nstats = self.native_dtd_stats()
         if nstats:
             out["native_dtd"] = nstats

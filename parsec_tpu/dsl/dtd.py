@@ -492,8 +492,14 @@ class Taskpool(CoreTaskpool):
         eng = self._engine()
         if eng is not None:
             # native hot loop: returns the task's sequence number (the
-            # opaque handle — native tasks have no Python Task object)
-            return eng.insert_rows(fn, [args], priority, device, pure)[0]
+            # opaque handle — native tasks have no Python Task object).
+            # Stage timers no longer force the Python engine (ISSUE
+            # 13), so the insert-stage row is accounted here too.
+            out = eng.insert_rows(fn, [args], priority, device, pure)[0]
+            if timed:
+                self.insert_s += time.perf_counter() - t0
+                self.insert_calls += 1
+            return out
         tc = self._task_class_for(fn, self._shape_of(args), device,
                                   pure=pure)
         task = self._insert_one(tc, args, priority, None, None)
@@ -533,7 +539,11 @@ class Taskpool(CoreTaskpool):
             self.admission.admit(self, len(rows))
         eng = self._engine()
         if eng is not None:
-            return eng.insert_rows(fn, rows, priority, device, pure)
+            handles = eng.insert_rows(fn, rows, priority, device, pure)
+            if timed:
+                self.insert_s += time.perf_counter() - t0
+                self.insert_calls += len(rows)
+            return handles
         shape0 = self._shape_of(rows[0])
         tc0 = self._task_class_for(fn, shape0, device, pure=pure)
         ready: List[Task] = []

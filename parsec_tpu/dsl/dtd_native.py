@@ -21,15 +21,18 @@ first insert):
   error instead of a silent fallback.
 - ``0``: always the Python path.
 
-Eligibility — the **instrumented-fallback rule**: the native loop cannot
-fire per-task Python observers, so a pool stays on the (instrumented)
-Python engine whenever any of these holds:
+Eligibility — the **instrumented-fallback rule** (ISSUE 13 moved the
+line: observation must never change which engine runs, PaRSEC's
+PINS/profiling contract): a pool stays on the (instrumented) Python
+engine only when one of these holds:
 
 - distributed (``nb_ranks > 1``) — replay/shell semantics are Python;
-- an observer is live: dfsan sanitizer, Trace (spans), Grapher,
-  ``runtime.stage_timers``, the debug-history EXE ring, or any
-  registered PINS callback (the ``tenant`` service accounting among
-  them);
+- a **semantically-intrusive** observer is live: the dfsan race
+  sanitizer (stamps/orders every access), the Grapher (records every
+  dep edge), the debug-history EXE ring, or a per-task PINS sampler
+  with no native equivalent (alperf, counters, iterators_checker —
+  and the straggler watchdog when no live Trace feeds it ring
+  records);
 - the context scheduler does not opt in (``native_dtd_capable`` — the
   lfq/ll/ltq/lhq/gd families do; ``wfq`` keeps Python pools so its
   weighted-fair arbitration and ``pool_stats`` observe every task, and
@@ -37,6 +40,16 @@ Python engine whenever any of these holds:
   since the native LIFO/steal queues would discard their ordering key);
 - a non-CPU device is registered (bodies would route through device
   managers the native pump bypasses).
+
+What does NOT disqualify anymore: a live :class:`~parsec_tpu.
+profiling.trace.Trace` (the engine records begin/end/queue-wait spans
+into its own per-worker binary event rings — ``pdtd_obs_*`` — which
+the trace expands byte-compatibly at dump/scrape time), the always-on
+metrics registry, ``runtime.stage_timers`` (stage totals read from the
+engine's C++ atomics at scrape), and scrape-only PINS modules
+(``tenant`` — native completions folded per tenant at scrape —
+and ``overhead``). The ring capacity knob is
+``profiling.native_ring_events``.
 
 Serving hooks do NOT force a fallback: ``Taskpool.admission`` runs on
 the inserting thread as usual, and a pool with ``on_retire`` simply
@@ -57,6 +70,7 @@ from __future__ import annotations
 
 import ctypes
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
@@ -149,12 +163,15 @@ def engine_for(tp) -> Optional["NativeDTD"]:
     ctx = tp.context
     if ctx is None or tp.nb_ranks > 1:
         return None
-    # instrumented-fallback rule: any live per-task observer keeps the
-    # pool on the Python path (the observers' hooks must see every task)
-    if ctx.dfsan is not None or ctx.trace is not None or \
-            ctx.grapher is not None or ctx.stage_timers:
+    # instrumented-fallback rule (the ISSUE 13 line): only
+    # SEMANTICALLY-INTRUSIVE observers keep the pool on the Python
+    # path. A live Trace records through the engine's own event rings,
+    # the metrics registry and stage timers read C++ atomics at scrape,
+    # and scrape-only PINS callbacks are registered native_ok — see the
+    # module docstring for the exact residual list.
+    if ctx.dfsan is not None or ctx.grapher is not None:
         return None
-    if ctx.pins.active():
+    if ctx.pins.needs_python_engine(trace_live=ctx.trace is not None):
         return None
     from ..utils import debug_history
     if debug_history.enabled():     # EXE-mark ring expects every task
@@ -209,6 +226,9 @@ class NativeDTD:
         self._ranbuf = [ctypes.c_int() for _ in range(self.nworkers)]
         self._batchbuf = [(ctypes.c_uint32 * _PUMP_BATCH)()
                           for _ in range(self.nworkers)]
+        # per-task body begin/end stamps for the event rings (obs only)
+        self._t01buf = [(ctypes.c_uint64 * (2 * _PUMP_BATCH))()
+                        for _ in range(self.nworkers)]
         self._infobuf = [(ctypes.c_int32 * 2)()
                          for _ in range(self.nworkers)]
         self._dropbuf = [(ctypes.c_uint32 * _MAX_PREDS_INIT)()
@@ -225,9 +245,53 @@ class NativeDTD:
         # abort): the workers keep pumping this engine and fold it into
         # the context totals once the last task drains
         self.retiring = False
+        # in-engine observability plane (ISSUE 13): when a live Trace
+        # is installed, enable the per-worker binary event rings so the
+        # pool KEEPS the native engine under tracing — records carry
+        # seq/class/worker/t0/t1/queue-wait/span and are expanded to
+        # the PR 9 event shape at scrape time by the trace's
+        # NativeRingAdapter. class_names is the insert-side id→name
+        # table the expansion reads; the rid rides at the pool level
+        # (tp.trace_rid — the serving Submission's deterministic id).
+        self.class_names: List[str] = []
+        self._cls_by_fn: Dict[Any, int] = {}
+        self._obs = False
+        self._obs_adapter = None
+        self._obs_cap = 0
+        self.obs_offset_s = 0.0
+        tr = ctx.trace
+        if tr is not None and hasattr(lib, "pdtd_obs_enable"):
+            from ..profiling import spans as spans_mod
+            cap = max(64, int(mca_param.get(
+                "profiling.native_ring_events", 16384)))
+            if lib.pdtd_obs_enable(
+                    self._e, spans_mod.native_span_base(ctx.my_rank),
+                    cap) == 0:
+                # clock handshake: exact offset from the engine's
+                # monotonic-ns domain to time.perf_counter (no
+                # assumption that the two share an epoch)
+                self.obs_offset_s = (time.perf_counter() -
+                                     lib.pdtd_obs_now() / 1e9)
+                self._obs = True
+                self._obs_cap = cap
+                from ..profiling.trace import NativeRingAdapter
+                self._obs_adapter = NativeRingAdapter(self)
+                tr.add_native_source(self._obs_adapter)
         ctx._ndtd_register(self)
 
     # -------------------------------------------------------------- insert
+    def _cls_id(self, fn) -> int:
+        """Insert-side class id for the event rings: the expansion maps
+        it back to the task-class name (``fn.__name__`` — the same name
+        the Python engine's task class carries, so span trees match
+        across engines). One dict hit per insert chunk."""
+        cid = self._cls_by_fn.get(fn)
+        if cid is None:
+            cid = len(self.class_names)
+            self.class_names.append(getattr(fn, "__name__", "dtd_task"))
+            self._cls_by_fn[fn] = cid
+        return cid
+
     def _class_for(self, fn, shape, device, pure):
         key = (fn, shape, device, pure)
         info = self._class_info.get(key)
@@ -362,7 +426,8 @@ class NativeDTD:
             flags_a.ctypes.data_as(u8p),
             npreds_a.ctypes.data_as(u32p),
             preds_a.ctypes.data_as(u32p),
-            linked_a.ctypes.data_as(u8p))
+            linked_a.ctypes.data_as(u8p),
+            self._cls_id(fn))
         if first < 0:
             raise RuntimeError(
                 f"pdtd_insert failed (rc={first}): task table "
@@ -459,21 +524,31 @@ class NativeDTD:
             ran = True
             self._run_batch(tids, n, w)
 
+    def _obs_ns(self, t: float) -> int:
+        """perf_counter seconds → the engine's monotonic-ns domain
+        (inverse of the enable-time clock handshake)."""
+        return int((t - self.obs_offset_s) * 1e9)
+
     def _run_batch(self, tids, n: int, w: int) -> None:
         """Run up to _PUMP_BATCH Python bodies. Tasks with no tile
         traffic (no retained outputs, no consumed predecessors — the
         null-task and serving shapes) complete through ONE batched
         native call; tile-bearing tasks take the full individual path
-        (write-back, retained outputs, drop reporting)."""
+        (write-back, retained outputs, drop reporting). With the event
+        rings live, per-task body begin/end stamps ride the completion
+        call — the batch's single completion instant would otherwise
+        smear the whole batch's makespan over every task's span."""
         tp = self.tp
         rows = self.rows
-        done: List[tuple] = []          # (seq, tc_name) batch-completable
+        obs = self._obs
+        # (seq, tc_name, t0_ns, t1_ns) batch-completable
+        done: List[tuple] = []
         try:
             for i in range(n):
                 seq = tids[i]
                 row = rows.pop(seq, None)
                 if row is None:
-                    done.append((seq, "dtd_task"))
+                    done.append((seq, "dtd_task", 0, 0))
                     continue
                 info, spec, resolvers, out_tiles, n_lp = row
                 if out_tiles or n_lp:
@@ -481,18 +556,23 @@ class NativeDTD:
                                    out_tiles, n_lp, w)
                     continue
                 hook = info[0]
-                result = hook(_Shim(spec), *self._resolve(resolvers)) \
+                vals = self._resolve(resolvers)
+                tb = time.perf_counter() if obs else 0.0
+                result = hook(_Shim(spec), *vals) \
                     if hook is not None else None
+                te = time.perf_counter() if obs else 0.0
                 self._normalize(result, info[1], seq)   # validate-only:
                 # no output flow can exist without an out tile
-                done.append((seq, info[2]))
+                done.append((seq, info[2],
+                             self._obs_ns(tb) if obs else 0,
+                             self._obs_ns(te) if obs else 0))
         except BaseException as exc:  # noqa: BLE001 — worker must survive
             self._flush_batch(done, w)
             self._fail(seq, exc, w)
             # account the popped-but-unrun remainder so the engine still
             # drains (the pool is aborted; their bodies never run)
-            rest = [(tids[j], "dtd_task") for j in range(i + 1, n)]
-            for s, _ in rest:
+            rest = [(tids[j], "dtd_task", 0, 0) for j in range(i + 1, n)]
+            for s, _, _, _ in rest:
                 rows.pop(s, None)
             self._flush_batch(rest, w, retire=False)
             return
@@ -515,14 +595,22 @@ class NativeDTD:
                     tp.on_retire(tp)
             if tp.context._track_completed:
                 add = tp.completed_tasks.add
-                for s, nm in done:
+                for s, nm, _tb, _te in done:
                     add((nm, (s,)))
         finally:
             arr = self._batchbuf[w]
-            for j, (s, _) in enumerate(done):
-                arr[j] = s
+            t01 = None
+            if self._obs:
+                t01 = self._t01buf[w]
+                for j, (s, _nm, tb, te) in enumerate(done):
+                    arr[j] = s
+                    t01[2 * j] = tb
+                    t01[2 * j + 1] = te
+            else:
+                for j, (s, _nm, _tb, _te) in enumerate(done):
+                    arr[j] = s
             newly = self.lib.pdtd_complete_batch(self._e, w, arr,
-                                                 len(done))
+                                                 len(done), t01)
             if newly:
                 evt = tp.context._work_evt
                 if not evt.is_set():
@@ -550,10 +638,16 @@ class NativeDTD:
         completion with drop reporting."""
         tp = self.tp
         hook, out_flows, tc_name = info
+        obs = self._obs
+        t0ns = t1ns = 0
         try:
             vals = self._resolve(resolvers)
+            tb = time.perf_counter() if obs else 0.0
             result = hook(_Shim(spec), *vals) if hook is not None \
                 else None
+            if obs:
+                t0ns = self._obs_ns(tb)
+                t1ns = self._obs_ns(time.perf_counter())
             outs = self._normalize(result, out_flows, seq)
             if out_tiles:
                 # retained per-flow value for linked readers: the
@@ -584,15 +678,16 @@ class NativeDTD:
             if tp.context._track_completed:
                 tp.completed_tasks.add((tc_name, (seq,)))
         finally:
-            self._complete(seq, w, n_lp, drop_own=not out_tiles)
+            self._complete(seq, w, n_lp, drop_own=not out_tiles,
+                           t0ns=t0ns, t1ns=t1ns)
 
     def _complete(self, seq: int, w: int, n_lp: int,
-                  drop_own: bool) -> None:
+                  drop_own: bool, t0ns: int = 0, t1ns: int = 0) -> None:
         lib = self.lib
         info = self._infobuf[w]
         drops = self._dropbuf[w] if n_lp else None
         nd = lib.pdtd_complete(self._e, w, seq, drops,
-                               n_lp, info)
+                               n_lp, info, t0ns, t1ns)
         if nd > 0:
             outputs = self.outputs
             for i in range(min(nd, n_lp)):
@@ -648,10 +743,56 @@ class NativeDTD:
 
     # ------------------------------------------------------------- observe
     def stats(self) -> Dict[str, int]:
-        buf = (ctypes.c_uint64 * 16)()
+        buf = (ctypes.c_uint64 * len(_native.PDTD_STAT_KEYS))()
         self.lib.pdtd_stats(self._e, buf)
         return {k: int(v) for k, v in zip(_native.PDTD_STAT_KEYS, buf)
                 if k != "reserved"}
+
+    def obs_drain(self) -> List[np.ndarray]:
+        """Snapshot every worker's event ring (non-consuming): one
+        structured array per non-empty ring, dtype
+        ``_native.obs_dtype()``. One memcpy per ring — no per-event
+        Python work; the trace adapter expands lazily at dump time."""
+        if not self._obs:
+            return []
+        lib = self.lib
+        cap = self._obs_cap
+        dt = _native.obs_dtype()
+        vp = ctypes.c_void_p
+        out: List[np.ndarray] = []
+        for w in range(self.nworkers):
+            buf = np.empty(cap, dt)
+            n = lib.pdtd_obs_drain(self._e, w,
+                                   buf.ctypes.data_as(vp), cap)
+            if n > 0:
+                out.append(buf[:n].copy())
+        return out
+
+    def obs_dropped(self) -> int:
+        """Records lost to in-engine ring wraps."""
+        return self.stats().get("obs_dropped", 0) if self._obs else 0
+
+    def obs_retire(self) -> None:
+        """Pool folded (terminated AND drained): freeze the adapter's
+        snapshot, feed ring-fed PINS modules (the straggler watchdog's
+        native path), and free the C ring memory — a persistent serving
+        context must not pin one ring set per retired pool."""
+        ad = self._obs_adapter
+        if ad is None:
+            return
+        ad.snapshot()
+        ctx = self.tp.context
+        if ctx is not None:
+            for mod in getattr(ctx, "pins_modules", ()):
+                feed = getattr(mod, "observe_native_rings", None)
+                if feed is not None:
+                    try:
+                        feed(ad.raw_arrays(), self.class_names)
+                    except Exception:  # noqa: BLE001 — observer only
+                        pass
+        if self._obs:
+            self._obs = False
+            self.lib.pdtd_obs_disable(self._e)
 
     # ------------------------------------------------------------- helpers
     @staticmethod

@@ -399,8 +399,11 @@ def install_context_collectors(context) -> Callable[[], None]:
                          "native DTD engine counters (inserted/"
                          "ready_pushed/stolen/released_edges/"
                          "completed_native/completed_python/"
-                         "ring_highwater/inflight/ready, read from the "
-                         "engine's C++ atomics at scrape time)",
+                         "ring_highwater/inflight/ready plus the "
+                         "observability-plane rows obs_recorded/"
+                         "obs_dropped/obs_ring_depth of the in-engine "
+                         "event rings, read from the engine's C++ "
+                         "atomics at scrape time)",
                          ("rank", "key"))
     g_ready = reg.gauge("parsec_sched_ready_tasks",
                         "tasks queued in the scheduler", ("rank",))
@@ -488,6 +491,13 @@ def install_context_collectors(context) -> Callable[[], None]:
                         **ten.stats}
                 for k, v in rows.items():
                     setg(g_tenant, v, rank=rank, tenant=name, key=k)
+        # native-engine completions per tenant (ISSUE 13): native pools
+        # bypass the per-task tenant hooks — the engine atomics carry
+        # the truth, folded here at scrape time
+        for ten, n in ctx.native_tenant_stats().items():
+            if n:
+                setg(g_tenant, n, rank=rank, tenant=ten,
+                     key="native_tasks")
         hbm = ctx.hbm
         if hbm is not None:
             with hbm._lock:

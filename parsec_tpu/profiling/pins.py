@@ -49,21 +49,51 @@ class PinsManager:
     def __init__(self, context) -> None:
         self.context = context
         self._chains: Dict[PinsEvent, List[Callable]] = defaultdict(list)
+        # native-engine classification per registered callback (ISSUE
+        # 13): True = the observer has a native equivalent (the pdtd
+        # event rings) or only reads state at scrape time, so it does
+        # NOT disqualify the native DTD engine; "trace" = native-ok
+        # only while a live Trace feeds it ring records at pool
+        # retirement (the straggler watchdog); False (default) = a
+        # per-task Python observer the native hot loop cannot fire —
+        # pools stay on the instrumented Python path.
+        self._native_ok: Dict[Callable, object] = {}
 
-    def register(self, event: PinsEvent, cb: Callable) -> None:
+    def register(self, event: PinsEvent, cb: Callable,
+                 native_ok: object = False) -> None:
         self._chains[event].append(cb)
+        self._native_ok[cb] = native_ok
 
     def active(self) -> bool:
-        """True when ANY callback chain is populated — per-task PINS
-        observers are live, so the native DTD engine (whose hot loop
-        cannot fire them) must leave pools on the instrumented path."""
+        """True when ANY callback chain is populated (regardless of
+        native classification) — kept for report/diagnostic callers;
+        the native-engine gate is :meth:`needs_python_engine`."""
         return any(self._chains.values())
+
+    def needs_python_engine(self, trace_live: bool = False) -> bool:
+        """True when a registered callback requires the per-task Python
+        hook chain — the instrumented-fallback gate the native DTD
+        engine checks (``dsl/dtd_native.engine_for``). Callbacks
+        registered ``native_ok=True`` never disqualify; ``"trace"``
+        ones disqualify only when no live Trace will snapshot the
+        native rings for them."""
+        for chain in self._chains.values():
+            for cb in chain:
+                ok = self._native_ok.get(cb, False)
+                if ok is True:
+                    continue
+                if ok == "trace" and trace_live:
+                    continue
+                return True
+        return False
 
     def unregister(self, event: PinsEvent, cb: Callable) -> None:
         try:
             self._chains[event].remove(cb)
         except ValueError:
             pass
+        if not any(cb in chain for chain in self._chains.values()):
+            self._native_ok.pop(cb, None)
 
     def _fire(self, event: PinsEvent, *args) -> None:
         for cb in self._chains.get(event, ()):

@@ -44,7 +44,15 @@ mca_param.register("profiling.straggler_min_samples", 20,
 class PinsModule:
     """Base module: ``install(context)`` subscribes to the PINS chains,
     ``uninstall()`` removes the subscriptions, ``report()`` returns the
-    collected data (reference modules print at component close)."""
+    collected data (reference modules print at component close).
+
+    ``native_ok`` (per subscription) is the ISSUE 13 classification:
+    ``True`` = the observer has a native-engine equivalent or only
+    reads state at scrape time, so it does not force DTD pools onto the
+    instrumented Python path; ``"trace"`` = native-ok only while a live
+    Trace snapshots the engine rings for it (``observe_native_rings``
+    is then fed at pool retirement); ``False`` (default) = a per-task
+    Python observer — pools stay on the Python engine."""
 
     name = "module"
 
@@ -52,8 +60,8 @@ class PinsModule:
         self.context = None
         self._subs: List = []    # (event, cb) pairs for uninstall
 
-    def _sub(self, event: PinsEvent, cb) -> None:
-        self.context.pins.register(event, cb)
+    def _sub(self, event: PinsEvent, cb, native_ok: object = False) -> None:
+        self.context.pins.register(event, cb, native_ok=native_ok)
         self._subs.append((event, cb))
 
     def install(self, context) -> "PinsModule":
@@ -382,8 +390,13 @@ class StragglerWatchdog(PinsModule):
             "task instances flagged by the straggler watchdog "
             "(body time > rolling p99 x profiling.straggler_factor)",
             ("class",)) if metrics_mod.enabled() else None
-        self._sub(PinsEvent.EXEC_BEGIN, self._begin)
-        self._sub(PinsEvent.EXEC_END, self._end)
+        # native_ok="trace": with a live Trace the watchdog is fed the
+        # native engine's ring records at pool retirement
+        # (observe_native_rings) — near-live for the one-pool-per-
+        # request serving shape; without a trace there is no native
+        # data source, so the pool stays on the Python path
+        self._sub(PinsEvent.EXEC_BEGIN, self._begin, native_ok="trace")
+        self._sub(PinsEvent.EXEC_END, self._end, native_ok="trace")
         return self
 
     def _begin(self, es, task) -> None:
@@ -398,8 +411,14 @@ class StragglerWatchdog(PinsModule):
         t0 = task.prof.pop("straggler_t0", None)
         if t0 is None:
             return
-        dt = time.perf_counter() - t0
-        cls = task.task_class.name
+        self._observe(task.task_class.name, time.perf_counter() - t0,
+                      list(task.locals))
+
+    def _observe(self, cls: str, dt: float, locals_: List) -> None:
+        """ONE detection rule for both paths (live EXEC hooks and the
+        native ring feed): min-samples gate, window//4 p99
+        re-estimation, flag shape, counter, log — a one-sided tuning
+        edit cannot diverge the engines' straggler behavior."""
         flag = None
         with self._lock:
             row = self._rows.get(cls)
@@ -412,7 +431,7 @@ class StragglerWatchdog(PinsModule):
                     p99 = row[2] = self._p99(win)
                 if dt > p99 * self._factor:
                     flag = {"class": cls,
-                            "locals": list(task.locals),
+                            "locals": locals_,
                             "body_s": round(dt, 6),
                             "p99_s": round(p99, 6),
                             "factor": round(dt / max(p99, 1e-12), 2)}
@@ -424,9 +443,32 @@ class StragglerWatchdog(PinsModule):
                 self._m_flagged.labels(**{"class": cls}).inc()
             debug_verbose(1, "pins",
                           "straggler: %s%r body %.3f ms > p99 %.3f ms "
-                          "x %.1f", cls, tuple(task.locals),
+                          "x %.1f", cls, tuple(locals_),
                           flag["body_s"] * 1e3, flag["p99_s"] * 1e3,
                           self._factor)
+
+    def observe_native_rings(self, arrays, class_names) -> None:
+        """Ring-fed native path (ISSUE 13): a natively-executed pool's
+        body durations (select→completion from the in-engine event
+        rings) arrive in bulk when the rings are snapshotted at pool
+        retirement — near-live for the one-pool-per-request serving
+        shape. Each record goes through the SAME per-observation rule
+        as the live path (_observe), so an outlier inside the first
+        fold is still flagged. The per-record Python cost is paid only
+        at FOLD time and only with this module installed."""
+        import numpy as np
+        for a in arrays:
+            durs = (a["t1_ns"].astype(np.int64) -
+                    a["t0_ns"].astype(np.int64)) / 1e9
+            cls_ids = a["cls"]
+            seqs = a["seq"]
+            for cid in np.unique(cls_ids):
+                name = class_names[cid] if cid < len(class_names) \
+                    else "dtd_task"
+                mask = cls_ids == cid
+                for d, s in zip(durs[mask].tolist(),
+                                seqs[mask].tolist()):
+                    self._observe(name, d, [s])
 
     def report(self) -> Dict[str, Any]:
         with self._lock:
@@ -468,8 +510,13 @@ class TenantAccounting(PinsModule):
                 "cumulative task-body seconds per tenant",
                 ("rank", "tenant"))
         self._rank = str(context.my_rank)
-        self._sub(PinsEvent.EXEC_BEGIN, self._begin)
-        self._sub(PinsEvent.EXEC_END, self._end)
+        # native_ok: pools on the native DTD engine never fire these
+        # hooks — their completions are folded per tenant at scrape
+        # time from the engine's C++ atomics (report() / the context
+        # metrics collector read Context.native_tenant_stats), so the
+        # accounting module must not force the 12k/s Python path
+        self._sub(PinsEvent.EXEC_BEGIN, self._begin, native_ok=True)
+        self._sub(PinsEvent.EXEC_END, self._end, native_ok=True)
         return self
 
     @staticmethod
@@ -495,6 +542,14 @@ class TenantAccounting(PinsModule):
     def report(self) -> Dict[str, Any]:
         with self._lock:
             out = {"tenants": {k: dict(v) for k, v in self._rows.items()}}
+        # fold native-engine completions per tenant (ISSUE 13: native
+        # pools bypass the EXEC hooks; the engine's atomics are the
+        # truth — body_s stays Python-measured, native bodies may
+        # never enter Python at all)
+        for ten, n in self.context.native_tenant_stats().items():
+            t = out["tenants"].setdefault(ten, {"tasks": 0,
+                                                "body_s": 0.0})
+            t["native_tasks"] = t.get("native_tasks", 0) + n
         sched = self.context.scheduler
         if hasattr(sched, "pool_stats"):
             # fold wfq's selection/backlog view in per tenant

@@ -56,11 +56,26 @@ _lock = threading.Lock()
 #: f-string version measurably moved the obs_overhead_pct guard)
 _RANK_SHIFT = 44
 
+#: bit set in every NATIVELY-minted span id (the pdtd event rings mint
+#: ids in C++ from their own process-global counter — ISSUE 13): it
+#: partitions the sub-rank id space so a native id can never collide
+#: with this module's Python counter on the same rank, with zero
+#: cross-engine coordination
+_NATIVE_BIT = 43
+
 
 def next_span_id(rank: int = 0) -> int:
     """Mint a process-unique span id; the rank rides the high bits so
     ids from different ranks never collide in a merged trace."""
     return (rank << _RANK_SHIFT) | next(_counter)
+
+
+def native_span_base(rank: int = 0) -> int:
+    """Base ORed into every span id the native pdtd event rings mint
+    (``pdtd_obs_enable``): rank in the high bits like
+    :func:`next_span_id`, plus the native marker bit so the two mint
+    domains stay disjoint within a rank."""
+    return (rank << _RANK_SHIFT) | (1 << _NATIVE_BIT)
 
 
 def mint_rid(name: str) -> str:

@@ -64,10 +64,18 @@ def _pair_durations(events: List[Dict]) -> Dict[str, List[float]]:
 
 def summary(traces: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Per-key event counts and paired-duration statistics across ranks
-    (dbpreader-style)."""
+    (dbpreader-style). Per-rank drop counters from the meta block ride
+    along — a truncated capture (Python ring wrap or native in-engine
+    ring wrap, ISSUE 13) must be visible from the CLI, not silent."""
     out: Dict[str, Any] = {"ranks": len(traces), "keys": {}}
     for rank, tr in enumerate(traces):
         events = tr["events"]
+        meta = tr.get("meta") or {}
+        if meta.get("dropped") or meta.get("native_dropped"):
+            out.setdefault("dropped", []).append(
+                {"rank": meta.get("rank", rank),
+                 "dropped": meta.get("dropped", 0),
+                 "native_dropped": meta.get("native_dropped", 0)})
         counts: Dict[str, int] = defaultdict(int)
         for ev in events:
             counts[f"{ev['key']}:{ev['phase']}"] += 1

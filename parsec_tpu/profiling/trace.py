@@ -44,6 +44,18 @@ mca_param.register("profiling.trace_max_events", 100000,
                         "the trace: a persistent serving Context stays "
                         "bounded; when a ring wraps the oldest events "
                         "are dropped and Trace.dropped() counts them")
+mca_param.register("profiling.native_ring_events", 16384,
+                   help="per-worker capacity (records) of the NATIVE "
+                        "DTD engine's in-engine event rings "
+                        "(pdtd_obs_enable — ISSUE 13): rings grow x4 "
+                        "up to this cap, then drop-oldest with the "
+                        "drop counter advancing (folded into "
+                        "Trace.dropped() and the trace meta block)")
+mca_param.register("profiling.trace_max_native_sources", 256,
+                   help="native ring snapshots a Trace retains (one "
+                        "per natively-executed pool): a persistent "
+                        "serving context stays bounded — evicted "
+                        "snapshots count into Trace.dropped()")
 
 
 #: first slot of a combined request-span ring record (one entry per
@@ -61,6 +73,124 @@ class _Ring:
         self.dropped = 0
 
 
+class NativeRingAdapter:
+    """Scrape-time bridge from ONE native DTD engine's in-engine event
+    rings (``pdtd_obs_*`` in _native/core.cpp — ISSUE 13) into this
+    trace: ``to_records`` drains the rings at dump/scrape time and
+    expands each fixed-stride 48-byte record into the PR 9 trace-record
+    shape byte-compatibly (same keys, span parenting via the completion
+    dep edges the engine tracked, ``q_us`` from the native ready→select
+    stamps), so chrome/critpath/spans/counts work unchanged on
+    natively-executed pools. While the pool is live the drain is a
+    non-consuming snapshot; at pool retirement :meth:`snapshot` pulls
+    the raw arrays ONCE (one memcpy per ring, zero per-event Python
+    cost) and releases the engine so its C rings can be freed."""
+
+    def __init__(self, engine) -> None:
+        self._lock = threading.Lock()
+        self._engine = engine          # dsl.dtd_native.NativeDTD while live
+        self.tp = engine.tp            # rid/root_span read late-bound
+        self.pool_name = engine.tp.name
+        self.class_names = engine.class_names   # shared, insert-grown
+        self.offset_s = engine.obs_offset_s
+        self._frozen: Optional[List] = None
+        self._frozen_dropped = 0
+
+    def _arrays(self) -> List:
+        with self._lock:
+            if self._frozen is not None:
+                return self._frozen
+            eng = self._engine
+        return eng.obs_drain() if eng is not None else []
+
+    def dropped(self) -> int:
+        """Records lost to native ring wraps (the honesty counter)."""
+        with self._lock:
+            if self._frozen is not None:
+                return self._frozen_dropped
+            eng = self._engine
+        return eng.obs_dropped() if eng is not None else 0
+
+    def event_count(self) -> int:
+        return sum(len(a) for a in self._arrays())
+
+    def raw_arrays(self) -> List:
+        """The structured record arrays themselves (ring-fed consumers
+        like the straggler watchdog's native path)."""
+        return self._arrays()
+
+    def snapshot(self) -> None:
+        """Freeze at pool retirement: drain the rings into owned arrays
+        and drop the engine reference (idempotent)."""
+        with self._lock:
+            if self._frozen is not None:
+                return
+            eng = self._engine
+            self._engine = None
+            if eng is None:
+                self._frozen = []
+                return
+            self._frozen = eng.obs_drain()
+            self._frozen_dropped = eng.obs_dropped()
+
+    def to_records(self, t0: float) -> List[Dict[str, Any]]:
+        """Expand the binary records into PR 9-format event dicts with
+        times relative to the owning trace's ``t0``."""
+        from .. import _native
+        arrays = self._arrays()
+        if not arrays:
+            return []
+        tp = self.tp
+        rid = getattr(tp, "trace_rid", None)
+        root = getattr(tp, "root_span", None)
+        names = self.class_names
+        shift = self.offset_s - t0
+        nonep = _native.OBS_PARENT_NONE
+        span_of: Dict[int, int] = {}
+        for a in arrays:
+            for s, sp in zip(a["seq"].tolist(), a["span"].tolist()):
+                span_of[s] = sp
+        events: List[Dict[str, Any]] = []
+        for a in arrays:
+            t0s = (a["t0_ns"] * 1e-9 + shift).tolist()
+            t1s = (a["t1_ns"] * 1e-9 + shift).tolist()
+            qs = a["q_ns"].tolist()
+            sps = a["span"].tolist()
+            sqs = a["seq"].tolist()
+            pss = a["parent_seq"].tolist()
+            cls = a["cls"].tolist()
+            wks = a["worker"].tolist()
+            for i, seq in enumerate(sqs):
+                sid = sps[i]
+                name = names[cls[i]] if cls[i] < len(names) else "dtd_task"
+                if rid is None:
+                    # profiler shape (no request context): the classic
+                    # begin/end pair, keyed by the unique span id
+                    events.append({"key": "task", "phase": "begin",
+                                   "t": t0s[i], "stream": wks[i],
+                                   "object": sid, "info": {}})
+                    events.append({"key": "task", "phase": "end",
+                                   "t": t1s[i], "stream": -1,
+                                   "object": sid,
+                                   "info": {"class": name,
+                                            "locals": [seq]}})
+                    continue
+                ps = pss[i]
+                parent = root if ps == nonep else span_of.get(ps, root)
+                binfo: Dict[str, Any] = {"rid": rid, "span": sid,
+                                         "parent": parent}
+                if ps != nonep:
+                    binfo["q_us"] = round(qs[i] / 1e3, 1)
+                events.append({"key": "task", "phase": "begin",
+                               "t": t0s[i], "stream": wks[i],
+                               "object": sid, "info": binfo})
+                events.append({"key": "task", "phase": "end",
+                               "t": t1s[i], "stream": -1, "object": sid,
+                               "info": {"class": name, "locals": [seq],
+                                        "span": sid, "rid": rid}})
+        return events
+
+
 class Trace:
     """In-memory trace with a key dictionary (parsec_profiling API analog:
     dictionary entries = add_dictionary_keyword, events = trace_flags)."""
@@ -72,6 +202,12 @@ class Trace:
             mca_param.get("profiling.trace_max_events", 100000)) or 1
         self._rings: Dict[int, _Ring] = {}     # recording thread -> ring
         self._ring_lock = threading.Lock()     # ring creation only
+        # native DTD engines' ring adapters (ISSUE 13): bounded, evicted
+        # snapshots fold into dropped() so a truncated capture is loud
+        self._native_sources: deque = deque()
+        self._native_evicted = 0
+        self._max_native = max(1, int(mca_param.get(
+            "profiling.trace_max_native_sources", 256)))
         self.t0 = time.perf_counter()
         self.rank = 0
         self._comm = None                      # set by install()
@@ -126,9 +262,33 @@ class Trace:
         self.event(key, "end", **kw)
 
     def dropped(self) -> int:
-        """Events lost to ring wraps across every recording thread."""
+        """Events lost to ring wraps across every recording thread,
+        INCLUDING the native engines' in-engine rings and any evicted
+        native snapshots (a truncated native capture must be loud)."""
         with self._ring_lock:
-            return sum(r.dropped for r in self._rings.values())
+            n = sum(r.dropped for r in self._rings.values())
+        return n + self.native_dropped()
+
+    def native_dropped(self) -> int:
+        """The native-ring share of :meth:`dropped` (meta/statusz row)."""
+        with self._ring_lock:
+            n = self._native_evicted
+            sources = list(self._native_sources)
+        return n + sum(src.dropped() for src in sources)
+
+    # -- native DTD engine rings (ISSUE 13) -------------------------------
+    def add_native_source(self, src: "NativeRingAdapter") -> None:
+        """Attach one native engine's ring adapter: its records join
+        ``to_records`` (expanded lazily at dump/scrape time) and its
+        drop counter joins ``dropped()``. Bounded by
+        ``profiling.trace_max_native_sources`` — the oldest snapshot is
+        evicted with its event+drop counts folded into the drop total,
+        so a persistent serving context cannot grow without bound."""
+        with self._ring_lock:
+            self._native_sources.append(src)
+            while len(self._native_sources) > self._max_native:
+                old = self._native_sources.popleft()
+                self._native_evicted += old.event_count() + old.dropped()
 
     # hooks wired by install(). Paired by task.uid (an int — repr()
     # per event measured 2x the whole append cost); the human-readable
@@ -195,7 +355,11 @@ class Trace:
         self.rank = context.my_rank
         from .spans import _RANK_SHIFT
         self._span_base = self.rank << _RANK_SHIFT
-        context.pins.register(PinsEvent.EXEC_BEGIN, self.task_begin)
+        # native_ok: pools on the native DTD engine record the same
+        # begin/end spans into the in-engine rings (ISSUE 13), so a
+        # live trace no longer forces the instrumented Python path
+        context.pins.register(PinsEvent.EXEC_BEGIN, self.task_begin,
+                              native_ok=True)
         if context.comm is not None:
             self._comm = context.comm
             context.comm.install_trace(self)
@@ -205,6 +369,7 @@ class Trace:
     def to_records(self) -> List[Dict[str, Any]]:
         with self._ring_lock:
             rings = list(self._rings.values())
+            native = list(self._native_sources)
         t0 = self.t0
         events: List[Dict[str, Any]] = []
         for r in rings:
@@ -234,6 +399,10 @@ class Trace:
                 events.append({"key": k, "phase": p, "t": t,
                                "stream": s, "object": o,
                                "info": i or {}})
+        for src in native:
+            # natively-executed pools: the in-engine ring records,
+            # expanded here to the byte-compatible event shape
+            events.extend(src.to_records(t0))
         events.sort(key=lambda ev: ev["t"])
         return events
 
@@ -246,8 +415,12 @@ class Trace:
         (t0), the drop counter, and — when a multi-rank comm engine is
         attached — the wire-measured clock offset to rank 0 that makes
         the Perfetto merge align (tools.merge_chrome / spans)."""
+        nd = self.native_dropped()
+        with self._ring_lock:
+            py_dropped = sum(r.dropped for r in self._rings.values())
         out: Dict[str, Any] = {"rank": self.rank, "t0": self.t0,
-                               "dropped": self.dropped()}
+                               "dropped": py_dropped + nd,
+                               "native_dropped": nd}
         comm = self._comm
         if comm is not None:
             try:

@@ -366,11 +366,19 @@ def _native_ab_probe(n_pools: int = 40, rows_per_pool: int = 200) -> Dict:
     both engines. Every task carries the tenant's ``on_retire`` hook, so
     the native engine runs its Python-bodied path: insert, dependency
     countdown, select, steal, and release native; the body + window
-    retire in Python — the serving shape of the hot loop."""
+    retire in Python — the serving shape of the hot loop.
+
+    Since ISSUE 13 both arms run with the FULL observability plane
+    live — the always-on metrics registry AND an installed Trace (the
+    request-span path) — because that is the production configuration:
+    the native engine keeps running under observation (its in-engine
+    event rings record the spans), which this probe asserts with
+    ``engine_native`` per arm."""
     import time as _time
     from .. import _native
     from ..core import context as ctx_mod
     from ..dsl import dtd
+    from ..profiling.trace import Trace
     from ..serving import runtime as srt
     from ..utils import mca_param
 
@@ -387,6 +395,7 @@ def _native_ab_probe(n_pools: int = 40, rows_per_pool: int = 200) -> Dict:
             mca_param.set("runtime.native_dtd", native)
             mca_param.set("sched", "lfq")
             ctx = ctx_mod.init(nb_cores=4)
+            Trace().install(ctx)      # metrics + tracing LIVE, both arms
             rt = srt.enable(ctx)
             ctx.start()
             engines = set()
@@ -402,7 +411,8 @@ def _native_ab_probe(n_pools: int = 40, rows_per_pool: int = 200) -> Dict:
             dt = _time.perf_counter() - t0
             return {"requests_per_sec": round(n_pools / dt, 2),
                     "rows_per_sec": round(n_pools * rows_per_pool / dt, 1),
-                    "engine_native": engines == {True}}
+                    "engine_native": engines == {True},
+                    "trace_native_dropped": ctx.trace.native_dropped()}
         finally:
             mca_param.unset("runtime.native_dtd")
             mca_param.unset("sched")
@@ -417,9 +427,11 @@ def _native_ab_probe(n_pools: int = 40, rows_per_pool: int = 200) -> Dict:
     return {"python": py, "native": nat,
             "native_vs_python": ratio,
             "note": "lfq serving submissions (admission + on_retire per "
-                    "task) A/B'd across runtime.native_dtd; the wfq "
-                    "phase above keeps the instrumented Python path per "
-                    "the fallback rule"}
+                    "task) A/B'd across runtime.native_dtd with metrics "
+                    "+ tracing LIVE on both arms (ISSUE 13: the native "
+                    "engine keeps running under observation via its "
+                    "in-engine event rings); the wfq phase above keeps "
+                    "the instrumented Python path per the fallback rule"}
 
 
 def _null_ab_body(x=None):

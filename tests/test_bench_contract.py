@@ -454,19 +454,29 @@ def test_observability_section_registered():
     """--section observability is a first-class section (ISSUE 9 bench
     contract): registry, error keys, compact summary, and the
     obs_overhead_pct guard stay wired together — the ON rate rides the
-    throughput drop-guard, the overhead pct the rise-guard arm."""
+    throughput drop-guard, the overhead pct the rise-guard arm. ISSUE
+    13 adds the NATIVE arm: obs_native_tasks_per_sec (native engine
+    with metrics + tracing live) and obs_native_overhead_pct (cost vs
+    native-bare) ride the same two guards."""
     bench = _load_bench()
     assert "observability" in bench.SECTIONS
     assert bench._SECTION_KEYS["observability"] == ("observability",)
     assert "obs_tasks_per_sec" in bench._GFLOPS_GUARD_KEYS
     assert "obs_overhead_pct" in bench._LATENCY_GUARD_KEYS
+    assert "obs_native_tasks_per_sec" in bench._GFLOPS_GUARD_KEYS
+    assert "obs_native_overhead_pct" in bench._LATENCY_GUARD_KEYS
     result = _fat_result()
     result["detail"]["extra_configs"]["observability"] = {
         "tasks_per_sec_off": 17322.8, "tasks_per_sec_on": 16744.6,
-        "obs_overhead_pct": 3.45, "obs_overhead_ok": True}
+        "obs_overhead_pct": 3.45, "obs_overhead_ok": True,
+        "obs_native_tasks_per_sec": 601244.5,
+        "native_tasks_per_sec_bare": 668911.2,
+        "obs_native_overhead_pct": 10.1, "obs_native_ok": True}
     compact = json.loads(bench._compact_summary(result))
     assert compact["detail"]["obs_overhead_pct"] == 3.45
     assert compact["detail"]["obs_tasks_per_sec"] == 16744.6
+    assert compact["detail"]["obs_native_tasks_per_sec"] == 601244.5
+    assert compact["detail"]["obs_native_overhead_pct"] == 10.1
 
 
 def test_obs_overhead_guard_fires_on_rise():
@@ -480,3 +490,20 @@ def test_obs_overhead_guard_fires_on_rise():
     assert bench._compare_captures(
         {"obs_overhead_pct": 3.2, "obs_tasks_per_sec": 15800.0},
         prior) == {}
+
+
+def test_obs_native_guard_rows_fire_in_both_directions():
+    """ISSUE 13 acceptance guard: a native-rate drop (observation
+    evicting the engine again) and a native-observer cost rise both
+    fire; within-band changes stay quiet."""
+    bench = _load_bench()
+    prior = {"obs_native_tasks_per_sec": 600000.0,
+             "obs_native_overhead_pct": 8.0}
+    out = bench._compare_captures(
+        {"obs_native_tasks_per_sec": 15000.0,      # fell to Python-rate
+         "obs_native_overhead_pct": 14.0}, prior)   # +75%: cost crept
+    assert "obs_native_tasks_per_sec" in out["throughput_regression"]
+    assert "obs_native_overhead_pct" in out["latency_regression"]
+    assert bench._compare_captures(
+        {"obs_native_tasks_per_sec": 590000.0,
+         "obs_native_overhead_pct": 8.3}, prior) == {}

@@ -99,28 +99,54 @@ def test_engine_engages_by_default_and_knob_disables(nctx):
         mca_param.unset("runtime.native_dtd")
 
 
-@pytest.mark.parametrize("observer", ["pins", "stage_timers", "trace"])
-def test_instrumented_fallback_rule(observer):
-    """Any live per-task observer keeps the pool on the Python path,
-    even with runtime.native_dtd forced on."""
+@pytest.mark.parametrize("observer,expect_native", [
+    # residual Python-pinning list (ISSUE 13, documented in
+    # dsl/dtd_native.py): semantically-intrusive observers only
+    ("dfsan", False),           # stamps/orders every access
+    ("grapher", False),         # records every dep edge
+    ("debug_history", False),   # EXE-mark ring expects every task
+    ("alperf", False),          # per-task sampler, no native source
+    ("counters", False),        # per-task rusage sampler
+    ("straggler", False),       # no trace → no native ring feed
+    # observers that NO LONGER disqualify (the moved fallback line)
+    ("trace", True),            # in-engine event rings record spans
+    ("stage_timers", True),     # stage totals read from C++ atomics
+    ("overhead", True),         # scrape-only (flips stage_timers)
+    ("tenant", True),           # completions folded per tenant at scrape
+    ("straggler+trace", True),  # ring-fed at pool retirement
+    ("metrics", True),          # always-on registry is scrape-time
+])
+def test_instrumented_fallback_rule(observer, expect_native):
+    """The ISSUE 13 fallback matrix: exactly which observers still
+    force the instrumented Python path (with runtime.native_dtd forced
+    on, so a silent mis-classification cannot hide)."""
     if not _native.available():
         pytest.skip("native core unavailable")
     mca_param.set("runtime.native_dtd", 1)
-    if observer == "pins":
-        mca_param.set("pins", "dfsan")
+    pins_mods = {"dfsan": "dfsan", "alperf": "alperf",
+                 "counters": "counters", "straggler": "straggler",
+                 "tenant": "tenant", "overhead": "overhead",
+                 "straggler+trace": "straggler"}
+    if observer in pins_mods:
+        mca_param.set("pins", pins_mods[observer])
     elif observer == "stage_timers":
         mca_param.set("runtime.stage_timers", 1)
+    elif observer == "debug_history":
+        mca_param.set("debug.history_size", 64)
     try:
         ctx = parsec.init(nb_cores=2)
-        if observer == "trace":
+        if observer in ("trace", "straggler+trace"):
             from parsec_tpu.profiling.trace import Trace
             Trace().install(ctx)
+        elif observer == "grapher":
+            from parsec_tpu.profiling.grapher import Grapher
+            Grapher().install(ctx)
         ctx.start()
         tp = dtd.Taskpool(f"obs_{observer}")
         ctx.add_taskpool(tp)
         S = LocalCollection("S", {(0,): 0})
         tp.insert_task(lambda x: x + 1, dtd.TileArg(S, (0,), dtd.INOUT))
-        assert tp._native is None
+        assert (tp._native is not None) == expect_native, observer
         tp.wait()
         assert S.data_of((0,)) == 1
         parsec.fini(ctx)
@@ -128,6 +154,7 @@ def test_instrumented_fallback_rule(observer):
         mca_param.unset("runtime.native_dtd")
         mca_param.unset("pins")
         mca_param.unset("runtime.stage_timers")
+        mca_param.unset("debug.history_size")
 
 
 def test_wfq_scheduler_keeps_python_path_and_pool_stats():

@@ -162,9 +162,13 @@ def test_local_submission_yields_span_tree():
         tp = dtd.Taskpool("spanpool")
         sub = ctx.submit(tp, tenant="t")
         S = LocalCollection("S", {(0,): np.zeros(2, np.float32)})
-        for _ in range(4):
-            tp.insert_task(lambda x: x + 1,
-                           dtd.TileArg(S, (0,), dtd.INOUT))
+        # ONE batch: the RAW chain links deterministically on both
+        # engines (per-call inserts can complete before the next call
+        # links, snapshotting instead — the ISSUE 13 native engine is
+        # fast enough to make that race the common case)
+        tp.insert_tasks(lambda x: x + 1,
+                        [(dtd.TileArg(S, (0,), dtd.INOUT),)
+                         for _ in range(4)])
         tp.wait()
         sub.wait()
         doc = {"meta": tr.meta(), "events": tr.to_records()}

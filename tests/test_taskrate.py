@@ -67,26 +67,61 @@ def test_null_task_rate_floor(native):
 
 def test_overhead_module_reports_stage_breakdown():
     """The `overhead` PINS module flips runtime.stage_timers and reports
-    nonzero per-stage timers covering every task."""
+    nonzero per-stage timers covering every task. Pinned to the PYTHON
+    engine: since ISSUE 13 the module no longer forces the fallback,
+    and the per-stage Python timers only cover that path."""
+    mca_param.set("runtime.native_dtd", 0)
+    try:
+        ctx = parsec.init(nb_cores=2)
+        mod = new_module("overhead").install(ctx)
+        assert ctx.stage_timers
+        ctx.start()
+        tp = dtd.Taskpool("taskrate_instr")
+        ctx.add_taskpool(tp)
+        tp.insert_tasks(_null_body, [() for _ in range(200)],
+                        device=DeviceType.CPU)
+        tp.wait()
+        rep = mod.report()
+        parsec.fini(ctx)
+        assert rep["executed"] == 200
+        assert rep["insert_calls"] == 200
+        per = rep["per_task_us"]
+        assert set(per) == {"insert", "select", "dispatch", "release"}
+        assert per["insert"] > 0 and per["dispatch"] > 0
+        assert rep["release_s"] > 0 and rep["select_s"] >= 0
+        mod.uninstall()
+        assert not ctx.stage_timers
+    finally:
+        mca_param.unset("runtime.native_dtd")
+
+
+def test_overhead_module_keeps_native_engine_and_insert_row():
+    """ISSUE 13: the overhead module is scrape-only — a pool under it
+    KEEPS the native engine, the insert-stage row is still accounted
+    (on the inserting thread), and the per-stage counts come from the
+    engine's C++ atomics instead of the Python stream timers."""
+    from parsec_tpu import _native
+    if not _native.available():
+        pytest.skip("native core unavailable")
     ctx = parsec.init(nb_cores=2)
-    mod = new_module("overhead").install(ctx)
-    assert ctx.stage_timers
-    ctx.start()
-    tp = dtd.Taskpool("taskrate_instr")
-    ctx.add_taskpool(tp)
-    tp.insert_tasks(_null_body, [() for _ in range(200)],
-                    device=DeviceType.CPU)
-    tp.wait()
-    rep = mod.report()
-    parsec.fini(ctx)
-    assert rep["executed"] == 200
-    assert rep["insert_calls"] == 200
-    per = rep["per_task_us"]
-    assert set(per) == {"insert", "select", "dispatch", "release"}
-    assert per["insert"] > 0 and per["dispatch"] > 0
-    assert rep["release_s"] > 0 and rep["select_s"] >= 0
-    mod.uninstall()
-    assert not ctx.stage_timers
+    try:
+        mod = new_module("overhead").install(ctx)
+        ctx.start()
+        tp = dtd.Taskpool("taskrate_native_instr")
+        ctx.add_taskpool(tp)
+        tp.insert_tasks(_null_body, [() for _ in range(200)],
+                        device=DeviceType.CPU)
+        assert tp._native is not None          # no fallback
+        tp.wait()
+        rep = mod.report()
+        assert rep["insert_calls"] == 200      # native insert row
+        assert rep["insert_s"] > 0
+        st = ctx.native_dtd_stats()
+        assert st["inserted"] == 200
+        assert st["completed_native"] + st["completed_python"] == 200
+        mod.uninstall()
+    finally:
+        parsec.fini(ctx)
 
 
 def test_stage_timers_off_by_default():
