@@ -12,6 +12,21 @@ native engine carries the dependency-tracking, dynamic-task (DTD), and
 static-DAG execution hot paths, mirroring the reference where those
 layers are native C (parsec/parsec.c, parsec/scheduling.c,
 parsec/interfaces/dtd/insert_function.c, parsec/class/*).
+
+Sanitizer build lane (ISSUE 14): ``native.sanitize = off|tsan|asan|
+ubsan`` (MCA knob; env ``PARSEC_NATIVE_SAN`` wins so sanitized
+subprocesses need no MCA plumbing) selects a BUILD VARIANT. Each
+variant compiles to its own cached binary (``libparsec_core.tsan.so``,
+…) whose stamp records the source hash AND the flag set, so sanitized
+and production binaries coexist and neither can be served stale for
+the other. Sanitizer variants compile with ``-DPARSEC_SAN_YIELD=1``
+(the seeded yield-injection points that widen the explored
+interleaving space) at ``-O1 -g``; the production variant is exactly
+the PR 10 build. Loading a sanitized variant into a Python process
+requires the sanitizer runtime to be preloaded (``LD_PRELOAD`` of
+:func:`sanitizer_runtime`'s path) — ``_native/sanlane.py`` wraps that
+subprocess dance and the all-native stress driver
+(``sanstress.cpp``).
 """
 
 from __future__ import annotations
@@ -21,17 +36,45 @@ import hashlib
 import os
 import subprocess
 import threading
-from typing import Optional
+from typing import Dict, Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "core.cpp")
 _SO = os.path.join(_HERE, "libparsec_core.so")
 _STAMP = _SO + ".srchash"
 
+#: sanitizer variants: variant -> the g++ flags that define it. The
+#: production variant ("off") is the plain -O2 build; every sanitizer
+#: variant compiles the PSAN_YIELD injection points in.
+SAN_FLAGS = {
+    "tsan": ("-fsanitize=thread",),
+    "asan": ("-fsanitize=address", "-fno-omit-frame-pointer"),
+    "ubsan": ("-fsanitize=undefined", "-fno-sanitize-recover=undefined"),
+}
+#: gcc runtime library each variant's .so needs preloaded when loaded
+#: into an unsanitized host process (CPython)
+SAN_RUNTIME_LIB = {"tsan": "libtsan.so", "asan": "libasan.so",
+                   "ubsan": "libubsan.so"}
+#: pdtd lock-discipline recorder domains, in C enum order (core.cpp
+#: PdtdLockDomain) — index = domain id inside the pdtd_stats
+#: ``lock_pairs`` bitmask (bit held*5+acquired)
+PDTD_LOCK_DOMAINS = ("entry", "grow", "overflow", "cv", "ring")
+
+try:                                    # MCA knob for the lane; the env
+    from ..utils import mca_param as _mca   # var PARSEC_NATIVE_SAN wins
+    _mca.register(
+        "native.sanitize", "off",
+        choices=("off", "tsan", "asan", "ubsan"),
+        help="native-core build variant: off (production -O2) | "
+             "tsan/asan/ubsan (sanitizer-instrumented, cached "
+             "per-variant; env PARSEC_NATIVE_SAN overrides)")
+except Exception:  # pragma: no cover — direct import outside the pkg
+    _mca = None
+
 _lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_tried = False
-_build_error: Optional[str] = None
+_libs: Dict[str, Optional[ctypes.CDLL]] = {}
+_tried_variants: set = set()
+_build_errors: Dict[str, str] = {}
 
 BODY_FN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_uint32, ctypes.c_int32)
 
@@ -46,7 +89,12 @@ PDTD_STAT_KEYS = (
     "released_edges", "output_drops", "dropped_cancelled",
     "ring_highwater", "inflight", "ready", "pump_calls",
     "obs_recorded", "obs_dropped", "obs_ring_depth",
-    "reserved", "reserved")
+    # lock-discipline recorder (ISSUE 14): lock_pairs is the
+    # (held*5+acquired) acquisition-pair BITMASK over
+    # PDTD_LOCK_DOMAINS — OR-folded across engines, never summed;
+    # lock_acquires counts recorded acquisitions (0 unless
+    # pdtd_lockdbg_enable was called)
+    "lock_pairs", "lock_acquires")
 
 #: numpy dtype mirroring the C PdtdObsRec (48-byte fixed stride): one
 #: binary record per completed native-engine task, expanded to the
@@ -69,43 +117,109 @@ def _src_hash() -> str:
         return hashlib.sha256(f.read()).hexdigest()[:16]
 
 
-def _build() -> bool:
-    global _build_error
+def variant() -> str:
+    """The ACTIVE build variant: env ``PARSEC_NATIVE_SAN`` first (so a
+    sanitized subprocess lane needs only one env var), then the
+    ``native.sanitize`` MCA knob. Unknown values raise — a typo'd
+    sanitizer name must not silently mean "production build"."""
+    v = os.environ.get("PARSEC_NATIVE_SAN", "").strip().lower()
+    if not v and _mca is not None:
+        v = str(_mca.get("native.sanitize", "off")).strip().lower()
+    if v in ("", "0", "off", "none", "false"):
+        return "off"
+    if v not in SAN_FLAGS:
+        raise ValueError(
+            f"unknown native sanitizer variant {v!r}; choices are "
+            f"off, {', '.join(sorted(SAN_FLAGS))}")
+    return v
+
+
+def so_path(var: str = "off") -> str:
+    """Per-variant binary path: sanitized and production .so coexist."""
+    return _SO if var == "off" else \
+        os.path.join(_HERE, f"libparsec_core.{var}.so")
+
+
+def build_flags(var: str = "off"):
+    """The g++ flag set defining variant ``var`` (part of its cache
+    stamp — a flag change rebuilds)."""
+    if var == "off":
+        return ["-O2", "-std=c++17"]
+    return ["-O1", "-g", "-DPARSEC_SAN_YIELD=1", *SAN_FLAGS[var],
+            "-std=c++17"]
+
+
+def _stamp_want(var: str) -> str:
+    # production stamp stays the bare source hash (the PR 10 format, so
+    # an existing deployment's stamp remains valid); variant stamps add
+    # the flag set
+    h = _src_hash()
+    return h if var == "off" else h + " " + " ".join(build_flags(var))
+
+
+def sanitizer_runtime(var: str) -> Optional[str]:
+    """Absolute path of the gcc sanitizer runtime to LD_PRELOAD when
+    loading variant ``var``'s .so into an unsanitized process, or None
+    when unresolvable (no g++ / static-only runtime)."""
+    name = SAN_RUNTIME_LIB.get(var)
+    if name is None:
+        return None
     try:
-        want = _src_hash()
+        out = subprocess.run(["g++", f"-print-file-name={name}"],
+                             capture_output=True, text=True, timeout=30)
+        path = out.stdout.strip()
+        if path and path != name and os.path.exists(path):
+            return os.path.abspath(path)
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return None
+
+
+def _build(var: str = "off") -> bool:
+    so = so_path(var)
+    stamp = so + ".srchash"
+    try:
+        want = _stamp_want(var)
     except OSError as exc:
-        _build_error = f"cannot read {_SRC}: {exc}"
+        _build_errors[var] = f"cannot read {_SRC}: {exc}"
         return False
-    if os.path.exists(_SO):
+    if os.path.exists(so):
         try:
-            with open(_STAMP) as f:
+            with open(stamp) as f:
                 have = f.read().strip()
         except OSError:
             have = ""               # pre-hash .so (or stamp lost): rebuild
         if have == want:
             return True
-    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-           "-o", _SO + ".tmp", _SRC]
+    cmd = ["g++", *build_flags(var), "-shared", "-fPIC", "-pthread",
+           "-o", so + ".tmp", _SRC]
+    # never compile UNDER a sanitizer runtime: a sanitized Python lane
+    # (LD_PRELOAD=libtsan) would otherwise run g++/cc1plus themselves
+    # through TSan's shadow — observed as a multi-minute hang
+    env = dict(os.environ)
+    env.pop("LD_PRELOAD", None)
     try:
         proc = subprocess.run(cmd, check=True, capture_output=True,
-                              timeout=120)
+                              timeout=240, env=env)
         del proc
-        os.replace(_SO + ".tmp", _SO)
-        with open(_STAMP, "w") as f:
+        os.replace(so + ".tmp", so)
+        with open(stamp, "w") as f:
             f.write(want)
         return True
     except FileNotFoundError:
-        _build_error = "g++ not found on PATH"
+        _build_errors[var] = "g++ not found on PATH"
     except subprocess.CalledProcessError as exc:
         tail = (exc.stderr or b"").decode(errors="replace")[-500:]
-        _build_error = f"g++ failed (rc={exc.returncode}): {tail}"
+        _build_errors[var] = f"g++ failed (rc={exc.returncode}): {tail}"
     except (OSError, subprocess.SubprocessError) as exc:
-        _build_error = f"build failed: {exc}"
+        _build_errors[var] = f"build failed: {exc}"
     # rebuild impossible but a (prebuilt / stampless) .so exists: try
     # it — a deployment shipping the binary without the toolchain must
     # not lose the native engine; a STALE binary missing newly-added
-    # symbols fails the bind cleanly (load()'s AttributeError guard)
-    return os.path.exists(_SO)
+    # symbols fails the bind cleanly (load()'s AttributeError guard).
+    # Sanitizer variants never take this fallback: an unverifiable
+    # sanitized binary would undermine the zero-report contract.
+    return var == "off" and os.path.exists(so)
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -166,6 +280,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pdtd_wait_below.restype = u32
     lib.pdtd_cancel.argtypes = [p]
     lib.pdtd_stats.argtypes = [p, ctypes.POINTER(u64)]
+    # sanitizer lane + lock-discipline recorder (ISSUE 14)
+    lib.psan_seed.argtypes = [u64]
+    lib.psan_yield_enabled.restype = ctypes.c_int
+    lib.pdtd_lockdbg_enable.argtypes = [p]
     # pdtd observability plane (ISSUE 13): per-worker event rings
     lib.pdtd_obs_now.argtypes = []
     lib.pdtd_obs_now.restype = u64
@@ -208,30 +326,46 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
-def load() -> Optional[ctypes.CDLL]:
-    """The native library, or None when it cannot be built/loaded."""
-    global _lib, _tried, _build_error
+def load(var: Optional[str] = None) -> Optional[ctypes.CDLL]:
+    """The native library for build variant ``var`` (default: the
+    ACTIVE variant — ``native.sanitize`` / ``PARSEC_NATIVE_SAN``), or
+    None when it cannot be built/loaded. Loading a sanitizer variant
+    requires its runtime preloaded into the process (sanlane.py runs
+    that in a subprocess); a bare dlopen without it fails here and the
+    error names the runtime."""
+    try:
+        v = variant() if var is None else var
+    except ValueError:
+        # build_error() re-derives the message from variant() itself
+        return None
     with _lock:
-        if _lib is not None or _tried:
-            return _lib
-        _tried = True
+        if v in _tried_variants:
+            return _libs.get(v)
+        _tried_variants.add(v)
+        _libs[v] = None
+        lib = None
         if os.environ.get("PARSEC_NO_NATIVE"):
-            _build_error = "disabled by PARSEC_NO_NATIVE"
-            return None
-        if not _build():
-            return None
-        try:
-            _lib = _bind(ctypes.CDLL(_SO))
-        except OSError as exc:
-            _build_error = f"dlopen({_SO}) failed: {exc}"
-            _lib = None
-        except AttributeError as exc:
-            # a stale .so missing newly-added symbols: the source-hash
-            # stamp normally prevents this; surface it instead of a
-            # confusing partial bind
-            _build_error = f"stale {_SO}: {exc}"
-            _lib = None
-        return _lib
+            _build_errors[v] = "disabled by PARSEC_NO_NATIVE"
+        elif not _build(v):
+            _build_errors.setdefault(v, "build failed")
+        else:
+            so = so_path(v)
+            try:
+                lib = _bind(ctypes.CDLL(so))
+            except OSError as exc:
+                hint = ""
+                if v != "off":
+                    rt = sanitizer_runtime(v)
+                    hint = (f" (sanitized variant: LD_PRELOAD="
+                            f"{rt or SAN_RUNTIME_LIB[v]} is required)")
+                _build_errors[v] = f"dlopen({so}) failed: {exc}{hint}"
+            except AttributeError as exc:
+                # a stale .so missing newly-added symbols: the
+                # source-hash stamp normally prevents this; surface it
+                # instead of a confusing partial bind
+                _build_errors[v] = f"stale {so}: {exc}"
+        _libs[v] = lib
+        return lib
 
 
 def available() -> bool:
@@ -241,9 +375,13 @@ def available() -> bool:
 def build_error() -> Optional[str]:
     """Why the native library is unavailable (None when it loaded, or
     when load() was never attempted)."""
-    load()
-    return None if _lib is not None else \
-        (_build_error or "native library unavailable")
+    if load() is not None:
+        return None
+    try:
+        v = variant()
+    except ValueError as exc:
+        return str(exc)
+    return _build_errors.get(v) or "native library unavailable"
 
 
 def kahn_levels(n: int, edges) -> "Optional[list]":

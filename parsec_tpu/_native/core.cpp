@@ -53,7 +53,173 @@ static inline uint64_t pdtd_now_ns() {
       .count();
 }
 
+// ---------------------------------------------------------------------------
+// Sanitizer lane (ISSUE 14): seeded yield-injection points.
+//
+// Compiled in ONLY under the sanitizer build variants (the loader passes
+// -DPARSEC_SAN_YIELD=1 for tsan/asan/ubsan — _native/__init__.py): each
+// PSAN_YIELD() site runs a seeded per-thread xorshift and yields the OS
+// slice on a fraction of visits, widening the interleaving space the
+// stress suite (tests/test_native_san.py, _native/sanstress.py) explores
+// per run — especially inside the plifo CAS windows, where the ABA-tag
+// protocol needs contended retries to be exercised at all. Production
+// builds compile every site to nothing.
+// ---------------------------------------------------------------------------
+
+// Timed cv waits under the sanitizer variants go against the SYSTEM
+// clock: libstdc++ implements steady-clock waits (wait_for and
+// steady wait_until) via pthread_cond_clockwait, which gcc-10's
+// libtsan does not intercept — TSan then never sees the mutex release
+// inside the wait and reports a bogus "double lock" on the next
+// acquisition. The system-clock path runs the (intercepted)
+// pthread_cond_timedwait. Production builds keep the steady clock
+// (immune to wall-clock jumps); the sanitizer build trades that for a
+// toolchain whose model matches the code — our code, no suppressions.
+template <typename CV, typename LK, typename PRED>
+static inline void pdtd_cv_wait_ms(CV& cv, LK& lk, int ms, PRED pred) {
+#ifdef PARSEC_SAN_YIELD
+  cv.wait_until(lk, std::chrono::system_clock::now() +
+                        std::chrono::milliseconds(ms),
+                pred);
+#else
+  cv.wait_for(lk, std::chrono::milliseconds(ms), pred);
+#endif
+}
+
+// no-predicate form: ANY notify ends the wait (the pgraph idle park —
+// a predicated wait would sleep through push_local's notify_one)
+template <typename CV, typename LK>
+static inline void pdtd_cv_wait_ms(CV& cv, LK& lk, int ms) {
+#ifdef PARSEC_SAN_YIELD
+  cv.wait_until(lk, std::chrono::system_clock::now() +
+                        std::chrono::milliseconds(ms));
+#else
+  cv.wait_for(lk, std::chrono::milliseconds(ms));
+#endif
+}
+
+#ifdef PARSEC_SAN_YIELD
+static std::atomic<uint64_t> g_psan_seed{0x9e3779b97f4a7c15ull};
+static thread_local uint64_t t_psan_state = 0;
+static inline void psan_yield_point() {
+  if (t_psan_state == 0)
+    t_psan_state = g_psan_seed.fetch_add(0x9e3779b97f4a7c15ull,
+                                         std::memory_order_relaxed) | 1;
+  uint64_t x = t_psan_state;
+  x ^= x << 13;
+  x ^= x >> 7;
+  x ^= x << 17;
+  t_psan_state = x;
+  if ((x & 7u) == 0) std::this_thread::yield();
+}
+#define PSAN_YIELD() psan_yield_point()
+#else
+#define PSAN_YIELD() ((void)0)
+#endif
+
+// ---------------------------------------------------------------------------
+// Lock-discipline recorder (ISSUE 14): a debug-mode acquisition-pair
+// table over the pdtd engine's mutex domains, kept on C++ atomics so
+// recording never adds a lock of its own. Enabled per engine
+// (pdtd_lockdbg_enable — the Python driver turns it on when the dfsan
+// sanitizer is installed); when off, every site pays one relaxed bool
+// load. ``pairs`` is a bitmask over (held_domain, acquired_domain):
+// bit held*5+acquired set means "a thread acquired <acquired> while
+// holding <held> of the same engine". Scraped through pdtd_stats
+// (slots 18/19) and fed to dfsan's lock-order inversion detector,
+// which flags any cycle — including the self-edge of two nested
+// same-domain (entry) locks, the classic DTD deadlock shape. The
+// shipped hot loop's discipline is nesting-free: a healthy run records
+// ZERO pairs.
+// ---------------------------------------------------------------------------
+
+enum PdtdLockDomain {
+  PLK_ENTRY = 0,     // per-task entry mutex (the seq-stripe lock's role)
+  PLK_GROW = 1,      // task-table segment growth
+  PLK_OVERFLOW = 2,  // shared overflow dequeue
+  PLK_CV = 3,        // inserter-window / drain condition variable
+  PLK_RING = 4,      // observability ring growth/drain
+};
+static constexpr int kLockDomains = 5;
+
+struct PdtdLockDbg {
+  std::atomic<bool> on{false};
+  std::atomic<uint64_t> pairs{0};     // (held*5+acq) bitmask
+  std::atomic<uint64_t> acquires{0};  // recorded acquisitions
+};
+
+struct PdtdHeldLock {
+  const void* owner;  // the engine's PdtdLockDbg (identity)
+  int domain;
+};
+// strictly scope-nested (every site is RAII), so the stack is LIFO
+// even when engines interleave on one thread
+static thread_local PdtdHeldLock t_lock_stack[16];
+static thread_local int t_lock_depth = 0;
+
+// record-only note: the CALLER owns the actual mutex (so cv waits can
+// use unique_lock); construct after acquiring, destroy before release
+struct PdtdLockNote {
+  PdtdLockDbg* d_ = nullptr;
+  PdtdLockNote(PdtdLockDbg* d, int domain) {
+    if (!d->on.load(std::memory_order_relaxed)) return;
+    d->acquires.fetch_add(1, std::memory_order_relaxed);
+    for (int i = 0; i < t_lock_depth; ++i) {
+      if (t_lock_stack[i].owner == d)
+        d->pairs.fetch_or(
+            1ull << (t_lock_stack[i].domain * kLockDomains + domain),
+            std::memory_order_relaxed);
+    }
+    if (t_lock_depth < 16) {
+      t_lock_stack[t_lock_depth++] = {d, domain};
+      d_ = d;
+    }
+  }
+  ~PdtdLockNote() {
+    if (d_ != nullptr && t_lock_depth > 0) --t_lock_depth;
+  }
+  PdtdLockNote(const PdtdLockNote&) = delete;
+  PdtdLockNote& operator=(const PdtdLockNote&) = delete;
+};
+
+// lock_guard + note in one RAII: the standard pdtd lock site
+class PdtdLockRec {
+  std::lock_guard<std::mutex> lk_;
+  PdtdLockNote note_;  // declared after lk_: records while held,
+                       // pops before the unlock
+ public:
+  PdtdLockRec(PdtdLockDbg* d, int domain, std::mutex& mu)
+      : lk_(mu), note_(d, domain) {}
+};
+
 extern "C" {
+
+// sanitizer-lane controls: reseed the yield-injection PRNG streams (a
+// different seed explores a different interleaving neighborhood) and
+// report whether this build compiled the injection points in at all —
+// both bind on every variant so the loader's ABI stays uniform
+void psan_seed(uint64_t seed) {
+#ifdef PARSEC_SAN_YIELD
+  g_psan_seed.store(seed | 1, std::memory_order_relaxed);
+#else
+  (void)seed;
+#endif
+}
+
+int psan_yield_enabled(void) {
+#ifdef PARSEC_SAN_YIELD
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+// lock-discipline recorder control (ISSUE 14): per-engine opt-in. The
+// enable is one relaxed store — the Python driver flips it at engine
+// construction when the dfsan sanitizer is installed, before any
+// worker can be pumping, so recording sites never observe a torn
+// transition mid-acquisition.
+void pdtd_lockdbg_enable(void* ep);  // defined after Pdtd below
 
 // ---------------------------------------------------------------------------
 // pdep: concurrent dependency table.
@@ -133,6 +299,7 @@ int pdep_update(void* t, uint64_t key, uint64_t goal, uint32_t dep_bit,
 // entry exists (nothing arrived yet).
 int pdep_finalize(void* t, uint64_t key, uint64_t goal, int mode,
                   int32_t* out_priority) {
+  (void)mode;  // count and mask entries finalize identically (acc==goal)
   Pdep* p = static_cast<Pdep*>(t);
   PdepStripe& s = p->stripe(key);
   std::lock_guard<std::mutex> lk(s.mu);
@@ -278,7 +445,7 @@ struct PGraph {
            error.load(std::memory_order_relaxed) == 0) {
       if (!pop(w, &tid)) {
         std::unique_lock<std::mutex> lk(idle_mu);
-        idle_cv.wait_for(lk, std::chrono::milliseconds(1));
+        pdtd_cv_wait_ms(idle_cv, lk, 1);
         continue;
       }
       int rc = body(tid, w);  // ctypes callback: takes the GIL per call
@@ -433,6 +600,7 @@ static uint32_t plifo_stack_pop(Plifo* l, std::atomic<uint64_t>& h) {
     uint64_t next = Plifo::pack(
         l->pool[idx].next.load(std::memory_order_relaxed),
         Plifo::tag_of(old) + 1);
+    PSAN_YIELD();  // widen the read-next → CAS window (the ABA target)
     if (h.compare_exchange_weak(old, next, std::memory_order_acq_rel))
       return idx;
   }
@@ -444,6 +612,7 @@ static void plifo_stack_push(Plifo* l, std::atomic<uint64_t>& h,
   while (true) {
     l->pool[idx].next.store(Plifo::idx_of(old), std::memory_order_relaxed);
     uint64_t desired = Plifo::pack(idx, Plifo::tag_of(old) + 1);
+    PSAN_YIELD();  // widen the link-next → CAS window
     if (h.compare_exchange_weak(old, desired, std::memory_order_acq_rel))
       return;
   }
@@ -784,12 +953,21 @@ struct Pdtd {
       s_ring_hw{0}, s_pump_calls{0};
 
   // observability plane (pdtd_obs_enable): off by default — the hot
-  // loop pays ONE relaxed bool load per stamp site when off
+  // loop pays ONE relaxed bool load per stamp site when off. Sites
+  // that go on to DEREFERENCE obs_rings load it with acquire so the
+  // enable-time ring construction is ordered before first use by the
+  // atomic itself (standard C++ release/acquire — TSan models it
+  // natively, no suppression needed); stamp-only sites (plain fields
+  // on the task entry, published later by the ready-push/completion
+  // chain) keep the relaxed load.
   std::atomic<bool> obs_on{false};
   uint64_t obs_span_base = 0;
   uint32_t obs_cap_max = 0;
   std::vector<PdtdObsRing*> obs_rings;
   std::atomic<uint64_t> s_obs_recorded{0}, s_obs_dropped{0};
+
+  // lock-discipline recorder (ISSUE 14; see PdtdLockDbg above)
+  PdtdLockDbg lockdbg;
 
   ~Pdtd() {
     for (uint32_t s = 0; s < kMaxSegs; ++s) {
@@ -813,7 +991,7 @@ struct Pdtd {
     PdtdObsRing* r = obs_rings[w];
     uint64_t wp = r->wpos.load(std::memory_order_relaxed);
     if (wp >= r->cap && r->cap < obs_cap_max) {
-      std::lock_guard<std::mutex> lk(r->mu);
+      PdtdLockRec lk(&lockdbg, PLK_RING, r->mu);
       uint32_t ncap = r->cap * 4;
       if (ncap > obs_cap_max || ncap < r->cap) ncap = obs_cap_max;
       PdtdObsRec* nb = new (std::nothrow) PdtdObsRec[ncap];
@@ -823,8 +1001,9 @@ struct Pdtd {
         r->cap = ncap;
       }
     }
+    PSAN_YIELD();  // between the fill and the wpos publish below
     if (wp >= r->cap) {
-      std::lock_guard<std::mutex> lk(r->mu);
+      PdtdLockRec lk(&lockdbg, PLK_RING, r->mu);
       s_obs_dropped.fetch_add(1, std::memory_order_relaxed);
       obs_fill(r->buf[wp % r->cap], w, tid, t, t1);
       r->wpos.store(wp + 1, std::memory_order_release);
@@ -855,7 +1034,7 @@ struct Pdtd {
   }
 
   bool ensure(uint32_t upto) {  // segments covering task ids [0, upto)
-    std::lock_guard<std::mutex> lk(grow_mu);
+    PdtdLockRec lk(&lockdbg, PLK_GROW, grow_mu);
     uint32_t need = (upto + kSegSize - 1) >> kSegBits;
     if (need > kMaxSegs) return false;
     for (uint32_t s = 0; s < need; ++s) {
@@ -872,8 +1051,9 @@ struct Pdtd {
     s_ready_pushed.fetch_add(1, std::memory_order_relaxed);
     if (obs_on.load(std::memory_order_relaxed))
       task(tid)->t_ready_ns = pdtd_now_ns();
+    PSAN_YIELD();
     if (plifo_push(queues[w], tid) != 0) {
-      std::lock_guard<std::mutex> lk(overflow_mu);
+      PdtdLockRec lk(&lockdbg, PLK_OVERFLOW, overflow_mu);
       overflow.push_back(tid);
       s_overflow.fetch_add(1, std::memory_order_relaxed);
     }
@@ -887,6 +1067,7 @@ struct Pdtd {
       return true;
     }
     for (int i = 1; i < nworkers; ++i) {
+      PSAN_YIELD();
       if (plifo_pop(queues[(w + i) % nworkers], &item)) {
         *out = (uint32_t)item;
         s_stolen.fetch_add(1, std::memory_order_relaxed);
@@ -894,7 +1075,7 @@ struct Pdtd {
       }
     }
     {
-      std::lock_guard<std::mutex> lk(overflow_mu);
+      PdtdLockRec lk(&lockdbg, PLK_OVERFLOW, overflow_mu);
       if (!overflow.empty()) {
         *out = overflow.front();
         overflow.pop_front();
@@ -908,7 +1089,7 @@ struct Pdtd {
   void retire_one() {
     if (inflight.fetch_sub(1, std::memory_order_acq_rel) == 1 ||
         waiters.load(std::memory_order_acquire) > 0) {
-      std::lock_guard<std::mutex> lk(cv_mu);
+      PdtdLockRec lk(&lockdbg, PLK_CV, cv_mu);
       cv.notify_all();
     }
   }
@@ -924,7 +1105,7 @@ struct Pdtd {
       PdtdTask* s = task(sid);
       bool ready = false, armed = false;
       {
-        std::lock_guard<std::mutex> lk(s->mu);
+        PdtdLockRec lk(&lockdbg, PLK_ENTRY, s->mu);
         s->arrived += 1;
         if (s->goal >= 0 && s->arrived == s->goal && !s->done) {
           if (obs) s->parent_seq = src;
@@ -963,11 +1144,13 @@ struct Pdtd {
     PdtdTask* t = task(tid);
     std::vector<uint32_t> succs;
     {
-      std::lock_guard<std::mutex> lk(t->mu);
+      PdtdLockRec lk(&lockdbg, PLK_ENTRY, t->mu);
       t->done = true;
       succs.swap(t->succs);
     }
-    if (obs_on.load(std::memory_order_relaxed))
+    // acquire: this site DEREFERENCES obs_rings, so the enable-time
+    // ring construction must be ordered before first use
+    if (obs_on.load(std::memory_order_acquire))
       obs_record(w, tid, t, pdtd_now_ns());
     release_succs(w, tid, succs);
     drop_preds(t->lpreds, nullptr, 0);
@@ -985,7 +1168,7 @@ struct Pdtd {
     PdtdTask* t = task(tid);
     std::vector<uint32_t> succs;
     {
-      std::lock_guard<std::mutex> lk(t->mu);
+      PdtdLockRec lk(&lockdbg, PLK_ENTRY, t->mu);
       t->done = true;
       succs.swap(t->succs);
     }
@@ -1045,7 +1228,7 @@ int64_t pdtd_insert(void* ep, uint32_t n, const int32_t* prio,
       PdtdTask* p = e->task(pid);
       bool linked = false;
       {
-        std::lock_guard<std::mutex> lk(p->mu);
+        PdtdLockRec lk(&e->lockdbg, PLK_ENTRY, p->mu);
         if (!p->done) {
           p->succs.push_back(tid);
           p->nconsumers.fetch_add(1, std::memory_order_relaxed);
@@ -1062,7 +1245,7 @@ int64_t pdtd_insert(void* ep, uint32_t n, const int32_t* prio,
     // publish the goal and finalize against arrivals that raced ahead
     // (an already-linked pred may have completed before this point)
     {
-      std::lock_guard<std::mutex> lk(t->mu);
+      PdtdLockRec lk(&e->lockdbg, PLK_ENTRY, t->mu);
       t->goal = goal;
       if (t->arrived == goal) t->ready_deferred = true;
     }
@@ -1087,7 +1270,7 @@ void pdtd_arm(void* ep, uint32_t first, uint32_t n) {
     PdtdTask* t = e->task(tid);
     bool ready = false;
     {
-      std::lock_guard<std::mutex> lk(t->mu);
+      PdtdLockRec lk(&e->lockdbg, PLK_ENTRY, t->mu);
       t->armed = true;
       if (t->ready_deferred) {
         t->ready_deferred = false;
@@ -1184,12 +1367,13 @@ int pdtd_complete(void* ep, int worker, uint32_t tid, uint32_t* drops_out,
   PdtdTask* t = e->task(tid);
   std::vector<uint32_t> succs;
   {
-    std::lock_guard<std::mutex> lk(t->mu);
+    PdtdLockRec lk(&e->lockdbg, PLK_ENTRY, t->mu);
     if (t->done) return -1;
     t->done = true;
     succs.swap(t->succs);
   }
-  if (e->obs_on.load(std::memory_order_relaxed)) {
+  // acquire before dereferencing obs_rings (see complete_native)
+  if (e->obs_on.load(std::memory_order_acquire)) {
     if (t0_ns) t->t_sel_ns = t0_ns;
     e->obs_record(worker, tid, t, t1_ns ? t1_ns : pdtd_now_ns());
   }
@@ -1214,7 +1398,8 @@ int pdtd_complete_batch(void* ep, int worker, const uint32_t* tids,
                         int n, const uint64_t* t01) {
   Pdtd* e = static_cast<Pdtd*>(ep);
   if (worker < 0 || worker >= e->nworkers) worker = 0;
-  bool obs = e->obs_on.load(std::memory_order_relaxed);
+  // acquire before dereferencing obs_rings (see complete_native)
+  bool obs = e->obs_on.load(std::memory_order_acquire);
   int newly = 0;
   std::vector<uint32_t> succs;
   for (int i = 0; i < n; ++i) {
@@ -1223,7 +1408,7 @@ int pdtd_complete_batch(void* ep, int worker, const uint32_t* tids,
     PdtdTask* t = e->task(tid);
     succs.clear();
     {
-      std::lock_guard<std::mutex> lk(t->mu);
+      PdtdLockRec lk(&e->lockdbg, PLK_ENTRY, t->mu);
       if (t->done) continue;
       t->done = true;
       succs.swap(t->succs);
@@ -1253,7 +1438,7 @@ uint32_t pdtd_ready(void* ep) {
   uint32_t n = 0;
   for (Plifo* q : e->queues) n += plifo_size(q);
   {
-    std::lock_guard<std::mutex> lk(e->overflow_mu);
+    PdtdLockRec lk(&e->lockdbg, PLK_OVERFLOW, e->overflow_mu);
     n += (uint32_t)e->overflow.size();
   }
   return n;
@@ -1265,8 +1450,11 @@ uint32_t pdtd_ready(void* ep) {
 uint32_t pdtd_wait_below(void* ep, uint32_t threshold, int timeout_ms) {
   Pdtd* e = static_cast<Pdtd*>(ep);
   std::unique_lock<std::mutex> lk(e->cv_mu);
+  // record-only note: the cv wait needs the unique_lock itself; the
+  // note pops before the unlock (declared after lk)
+  PdtdLockNote note(&e->lockdbg, PLK_CV);
   e->waiters.fetch_add(1, std::memory_order_acq_rel);
-  e->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), [&] {
+  pdtd_cv_wait_ms(e->cv, lk, timeout_ms, [&] {
     return e->inflight.load(std::memory_order_acquire) <= threshold ||
            e->cancelled.load(std::memory_order_acquire);
   });
@@ -1278,8 +1466,12 @@ uint32_t pdtd_wait_below(void* ep, uint32_t threshold, int timeout_ms) {
 void pdtd_cancel(void* ep) {
   Pdtd* e = static_cast<Pdtd*>(ep);
   e->cancelled.store(true, std::memory_order_release);
-  std::lock_guard<std::mutex> lk(e->cv_mu);
+  PdtdLockRec lk(&e->lockdbg, PLK_CV, e->cv_mu);
   e->cv.notify_all();
+}
+
+void pdtd_lockdbg_enable(void* ep) {
+  static_cast<Pdtd*>(ep)->lockdbg.on.store(true, std::memory_order_relaxed);
 }
 
 void pdtd_stats(void* ep, uint64_t* out20) {
@@ -1305,14 +1497,18 @@ void pdtd_stats(void* ep, uint64_t* out20) {
   uint64_t depth = 0;
   for (PdtdObsRing* r : e->obs_rings) {
     // cap is written under the ring mutex (growth, disable) — take it
-    // so a scrape can't read a torn/stale capacity mid-regrow
-    std::lock_guard<std::mutex> lk(r->mu);
+    // so a scrape can't read a torn/stale capacity mid-regrow (the
+    // PR 13 post-review race, pinned by the TSan stress lane)
+    PdtdLockRec lk(&e->lockdbg, PLK_RING, r->mu);
     uint64_t wp = r->wpos.load(std::memory_order_acquire);
     depth += wp < r->cap ? wp : r->cap;
   }
   out20[17] = depth;
-  out20[18] = 0;
-  out20[19] = 0;
+  // lock-discipline recorder rows: the acquisition-pair bitmask
+  // ((held*5+acquired) bits over the PLK_* domains — OR-folded by the
+  // Python side, never summed) and the recorded acquisition count
+  out20[18] = e->lockdbg.pairs.load(std::memory_order_relaxed);
+  out20[19] = e->lockdbg.acquires.load(std::memory_order_relaxed);
 }
 
 // ---------------------------------------------------------------------------
@@ -1368,7 +1564,7 @@ void pdtd_obs_disable(void* ep) {
   Pdtd* e = static_cast<Pdtd*>(ep);
   e->obs_on.store(false, std::memory_order_release);
   for (PdtdObsRing* r : e->obs_rings) {
-    std::lock_guard<std::mutex> lk(r->mu);
+    PdtdLockRec lk(&e->lockdbg, PLK_RING, r->mu);
     r->buf.reset();
     r->cap = 0;
   }
@@ -1385,7 +1581,7 @@ int pdtd_obs_drain(void* ep, int worker, PdtdObsRec* out,
   Pdtd* e = static_cast<Pdtd*>(ep);
   if (worker < 0 || worker >= (int)e->obs_rings.size()) return -1;
   PdtdObsRing* r = e->obs_rings[worker];
-  std::lock_guard<std::mutex> lk(r->mu);
+  PdtdLockRec lk(&e->lockdbg, PLK_RING, r->mu);
   if (r->cap == 0) return 0;
   uint64_t w2 = r->wpos.load(std::memory_order_acquire);
   uint64_t n = w2 < r->cap ? w2 : r->cap;

@@ -1,0 +1,432 @@
+// sanstress — seeded interleaving-stress driver for the native core
+// (ISSUE 14). Compiled TOGETHER with core.cpp into a standalone
+// executable, entirely under one sanitizer (tsan/asan/ubsan), with NO
+// Python in the process: every frame a sanitizer reports is OUR code,
+// so zero-report is an enforceable contract (no suppressions needed —
+// the whole point of the lane). The PARSEC_SAN_YIELD injection points
+// compiled into core.cpp widen the interleaving space per run; the
+// seed argument moves the explored neighborhood.
+//
+// Scenarios (runnable individually or as "all"):
+//   pdtd    — the full dynamic-task engine under contention: an
+//             inserter thread staging chained + independent batches
+//             through the two-phase insert, W pump threads (native
+//             bodies complete inside pdtd_pump; "Python-bodied" tasks
+//             are drained through pdtd_pump_batch and completed via
+//             pdtd_complete/pdtd_complete_batch), the observability
+//             rings enabled with a SMALL initial capacity so growth
+//             AND the wrapped drop-oldest regime both run, and a
+//             scraper thread hammering pdtd_stats + pdtd_obs_drain
+//             CONCURRENT with ring growth — the exact PR 13
+//             pdtd_stats-vs-growth data race, pinned here forever.
+//             Odd repetitions cancel mid-flight (drop-at-select +
+//             cv wakeup), even ones drain cleanly via pdtd_wait_below.
+//   plifo   — N threads hammering the lock-free LIFO push/pop (the
+//             ABA-tag CAS windows are where PSAN_YIELD digs in).
+//   phash   — concurrent insert/find/remove across resize thresholds.
+//   pmempool— cross-thread alloc/release (thread-owned freelists).
+//   pgraph  — the static-DAG executor on a random layered DAG with a
+//             native body, plus pgraph_consume countdown from bodies.
+//
+// Exit code 0 = scenario invariants held; the sanitizer runtime turns
+// any report into a nonzero exit (TSAN_OPTIONS=exitcode=66, ASan
+// aborts, UBSan is compiled -fno-sanitize-recover). The invariant
+// checks make this double as a correctness stress even unsanitized.
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+// the pdtd observability record layout (mirrors core.cpp PdtdObsRec)
+struct ObsRec {
+  uint64_t t0_ns, t1_ns, q_ns, span;
+  uint32_t seq, parent_seq, cls;
+  int32_t worker;
+};
+
+extern "C" {
+void psan_seed(uint64_t seed);
+int psan_yield_enabled(void);
+// pdtd
+void* pdtd_new(int nworkers, uint32_t queue_capacity);
+void pdtd_free(void* e);
+int64_t pdtd_insert(void* e, uint32_t n, const int32_t* prio,
+                    const uint8_t* flags, const uint32_t* npreds,
+                    const uint32_t* preds, uint8_t* linked_out,
+                    uint32_t cls);
+void pdtd_arm(void* e, uint32_t first, uint32_t n);
+int pdtd_pump(void* e, int worker, uint32_t* out_tid);
+int pdtd_pump_batch(void* e, int worker, uint32_t* out_tids, int cap,
+                    int* ran_native);
+int pdtd_complete(void* e, int worker, uint32_t tid, uint32_t* drops_out,
+                  int32_t drops_cap, int32_t* info_out, uint64_t t0,
+                  uint64_t t1);
+int pdtd_complete_batch(void* e, int worker, const uint32_t* tids, int n,
+                        const uint64_t* t01);
+uint32_t pdtd_inflight(void* e);
+uint32_t pdtd_ready(void* e);
+uint32_t pdtd_wait_below(void* e, uint32_t threshold, int timeout_ms);
+void pdtd_cancel(void* e);
+void pdtd_stats(void* e, uint64_t* out20);
+int pdtd_obs_enable(void* e, uint64_t span_base, uint32_t cap_max);
+void pdtd_obs_disable(void* e);
+int pdtd_obs_drain(void* e, int worker, ObsRec* out, uint32_t cap_out);
+void pdtd_lockdbg_enable(void* e);
+// foundation classes
+void* plifo_new(uint32_t capacity);
+void plifo_free(void* l);
+int plifo_push(void* l, uint64_t item);
+int plifo_pop(void* l, uint64_t* out);
+uint32_t plifo_size(void* l);
+void* phash_new(uint32_t nbuckets_hint);
+void phash_free(void* h);
+int phash_insert(void* h, uint64_t key, uint64_t val);
+int phash_find(void* h, uint64_t key, uint64_t* out);
+int phash_remove(void* h, uint64_t key, uint64_t* out);
+uint64_t phash_size(void* h);
+void* pmempool_new(uint32_t elt_size, int nthreads);
+void pmempool_free(void* p);
+void* pmempool_alloc(void* p, int thread);
+void pmempool_release(void* p, int thread, void* elt);
+uint64_t pmempool_outstanding(void* p);
+typedef int (*pgraph_body_fn)(uint32_t task_id, int32_t worker);
+void* pgraph_new(uint32_t n, const int32_t* ndeps, const int32_t* priority,
+                 uint64_t m, const uint32_t* esrc, const uint32_t* edst,
+                 pgraph_body_fn body, int nworkers);
+void pgraph_free(void* g);
+int pgraph_run(void* g);
+uint32_t pgraph_remaining(void* g);
+int pgraph_consume(void* g, uint32_t tid);
+}
+
+namespace {
+
+int g_failures = 0;
+
+#define CHECK(cond, ...)                                          \
+  do {                                                            \
+    if (!(cond)) {                                                \
+      std::fprintf(stderr, "CHECK FAILED %s:%d: ", __FILE__,      \
+                   __LINE__);                                     \
+      std::fprintf(stderr, __VA_ARGS__);                          \
+      std::fprintf(stderr, "\n");                                 \
+      g_failures++;                                               \
+    }                                                             \
+  } while (0)
+
+// small deterministic PRNG (seed-reproducible schedules)
+struct Rng {
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed | 1) {}
+  uint64_t next() {
+    s ^= s << 13;
+    s ^= s >> 7;
+    s ^= s << 17;
+    return s;
+  }
+  uint32_t below(uint32_t n) { return (uint32_t)(next() % n); }
+};
+
+// ------------------------------------------------------------------ pdtd
+// One repetition: insert n_batches of batch sz tasks (mixed native/
+// "python"-bodied, chained to random earlier tasks), pump from W
+// threads, scrape stats + drain rings concurrently, cancel on odd reps.
+void pdtd_rep(uint64_t seed, int rep, int nworkers, int n_batches,
+              int batch) {
+  void* e = pdtd_new(nworkers, 64);  // tiny plifo: exercise overflow
+  CHECK(e != nullptr, "pdtd_new");
+  pdtd_lockdbg_enable(e);
+  // small cap_max: growth (1024 -> cap) AND drop-oldest both engage
+  CHECK(pdtd_obs_enable(e, (1ull << 43), 2048) == 0, "obs_enable");
+  const bool cancel_rep = (rep & 1) != 0;
+  std::atomic<bool> done{false};
+  std::atomic<uint64_t> native_done{0}, python_done{0};
+
+  std::vector<std::thread> pumps;
+  for (int w = 0; w < nworkers; ++w) {
+    pumps.emplace_back([&, w] {
+      Rng r(seed + 1000 + w);
+      std::vector<uint32_t> tids(32);
+      std::vector<uint64_t> t01(64, 0);
+      int32_t info[2];
+      uint32_t drops[8];
+      while (!done.load(std::memory_order_acquire) ||
+             pdtd_inflight(e) > 0) {
+        int ran = 0;
+        int n = pdtd_pump_batch(e, w, tids.data(), 32, &ran);
+        if (ran) native_done.fetch_add(1, std::memory_order_relaxed);
+        if (n == 0 && !ran) {
+          std::this_thread::yield();
+          continue;
+        }
+        // "python bodies": complete half one-by-one (drop reporting
+        // path), half through the batched call
+        int half = n / 2;
+        for (int i = 0; i < half; ++i) {
+          int rc = pdtd_complete(e, w, tids[i], drops, 8, info,
+                                 r.next() | 1, r.next() | 1);
+          CHECK(rc >= 0, "pdtd_complete rc=%d", rc);
+          python_done.fetch_add(1, std::memory_order_relaxed);
+        }
+        if (n > half) {
+          int rc = pdtd_complete_batch(e, w, tids.data() + half,
+                                       n - half, t01.data());
+          CHECK(rc >= 0, "pdtd_complete_batch rc=%d", rc);
+          python_done.fetch_add(n - half, std::memory_order_relaxed);
+        }
+      }
+    });
+  }
+  // concurrent scraper: pdtd_stats + ring drains DURING growth (the
+  // PR 13 pdtd_stats-vs-ring-growth race regression — satellite 1)
+  std::thread scraper([&] {
+    uint64_t st[20];
+    std::vector<ObsRec> buf(2048);
+    while (!done.load(std::memory_order_acquire) ||
+           pdtd_inflight(e) > 0) {
+      pdtd_stats(e, st);
+      CHECK(st[18] == 0, "lock-order pair recorded: mask=%llu",
+            (unsigned long long)st[18]);
+      for (int w = 0; w < nworkers; ++w) {
+        int n = pdtd_obs_drain(e, w, buf.data(), 2048);
+        CHECK(n >= 0, "obs_drain rc=%d", n);
+      }
+      std::this_thread::yield();
+    }
+  });
+
+  Rng r(seed + rep);
+  std::vector<int32_t> prio(batch);
+  std::vector<uint8_t> flags(batch);
+  std::vector<uint32_t> npreds(batch);
+  std::vector<uint32_t> preds;
+  std::vector<uint8_t> linked;
+  uint32_t inserted = 0;
+  for (int b = 0; b < n_batches; ++b) {
+    preds.clear();
+    for (int i = 0; i < batch; ++i) {
+      prio[i] = (int32_t)r.below(7);
+      flags[i] = (uint8_t)(r.below(2));  // mix native / python bodies
+      uint32_t np = inserted ? r.below(3) : 0;
+      npreds[i] = np;
+      for (uint32_t k = 0; k < np; ++k)
+        preds.push_back(r.below(inserted));  // any earlier task
+    }
+    linked.assign(preds.size() ? preds.size() : 1, 0);
+    int64_t first = pdtd_insert(e, batch, prio.data(), flags.data(),
+                                npreds.data(), preds.data(),
+                                linked.data(), 0);
+    CHECK(first == (int64_t)inserted, "insert first=%lld want %u",
+          (long long)first, inserted);
+    pdtd_arm(e, (uint32_t)first, batch);
+    inserted += batch;
+    if (cancel_rep && b == n_batches / 2) pdtd_cancel(e);
+    if ((b & 3) == 0) pdtd_wait_below(e, batch * 4, 50);
+  }
+  // drain: every inserted task must leave flight (completed or
+  // cancel-dropped) — a stuck countdown would hang here, so bound it
+  auto t0 = std::chrono::steady_clock::now();
+  while (pdtd_inflight(e) > 0) {
+    pdtd_wait_below(e, 0, 100);
+    if (std::chrono::steady_clock::now() - t0 >
+        std::chrono::seconds(60)) {
+      CHECK(false, "drain timed out: inflight=%u ready=%u",
+            pdtd_inflight(e), pdtd_ready(e));
+      break;
+    }
+  }
+  done.store(true, std::memory_order_release);
+  for (auto& t : pumps) t.join();
+  scraper.join();
+  uint64_t st[20];
+  pdtd_stats(e, st);
+  CHECK(st[0] == inserted, "inserted=%llu want %u",
+        (unsigned long long)st[0], inserted);
+  // completed + cancel-dropped account for every inserted task
+  uint64_t accounted = st[6] + st[7] + st[10];
+  CHECK(accounted == inserted, "accounted=%llu want %u (cancel=%d)",
+        (unsigned long long)accounted, inserted, (int)cancel_rep);
+  CHECK(st[18] == 0, "lock pairs must stay 0, got mask=%llu",
+        (unsigned long long)st[18]);
+  if (!cancel_rep)
+    CHECK(st[15] + st[16] >= inserted,
+          "obs recorded+dropped=%llu < inserted=%u",
+          (unsigned long long)(st[15] + st[16]), inserted);
+  pdtd_obs_disable(e);
+  pdtd_free(e);
+}
+
+void scenario_pdtd(uint64_t seed, int iters) {
+  for (int rep = 0; rep < iters; ++rep)
+    pdtd_rep(seed, rep, 4, 40, 128);
+}
+
+// ----------------------------------------------------------------- plifo
+void scenario_plifo(uint64_t seed, int iters) {
+  void* l = plifo_new(512);
+  CHECK(l != nullptr, "plifo_new");
+  const int T = 6;
+  std::atomic<uint64_t> pushed{0}, popped{0};
+  std::vector<std::thread> ths;
+  for (int t = 0; t < T; ++t) {
+    ths.emplace_back([&, t] {
+      Rng r(seed + t);
+      uint64_t v;
+      for (int i = 0; i < iters * 4000; ++i) {
+        if (r.below(2)) {
+          if (plifo_push(l, r.next()) == 0)
+            pushed.fetch_add(1, std::memory_order_relaxed);
+        } else if (plifo_pop(l, &v)) {
+          popped.fetch_add(1, std::memory_order_relaxed);
+        }
+      }
+    });
+  }
+  for (auto& t : ths) t.join();
+  uint64_t v;
+  uint64_t drained = 0;
+  while (plifo_pop(l, &v)) drained++;
+  CHECK(pushed.load() == popped.load() + drained,
+        "plifo conservation: pushed=%llu popped=%llu drained=%llu",
+        (unsigned long long)pushed.load(),
+        (unsigned long long)popped.load(), (unsigned long long)drained);
+  CHECK(plifo_size(l) == 0, "plifo size after drain");
+  plifo_free(l);
+}
+
+// ----------------------------------------------------------------- phash
+void scenario_phash(uint64_t seed, int iters) {
+  void* h = phash_new(16);  // tiny: force resizes under load
+  CHECK(h != nullptr, "phash_new");
+  const int T = 4;
+  std::vector<std::thread> ths;
+  for (int t = 0; t < T; ++t) {
+    ths.emplace_back([&, t] {
+      Rng r(seed + 31 * t);
+      uint64_t out;
+      for (int i = 0; i < iters * 2500; ++i) {
+        // per-thread key range + a shared overlapping range
+        uint64_t key = r.below(2) ? (uint64_t)t << 32 | r.below(512)
+                                  : r.below(256);
+        switch (r.below(3)) {
+          case 0: phash_insert(h, key, key * 3); break;
+          case 1:
+            if (phash_find(h, key, &out))
+              CHECK(out == key * 3, "phash value for %llu",
+                    (unsigned long long)key);
+            break;
+          default: phash_remove(h, key, nullptr); break;
+        }
+      }
+    });
+  }
+  for (auto& t : ths) t.join();
+  phash_free(h);
+}
+
+// -------------------------------------------------------------- pmempool
+void scenario_pmempool(uint64_t seed, int iters) {
+  const int T = 4;
+  void* p = pmempool_new(96, T);
+  CHECK(p != nullptr, "pmempool_new");
+  std::vector<std::thread> ths;
+  for (int t = 0; t < T; ++t) {
+    ths.emplace_back([&, t] {
+      Rng r(seed + 7 * t);
+      std::vector<void*> mine;
+      for (int i = 0; i < iters * 2000; ++i) {
+        if (mine.size() < 16 && r.below(2)) {
+          void* e = pmempool_alloc(p, t);
+          CHECK(e != nullptr, "pmempool_alloc");
+          std::memset(e, t, 96);  // touch: ASan would catch overlap
+          mine.push_back(e);
+        } else if (!mine.empty()) {
+          // cross-thread release path half the time
+          pmempool_release(p, r.below(2) ? t : (t + 1) % T,
+                           mine.back());
+          mine.pop_back();
+        }
+      }
+      for (void* e : mine) pmempool_release(p, t, e);
+    });
+  }
+  for (auto& t : ths) t.join();
+  CHECK(pmempool_outstanding(p) == 0, "pmempool outstanding=%llu",
+        (unsigned long long)pmempool_outstanding(p));
+  pmempool_free(p);
+}
+
+// ---------------------------------------------------------------- pgraph
+std::atomic<uint64_t> g_body_runs{0};
+void* g_graph = nullptr;
+
+int graph_body(uint32_t tid, int32_t worker) {
+  (void)worker;
+  g_body_runs.fetch_add(1, std::memory_order_relaxed);
+  // consume this task's own output consumers' view of a PRED: model
+  // the Python executor's read-then-consume on every incoming edge is
+  // driven from Python; here just hammer the atomic countdown path
+  pgraph_consume(g_graph, tid);
+  return 0;
+}
+
+void scenario_pgraph(uint64_t seed, int iters) {
+  for (int rep = 0; rep < iters; ++rep) {
+    Rng r(seed + rep);
+    const uint32_t layers = 6, width = 32, n = layers * width;
+    std::vector<uint32_t> esrc, edst;
+    for (uint32_t L = 1; L < layers; ++L)
+      for (uint32_t i = 0; i < width; ++i)
+        for (int k = 0; k < 3; ++k) {
+          esrc.push_back((L - 1) * width + r.below(width));
+          edst.push_back(L * width + i);
+        }
+    std::vector<int32_t> ndeps(n, 0), prio(n);
+    for (uint32_t d : edst) ndeps[d]++;
+    for (uint32_t i = 0; i < n; ++i) prio[i] = (int32_t)r.below(5);
+    g_body_runs.store(0);
+    void* g = pgraph_new(n, ndeps.data(), prio.data(), esrc.size(),
+                         esrc.data(), edst.data(), graph_body, 4);
+    CHECK(g != nullptr, "pgraph_new");
+    g_graph = g;
+    CHECK(pgraph_run(g) == 0, "pgraph_run");
+    CHECK(pgraph_remaining(g) == 0, "pgraph remaining");
+    CHECK(g_body_runs.load() == n, "bodies ran %llu want %u",
+          (unsigned long long)g_body_runs.load(), n);
+    pgraph_free(g);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string scenario = argc > 1 ? argv[1] : "all";
+  uint64_t seed = argc > 2 ? std::strtoull(argv[2], nullptr, 10) : 42;
+  int iters = argc > 3 ? std::atoi(argv[3]) : 2;
+  psan_seed(seed);
+  std::printf("sanstress scenario=%s seed=%llu iters=%d yield=%d\n",
+              scenario.c_str(), (unsigned long long)seed, iters,
+              psan_yield_enabled());
+  bool all = scenario == "all";
+  bool known = all;
+  if (all || scenario == "pdtd") { scenario_pdtd(seed, iters); known = true; }
+  if (all || scenario == "plifo") { scenario_plifo(seed, iters); known = true; }
+  if (all || scenario == "phash") { scenario_phash(seed, iters); known = true; }
+  if (all || scenario == "pmempool") {
+    scenario_pmempool(seed, iters);
+    known = true;
+  }
+  if (all || scenario == "pgraph") { scenario_pgraph(seed, iters); known = true; }
+  if (!known) {
+    std::fprintf(stderr, "unknown scenario %s\n", scenario.c_str());
+    return 2;
+  }
+  std::printf("sanstress %s: %s\n", scenario.c_str(),
+              g_failures ? "FAILED" : "OK");
+  return g_failures ? 1 : 0;
+}

@@ -10,11 +10,15 @@ ptgpp sanity checks rolled into one command):
 - ``--self-check``: additionally lint the seeded hazard fixtures
   (analysis/fixtures.py: racy, cyclic, undeclared producer, access
   violation, ...) and FAIL unless each is caught with an actionable
-  message naming the task class, flow and coordinates;
+  message naming the task class, flow and coordinates; since ISSUE 14
+  this arm also RUNS the seeded-WAW DTD fixture on both engines and
+  fails unless ring-fed dfsan (native) reports it identically to the
+  live sanitizer (Python);
 - ``--dot PATH``: write the selected algorithm's instance DAG as DOT,
   edges colored by FlowAccess, hazard edges marked (grapher.py).
 
-Purely static — no runtime context is started and no task bodies run.
+The default lint pass is purely static — no runtime context, no task
+bodies; only the ``--self-check`` engine-parity arm starts a context.
 """
 
 from __future__ import annotations
@@ -104,8 +108,15 @@ def main(argv: List[str] = None) -> int:
         print(f"[dot] wrote {args.dot}")
 
     if args.self_check:
-        from .fixtures import self_check
+        from .fixtures import native_self_check, self_check
         failures, lines = self_check()
+        # ISSUE 14: the seeded DTD WAW must be reported identically by
+        # the live sanitizer (Python engine) and the ring-fed replay
+        # (native engine) — this arm RUNS both engines, it is not
+        # static like the fixtures above
+        nfail, nlines = native_self_check()
+        failures += nfail
+        lines += nlines
         for line in lines:
             print(f"[self-check] {line}")
         if failures:

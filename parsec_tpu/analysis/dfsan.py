@@ -152,6 +152,8 @@ class DataflowSanitizer(PinsModule):
         super().__init__()
         self._lock = threading.Lock()
         self._comp: Dict[int, int] = {}          # thread ident -> component
+        self._ncomp = 0                          # next component id (live
+        #                                          threads AND replayed tasks)
         self._thread_vc: Dict[int, VC] = {}
         self._pending: Dict[Any, VC] = {}        # task key -> joined pred VC
         self._tiles: Dict[Tuple[str, Tuple], _TileState] = {}
@@ -164,15 +166,26 @@ class DataflowSanitizer(PinsModule):
         self._lock_edges: Dict[Tuple[str, int], set] = {}
         self._held = threading.local()
         self.stats = {"reads": 0, "writes": 0, "edges": 0, "tasks": 0,
-                      "repo_accesses": 0, "lock_acquires": 0}
+                      "repo_accesses": 0, "lock_acquires": 0,
+                      "native_replayed_pools": 0,
+                      "native_replay_skipped": 0,
+                      "native_lock_pairs": 0}
 
     # ------------------------------------------------------------ lifecycle
     def install(self, context) -> "DataflowSanitizer":
         super().install(context)
         context.dfsan = self
-        self._sub(PinsEvent.TASKPOOL_INIT, self._taskpool_init)
-        self._sub(PinsEvent.RELEASE_DEPS_BEGIN, self._release_begin)
-        self._sub(PinsEvent.COMPLETE_EXEC_END, self._complete_end)
+        # native_ok=True (ISSUE 14): these per-task hooks only fire on
+        # the Python engine, and natively-executed DTD pools are
+        # covered EXACTLY by the fold-time ring replay
+        # (replay_native_pool) — so the sanitizer itself no longer
+        # disqualifies the native engine via needs_python_engine()
+        self._sub(PinsEvent.TASKPOOL_INIT, self._taskpool_init,
+                  native_ok=True)
+        self._sub(PinsEvent.RELEASE_DEPS_BEGIN, self._release_begin,
+                  native_ok=True)
+        self._sub(PinsEvent.COMPLETE_EXEC_END, self._complete_end,
+                  native_ok=True)
         # adopt taskpools registered before install
         with context._lock:
             pools = list(context._taskpools_by_name.values())
@@ -208,10 +221,21 @@ class DataflowSanitizer(PinsModule):
             self._lock_edges.clear()
 
     # ------------------------------------------------------------- clocks
+    def _alloc_comp(self) -> int:
+        """Next clock component id (caller holds the sanitizer lock).
+        Live worker threads get one component each; natively-REPLAYED
+        tasks get one component PER TASK — with shared components an
+        inherited later epoch would shadow an unordered earlier one
+        (the per-thread approximation note below), and a fold-time
+        replay on one thread would shadow everything."""
+        c = self._ncomp
+        self._ncomp += 1
+        return c
+
     def _comp_of(self, tid: int) -> int:
         c = self._comp.get(tid)
         if c is None:
-            c = self._comp[tid] = len(self._comp)
+            c = self._comp[tid] = self._alloc_comp()
         return c
 
     def _clock_of_locked(self, task) -> Tuple[Epoch, VC]:
@@ -259,6 +283,18 @@ class DataflowSanitizer(PinsModule):
         with self._lock:
             _join(self._base, self._max)
 
+    def base_snapshot(self) -> VC:
+        """Copy of the current barrier base. The native driver takes
+        one when an aborted pool enters the RETIRING state (still
+        draining): its termination barrier advances ``_base`` before
+        the pump folds the drained engine, and a replay seeded from
+        the post-barrier base would retroactively order the pool's
+        tasks after concurrent pools' accesses — excusing real
+        races. ``replay_native_pool`` seeds from the snapshot when
+        the engine carries one."""
+        with self._lock:
+            return dict(self._base)
+
     # ----------------------------------------------------------- HB edges
     def observe_edge(self, src_task, ref) -> None:
         """One dependency release src_task → ref (called by the release
@@ -293,31 +329,38 @@ class DataflowSanitizer(PinsModule):
         self.races.append(RaceReport(kind=kind, tile=tile, task=task,
                                      other=other, message=message))
 
+    def _write_locked(self, epoch: Epoch, vc: VC, label: str, tk) -> None:
+        """Stamp one committed write (caller holds the sanitizer lock):
+        the ONE copy of the WAW/RAW checks + tile-state update, shared
+        by the live ``observe_write`` path and the native-pool replay
+        so reports and digests cannot drift between engines."""
+        st = self._tiles.setdefault(tk, _TileState())
+        tile_s = f"{tk[0]}{tk[1]}"
+        if st.write_epoch is not None and \
+                not self._epoch_leq(st.write_epoch, vc):
+            self._race("waw", tile_s, label, st.write_task,
+                       f"unordered writes to {tile_s}: {label} vs "
+                       f"{st.write_task} — final version is "
+                       f"schedule-dependent")
+        for repoch, rlabel in st.reads:
+            if rlabel != label and not self._epoch_leq(repoch, vc):
+                self._race("raw", tile_s, label, rlabel,
+                           f"write to {tile_s} by {label} unordered "
+                           f"with read by {rlabel}")
+        st.write_epoch = epoch
+        st.write_vc = dict(vc)
+        st.write_task = label
+        st.reads.clear()
+        st.seq.append(label)
+        self.stats["writes"] += 1
+
     def observe_write(self, task, dc, key) -> None:
         """A committed tile write (DataRef write-back / DTD retire)."""
         tk = self._tile_key(dc, key)
         label = repr(task)
         with self._lock:
             epoch, vc = self._clock_of_locked(task)
-            st = self._tiles.setdefault(tk, _TileState())
-            tile_s = f"{tk[0]}{tk[1]}"
-            if st.write_epoch is not None and \
-                    not self._epoch_leq(st.write_epoch, vc):
-                self._race("waw", tile_s, label, st.write_task,
-                           f"unordered writes to {tile_s}: {label} vs "
-                           f"{st.write_task} — final version is "
-                           f"schedule-dependent")
-            for repoch, rlabel in st.reads:
-                if rlabel != label and not self._epoch_leq(repoch, vc):
-                    self._race("raw", tile_s, label, rlabel,
-                               f"write to {tile_s} by {label} unordered "
-                               f"with read by {rlabel}")
-            st.write_epoch = epoch
-            st.write_vc = dict(vc)
-            st.write_task = label
-            st.reads.clear()
-            st.seq.append(label)
-            self.stats["writes"] += 1
+            self._write_locked(epoch, vc, label, tk)
         if self.context is not None:
             self.context.pins.data_write(task, dc, key)
 
@@ -415,6 +458,192 @@ class DataflowSanitizer(PinsModule):
             seen.add(u)
             stack.extend(self._lock_edges.get(u, ()))
         return False
+
+    def feed_native_lock_pairs(self, pairs: int) -> None:
+        """Fold the C lock-discipline recorder's acquisition-pair
+        bitmask (``pdtd_stats`` ``lock_pairs``, bit ``held*5+acquired``
+        over ``_native.PDTD_LOCK_DOMAINS``) into the inversion
+        detector. The pdtd hot loop's discipline is nesting-free, so a
+        healthy engine contributes NOTHING here; any pair lands in the
+        shared order graph (domains prefixed ``native-``), and a
+        same-domain pair — two nested entry locks, the classic DTD
+        deadlock shape — is an inversion by itself."""
+        if not pairs:
+            return
+        from .. import _native
+        doms = _native.PDTD_LOCK_DOMAINS
+        n = len(doms)
+        with self._lock:
+            for held in range(n):
+                for acq in range(n):
+                    if not (pairs >> (held * n + acq)) & 1:
+                        continue
+                    self.stats["native_lock_pairs"] += 1
+                    hk = (f"native-{doms[held]}", 0)
+                    ak = (f"native-{doms[acq]}", 0)
+                    if hk == ak:
+                        self._race(
+                            "lock-order", "", f"{ak[0]}[0]",
+                            f"{hk[0]}[0]",
+                            f"lock-order inversion: nested same-domain "
+                            f"native pdtd locks ({doms[held]}) — the "
+                            f"self-deadlock shape")
+                        continue
+                    self._lock_edges.setdefault(hk, set()).add(ak)
+                    if self._lock_path(ak, hk):
+                        self._race(
+                            "lock-order", "", f"{ak[0]}[0]",
+                            f"{hk[0]}[0]",
+                            f"lock-order inversion: {hk[0]}[0] held "
+                            f"while acquiring {ak[0]}[0], but the "
+                            f"reverse order was also observed")
+
+    # ---------------------------------------------------- native replay
+    def replay_native_pool(self, engine) -> None:
+        """Fold-time replay of a natively-executed DTD pool (ISSUE 14).
+
+        The native engine runs insert→release entirely behind the C
+        ABI, so the live per-access hooks never fire; instead it hands
+        this method (from ``NativeDTD.obs_retire``, BEFORE the
+        termination barrier advances ``_base``):
+
+        - **insert-time access manifests** — per tile-bearing task, in
+          program order: sync snapshot reads (the tile-lock/retire
+          protocol orders them — replayed as clock joins, exactly the
+          live ``observe_read(sync=True)``), linked-predecessor HB
+          edges (the ``linked_out``-resolved goal edges — the same
+          edges ``observe_edge`` sees live, and the superset of the
+          ring records' ``parent_seq``), and declared writes;
+        - **commit evidence** — which declared writes the body actually
+          produced (``observe_write`` stamps only produced flows), plus
+          dynamic access-mode violations captured at normalize time;
+        - **the frozen event rings** — the completion ground truth: on
+          a clean pool every inserted task completed (termination
+          requires drain), on an ABORTED pool only ring-recorded seqs
+          are replayed, and if the rings wrapped (records dropped) the
+          replay is SKIPPED and counted, never guessed — a missing
+          happens-before source would fabricate races;
+        - **the C lock-discipline pair table** (``lock_pairs``), folded
+          into the inversion detector either way.
+
+        Tasks replay in seq order (= insertion program order, a
+        topological order of the pool DAG — predecessor ids are always
+        smaller). Each replayed task gets its OWN clock component, so
+        the exactness matches live operation's per-thread components or
+        better; labels are ``class(seq)``, identical to the Python
+        engine's ``Task.__repr__``, which keeps race reports AND the
+        per-tile version digests bitwise-comparable across engines."""
+        manifests = getattr(engine, "_dfsan_manifest", None)
+        if manifests is None:
+            return
+        stats = engine.stats()
+        self.feed_native_lock_pairs(stats.get("lock_pairs", 0))
+        # the C recorder's acquisition count folds into the same row
+        # the Python _OrderedLock wrapper feeds — ONE "how much lock
+        # traffic did the sanitizer actually see" surface per run
+        self.stats["lock_acquires"] += stats.get("lock_acquires", 0)
+        tp = engine.tp
+        if tp.error is None:
+            replay = sorted(manifests)
+        elif stats.get("obs_dropped", 0) or not getattr(engine, "_obs",
+                                                        False):
+            # an aborted pool replays only ring-EVIDENCED completions;
+            # wrapped rings — or rings that never enabled (allocation
+            # failure) — mean the evidence is gone: skip LOUDLY, never
+            # report a fabricated clean replay
+            with self._lock:
+                self.stats["native_replay_skipped"] += 1
+            return
+        else:
+            done_seqs: set = set()
+            for arr in engine.obs_drain():
+                done_seqs.update(int(s) for s in arr["seq"])
+            replay = sorted(s for s in manifests if s in done_seqs)
+        commits = getattr(engine, "_dfsan_commits", {})
+        names = engine.class_names
+        completed = stats.get("completed_native", 0) + \
+            stats.get("completed_python", 0)
+        fired: List[Tuple[str, Any, Any]] = []
+        with self._lock:
+            # retiring-path folds run AFTER the pool's own termination
+            # barrier — seed task clocks from the base snapshot taken
+            # at termination (base_snapshot), not the advanced _base
+            base = getattr(engine, "_dfsan_base", None)
+            if base is None:
+                base = self._base
+            clocks: Dict[int, Tuple[Epoch, VC]] = {}
+            # last replayed committed write PER RUNTIME TILE (collection
+            # object identity + key): a sync snapshot read joins THIS,
+            # not the label-keyed tile state — the tile-lock/retire
+            # protocol only orders accesses through the same collection
+            # tile, so label-aliased collections (two views of one
+            # buffer, the seeded-WAW fixture) must NOT be retroactively
+            # ordered by the replay. Writes from pools that already
+            # terminated are covered by the barrier base. (Known
+            # approximation, stricter than live: a CONCURRENT pool's
+            # commit that a live insert-time read would have observed
+            # is not joined — same-label cross-pool traffic without an
+            # intervening termination is flagged, not excused.)
+            rt_last: Dict[Tuple[int, Tuple], Tuple[Epoch, VC]] = {}
+            for seq in replay:
+                cls_id, accesses = manifests[seq]
+                label = f"{names[cls_id]}({seq})"
+                vc = dict(base)
+                committed = commits.get(seq, ())
+                writes = []
+                for acc in accesses:
+                    op = acc[0]
+                    if op == "edge":
+                        pc = clocks.get(acc[1])
+                        if pc is not None:
+                            pep, pvc = pc
+                            _join(vc, pvc)
+                            if pep[1] > vc.get(pep[0], -1):
+                                vc[pep[0]] = pep[1]
+                        self.stats["edges"] += 1
+                    elif op == "sync":
+                        tk = self._tile_key(acc[1], acc[2])
+                        self._tiles.setdefault(tk, _TileState())
+                        last = rt_last.get((id(acc[1]), tk[1]))
+                        if last is not None:
+                            pep, pvc = last
+                            _join(vc, pvc)
+                            if pep[1] > vc.get(pep[0], -1):
+                                vc[pep[0]] = pep[1]
+                        self.stats["reads"] += 1
+                        fired.append(("r", acc[1], acc[2]))
+                    elif acc[3] in committed:   # "write", produced
+                        writes.append(acc)
+                comp = self._alloc_comp()
+                epoch = (comp, 1)
+                clocks[seq] = (epoch, vc)
+                for acc in writes:
+                    tk = self._tile_key(acc[1], acc[2])
+                    self._write_locked(epoch, vc, label, tk)
+                    rt_last[(id(acc[1]), tk[1])] = (epoch, vc)
+                    fired.append(("w", acc[1], acc[2]))
+                _join(self._max, vc)
+                if 1 > self._max.get(comp, -1):
+                    self._max[comp] = 1
+            for (seq, cls_name, fname, access) in \
+                    getattr(engine, "_dfsan_violations", ()):
+                self._race(
+                    "access-violation", "", f"{cls_name}({seq})", fname,
+                    f"{cls_name}({seq}): body returned a value for "
+                    f"flow {fname!r} declared "
+                    f"{FlowAccess(access).name} — only WRITE/RW flows "
+                    f"are output flows (core.task)")
+            self.stats["tasks"] += completed
+            self.stats["native_replayed_pools"] += 1
+        if self.context is not None:
+            pins = self.context.pins
+            for kind, dc, key in fired:
+                # same PINS rebroadcast as the live paths; the replay
+                # has no Task object, so observers receive task=None
+                if kind == "w":
+                    pins.data_write(None, dc, key)
+                else:
+                    pins.data_read(None, dc, key)
 
     # ------------------------------------------------------------- digest
     def digest(self) -> str:

@@ -215,6 +215,112 @@ def build_serving_quarantine() -> ptg.Taskpool:
     return tp
 
 
+# --------------------------------------------------------------------------
+# Dynamic native-engine fixture (ISSUE 14): a seeded WAW on a DTD pool.
+# DTD's declared-arg dataflow chains every writer of a tile, so the bug
+# is seeded one level up — TWO collections registered under ONE label
+# ("KV") alias the same logical tile (the classic user bug: two views
+# of one buffer). The runtime's per-collection writer tracking cannot
+# order them; dfsan's label-keyed tile state must flag the WAW — on the
+# Python engine live, and on the native engine via the fold-time
+# ring/manifest replay, with IDENTICAL class/flow/coords.
+# --------------------------------------------------------------------------
+
+_WAW_GATE = None
+
+
+def _waw_w1(x):
+    # parked until BOTH writers are inserted: on the Python engine the
+    # live sanitizer's insert-time snapshot read would otherwise
+    # observe w1's committed write and (bogusly, but by the documented
+    # same-tile sync rule) order w2 after it on a fast machine —
+    # determinism of the fixture must not hang on a scheduling race
+    if _WAW_GATE is not None:
+        _WAW_GATE.wait(10.0)
+    return x + 1.0
+
+
+def _waw_w2(x):
+    return x + 10.0
+
+
+def run_racy_dtd(native: int) -> list:
+    """Run the seeded-WAW DTD fixture on the requested engine; returns
+    the normalized race rows ``(kind, tile, {task, other})`` — task/
+    other unordered because commit order between unordered writers is
+    schedule-dependent by definition."""
+    import threading
+
+    import parsec_tpu as parsec
+    from ..dsl import dtd
+    from ..utils import mca_param
+    global _WAW_GATE
+    mca_param.set("pins", "dfsan")
+    mca_param.set("runtime.native_dtd", native)
+    try:
+        ctx = parsec.init(nb_cores=2)
+        ctx.start()
+        kv1 = LocalCollection("KV", {(0,): 0.0})
+        kv2 = LocalCollection("KV", {(0,): 0.0})
+        tp = dtd.Taskpool("racy_dtd")
+        ctx.add_taskpool(tp)
+        _WAW_GATE = threading.Event()
+        tp.insert_task(_waw_w1, dtd.TileArg(kv1, (0,), dtd.INOUT))
+        tp.insert_task(_waw_w2, dtd.TileArg(kv2, (0,), dtd.INOUT))
+        _WAW_GATE.set()
+        tp.flush()
+        tp.wait()
+        engaged = tp._native is not None
+        if engaged != bool(native):
+            raise AssertionError(
+                f"native={native} but engine engaged={engaged} — the "
+                f"fixture must exercise the engine it names")
+        rows = sorted(
+            (r.kind, r.tile, tuple(sorted((r.task, r.other))))
+            for r in ctx.dfsan.races)
+        parsec.fini(ctx)
+        return rows
+    finally:
+        mca_param.unset("pins")
+        mca_param.unset("runtime.native_dtd")
+
+
+def native_self_check() -> Tuple[int, list]:
+    """The ISSUE 14 engine-parity check: the seeded DTD WAW must be
+    reported by ring-fed dfsan on the NATIVE engine exactly as the
+    live sanitizer reports it on the Python engine (same kind, same
+    tile coords, same task labels). Returns (failures, log_lines);
+    skips cleanly (0 failures) when the native toolchain is absent."""
+    from .. import _native
+    lines = []
+    if not _native.available():
+        lines.append(f"skip racy_dtd_native: native core unavailable "
+                     f"({_native.build_error()})")
+        return 0, lines
+    py_rows = run_racy_dtd(0)
+    nat_rows = run_racy_dtd(1)
+    failures = 0
+    if not any(k == "waw" for k, _t, _p in py_rows):
+        failures += 1
+        lines.append(f"FAIL racy_dtd python-engine: no WAW reported "
+                     f"({py_rows})")
+    if not any(k == "waw" and "KV(0,)" in t and
+               any("_waw_w1(" in x for x in pair) and
+               any("_waw_w2(" in x for x in pair)
+               for k, t, pair in nat_rows):
+        failures += 1
+        lines.append(f"FAIL racy_dtd native-engine: WAW missing or "
+                     f"lacking class/coords ({nat_rows})")
+    if py_rows != nat_rows:
+        failures += 1
+        lines.append(f"FAIL racy_dtd: engine reports differ:\n"
+                     f"  python: {py_rows}\n  native: {nat_rows}")
+    if not failures:
+        lines.append(f"ok   racy_dtd: WAW on KV(0,) reported "
+                     f"identically by both engines ({nat_rows[0]})")
+    return failures, lines
+
+
 def self_check() -> Tuple[int, list]:
     """Lint every fixture and verify the expected rules fire with
     messages naming the task class, flow and coordinates; verify the

@@ -419,6 +419,12 @@ class Context:
         if eng.inflight() == 0:
             self._ndtd_unregister(eng)
         else:
+            # the pump folds this engine AFTER the pool's termination
+            # barrier has advanced the sanitizer base — snapshot the
+            # pre-barrier base now so the dfsan replay seeds from it
+            san = getattr(eng, "_dfsan", None)
+            if san is not None:
+                eng._dfsan_base = san.base_snapshot()
             eng.retiring = True
 
     def _ndtd_unregister(self, eng) -> None:
@@ -435,6 +441,10 @@ class Context:
                 if k == "ring_highwater":
                     self._ndtd_totals[k] = max(
                         self._ndtd_totals.get(k, 0), v)
+                elif k == "lock_pairs":
+                    # acquisition-pair BITMASK (ISSUE 14): OR, not sum
+                    self._ndtd_totals[k] = \
+                        self._ndtd_totals.get(k, 0) | v
                 else:
                     self._ndtd_totals[k] = \
                         self._ndtd_totals.get(k, 0) + v
@@ -462,6 +472,8 @@ class Context:
             for k, v in eng.stats().items():
                 if k == "ring_highwater":
                     out[k] = max(out.get(k, 0), v)
+                elif k == "lock_pairs":
+                    out[k] = out.get(k, 0) | v
                 else:
                     out[k] = out.get(k, 0) + v
         return out

@@ -22,17 +22,19 @@ first insert):
 - ``0``: always the Python path.
 
 Eligibility — the **instrumented-fallback rule** (ISSUE 13 moved the
-line: observation must never change which engine runs, PaRSEC's
-PINS/profiling contract): a pool stays on the (instrumented) Python
-engine only when one of these holds:
+line; ISSUE 14 moved it again for dfsan: observation must never change
+which engine runs, PaRSEC's PINS/profiling contract): a pool stays on
+the (instrumented) Python engine only when one of these holds, with
+the reason stated per row:
 
 - distributed (``nb_ranks > 1``) — replay/shell semantics are Python;
-- a **semantically-intrusive** observer is live: the dfsan race
-  sanitizer (stamps/orders every access), the Grapher (records every
-  dep edge), the debug-history EXE ring, or a per-task PINS sampler
-  with no native equivalent (alperf, counters, iterators_checker —
-  and the straggler watchdog when no live Trace feeds it ring
-  records);
+- a **semantically-intrusive** observer with no native source is
+  live: the Grapher (records every dep edge as it is released), the
+  debug-history EXE ring (expects an EXE mark per task), or a
+  per-task PINS sampler with no native equivalent (alperf — per-task
+  rusage deltas; counters — per-task counter snapshots;
+  iterators_checker — walks each task's iterator state; and the
+  straggler watchdog when no live Trace feeds it ring records);
 - the context scheduler does not opt in (``native_dtd_capable`` — the
   lfq/ll/ltq/lhq/gd families do; ``wfq`` keeps Python pools so its
   weighted-fair arbitration and ``pool_stats`` observe every task, and
@@ -46,9 +48,19 @@ profiling.trace.Trace` (the engine records begin/end/queue-wait spans
 into its own per-worker binary event rings — ``pdtd_obs_*`` — which
 the trace expands byte-compatibly at dump/scrape time), the always-on
 metrics registry, ``runtime.stage_timers`` (stage totals read from the
-engine's C++ atomics at scrape), and scrape-only PINS modules
-(``tenant`` — native completions folded per tenant at scrape —
-and ``overhead``). The ring capacity knob is
+engine's C++ atomics at scrape), scrape-only PINS modules (``tenant``
+— native completions folded per tenant at scrape — and ``overhead``),
+and — since ISSUE 14 — the **dfsan race sanitizer** for local DTD
+pools: the engine captures insert-time access manifests (tile keys +
+modes + linked-pred edges, resolved while the inserter already holds
+the tile locks) and enables the event rings, and dfsan replays the
+pool at FOLD time over the frozen ring snapshots + manifests
+(``DataflowSanitizer.replay_native_pool``) — same happens-before
+model, same race reports, bitwise-identical per-tile version digests,
+at ring-record cost per task instead of a Python hot loop. The C
+lock-discipline recorder (``pdtd_lockdbg_enable``, scraped through
+``pdtd_stats``) feeds dfsan's lock-order inversion detector at the
+same fold. The ring capacity knob is
 ``profiling.native_ring_events``.
 
 Serving hooks do NOT force a fallback: ``Taskpool.admission`` runs on
@@ -163,13 +175,15 @@ def engine_for(tp) -> Optional["NativeDTD"]:
     ctx = tp.context
     if ctx is None or tp.nb_ranks > 1:
         return None
-    # instrumented-fallback rule (the ISSUE 13 line): only
-    # SEMANTICALLY-INTRUSIVE observers keep the pool on the Python
-    # path. A live Trace records through the engine's own event rings,
-    # the metrics registry and stage timers read C++ atomics at scrape,
-    # and scrape-only PINS callbacks are registered native_ok — see the
+    # instrumented-fallback rule (the ISSUE 13 line, ISSUE 14 moved
+    # dfsan off it): only SEMANTICALLY-INTRUSIVE observers with no
+    # native source keep the pool on the Python path. A live Trace
+    # records through the engine's own event rings, the metrics
+    # registry and stage timers read C++ atomics at scrape, scrape-only
+    # PINS callbacks are registered native_ok, and dfsan replays the
+    # pool from ring snapshots + insert manifests at fold — see the
     # module docstring for the exact residual list.
-    if ctx.dfsan is not None or ctx.grapher is not None:
+    if ctx.grapher is not None:
         return None
     if ctx.pins.needs_python_engine(trace_live=ctx.trace is not None):
         return None
@@ -246,12 +260,14 @@ class NativeDTD:
         # the context totals once the last task drains
         self.retiring = False
         # in-engine observability plane (ISSUE 13): when a live Trace
-        # is installed, enable the per-worker binary event rings so the
-        # pool KEEPS the native engine under tracing — records carry
-        # seq/class/worker/t0/t1/queue-wait/span and are expanded to
-        # the PR 9 event shape at scrape time by the trace's
-        # NativeRingAdapter. class_names is the insert-side id→name
-        # table the expansion reads; the rid rides at the pool level
+        # is installed — or the dfsan sanitizer needs the rings as its
+        # completion evidence (ISSUE 14) — enable the per-worker binary
+        # event rings so the pool KEEPS the native engine under
+        # observation — records carry seq/class/worker/t0/t1/queue-wait/
+        # span and are expanded to the PR 9 event shape at scrape time
+        # by the trace's NativeRingAdapter. class_names is the
+        # insert-side id→name table the expansion (and the dfsan
+        # replay's task labels) reads; the rid rides at the pool level
         # (tp.trace_rid — the serving Submission's deterministic id).
         self.class_names: List[str] = []
         self._cls_by_fn: Dict[Any, int] = {}
@@ -259,8 +275,23 @@ class NativeDTD:
         self._obs_adapter = None
         self._obs_cap = 0
         self.obs_offset_s = 0.0
+        # ring-fed dfsan (ISSUE 14): insert-time access manifests +
+        # fold-time replay keep the race sanitizer live on the native
+        # engine — see replay_native_pool in analysis/dfsan.py
+        self._dfsan = getattr(ctx, "dfsan", None)
+        if self._dfsan is not None:
+            self._dfsan_manifest: Optional[Dict[int, tuple]] = {}
+            self._dfsan_commits: Dict[int, tuple] = {}
+            self._dfsan_violations: List[tuple] = []
+            if hasattr(lib, "pdtd_lockdbg_enable"):
+                # C lock-discipline recorder: acquisition pairs scraped
+                # via pdtd_stats feed dfsan's inversion detector at fold
+                lib.pdtd_lockdbg_enable(self._e)
+        else:
+            self._dfsan_manifest = None
         tr = ctx.trace
-        if tr is not None and hasattr(lib, "pdtd_obs_enable"):
+        if (tr is not None or self._dfsan is not None) and \
+                hasattr(lib, "pdtd_obs_enable"):
             from ..profiling import spans as spans_mod
             cap = max(64, int(mca_param.get(
                 "profiling.native_ring_events", 16384)))
@@ -274,9 +305,10 @@ class NativeDTD:
                                      lib.pdtd_obs_now() / 1e9)
                 self._obs = True
                 self._obs_cap = cap
-                from ..profiling.trace import NativeRingAdapter
-                self._obs_adapter = NativeRingAdapter(self)
-                tr.add_native_source(self._obs_adapter)
+                if tr is not None:
+                    from ..profiling.trace import NativeRingAdapter
+                    self._obs_adapter = NativeRingAdapter(self)
+                    tr.add_native_source(self._obs_adapter)
         ctx._ndtd_register(self)
 
     # -------------------------------------------------------------- insert
@@ -298,8 +330,13 @@ class NativeDTD:
         if info is None:
             tc = self.tp._task_class_for(fn, shape, device, pure=pure)
             hook = tc.incarnations[0].hook if tc.incarnations else None
+            # flow-access layout captured ONCE PER CLASS (ISSUE 14):
+            # the dfsan replay's dynamic access-mode check reads it to
+            # flag bodies that returned values for READ/CTL flows
             info = (hook, tuple(f.name for f in tc.output_flows),
-                    tc.name)
+                    tc.name,
+                    {f.name: (int(f.access), bool(f.is_ctl))
+                     for f in tc.flows})
             self._class_info[key] = info
         return info
 
@@ -348,6 +385,16 @@ class NativeDTD:
         seqs: List[int] = []
         # pending[(row_i)] = per-row python-side record
         pend: List[Optional[tuple]] = []
+        # dfsan access manifests (ISSUE 14), one list per tile-bearing
+        # row: ("sync", dc, key) — program-order snapshot read (the
+        # tile-lock/retire protocol orders it; replayed as a sync
+        # join); ("link", dc, key, slot, pred_seq) — resolved against
+        # linked_out in pass 2 to an HB edge or a sync read; ("write",
+        # dc, key, fname) — committed-or-not decided at completion.
+        # Entry order mirrors the Python engine's observation order
+        # exactly (reads at insert, writes at commit, arg order).
+        cap = self._dfsan_manifest is not None
+        mans: List[Optional[list]] = []
         pi = 0
         max_lp = 0
         for args in rows:
@@ -358,6 +405,7 @@ class NativeDTD:
             spec: List[tuple] = []
             resolvers: List[tuple] = []
             out_tiles: List[tuple] = []
+            man: Optional[list] = [] if cap else None
             seen: Dict[Any, int] = {}       # tile -> primary flow idx
             flow_i = 0
             row_np = 0
@@ -393,6 +441,9 @@ class NativeDTD:
                         # linked_out (slot pi) in pass 2
                         resolvers.append(
                             (1, writer.seq, writer_flow, tile, pi))
+                        if cap:
+                            man.append(("link", a.collection, a.key,
+                                        pi, writer.seq))
                         pi += 1
                         row_np += 1
                     else:
@@ -402,11 +453,16 @@ class NativeDTD:
                         resolvers.append((0, ctx.stage_read(
                             a.collection, a.key,
                             a.collection.data_of(a.key))))
+                        if cap:
+                            man.append(("sync", a.collection, a.key))
                 if a.access & FlowAccess.WRITE:
                     with tile.lock:
                         tile.last_writer = _NativeWriter(seq)
                         tile.last_writer_flow = fname
                     out_tiles.append((tile, fname, idx))
+                    if cap:
+                        man.append(("write", a.collection, a.key,
+                                    fname))
             needs_python = not (native_ok and not spec)
             flags_a[i] = 1 if needs_python else 0
             prio_a[i] = priority
@@ -414,6 +470,8 @@ class NativeDTD:
             max_lp = max(max_lp, row_np)
             pend.append((spec, resolvers, out_tiles)
                         if needs_python else None)
+            if cap:
+                mans.append(man if man else None)
         if max_lp > _MAX_PREDS_INIT and \
                 max_lp > len(self._dropbuf[0]):
             self._dropbuf = [(ctypes.c_uint32 * (2 * max_lp))()
@@ -421,13 +479,14 @@ class NativeDTD:
         u8p = ctypes.POINTER(ctypes.c_uint8)
         u32p = ctypes.POINTER(ctypes.c_uint32)
         i32p = ctypes.POINTER(ctypes.c_int32)
+        cid = self._cls_id(fn)
         first = lib.pdtd_insert(
             self._e, n, prio_a.ctypes.data_as(i32p),
             flags_a.ctypes.data_as(u8p),
             npreds_a.ctypes.data_as(u32p),
             preds_a.ctypes.data_as(u32p),
             linked_a.ctypes.data_as(u8p),
-            self._cls_id(fn))
+            cid)
         if first < 0:
             raise RuntimeError(
                 f"pdtd_insert failed (rc={first}): task table "
@@ -471,6 +530,19 @@ class NativeDTD:
                     fn, shape, device, pure)
             self.rows[seqs[i]] = (info, tuple(spec), resolvers,
                                   out_tiles, n_lp)
+        if cap:
+            # resolve the manifests' snap-vs-link against linked_out
+            # (same rule as the resolvers above) and freeze them for
+            # the fold-time dfsan replay
+            manifest = self._dfsan_manifest
+            for i, man in enumerate(mans):
+                if man is None:
+                    continue
+                for j, m in enumerate(man):
+                    if m[0] == "link":
+                        man[j] = ("edge", m[4]) if linked_a[m[3]] \
+                            else ("sync", m[1], m[2])
+                manifest[seqs[i]] = (cid, tuple(man))
         self._unarmed = None
         lib.pdtd_arm(self._e, first, n)
         evt = ctx._work_evt
@@ -563,6 +635,12 @@ class NativeDTD:
                 te = time.perf_counter() if obs else 0.0
                 self._normalize(result, info[1], seq)   # validate-only:
                 # no output flow can exist without an out tile
+                if type(result) is dict and \
+                        self._dfsan_manifest is not None:
+                    # dynamic access-mode check (dfsan): a dict return
+                    # may target a declared READ flow — record for the
+                    # fold-time replay's access-violation report
+                    self._dfsan_check_modes(seq, info, result)
                 done.append((seq, info[2],
                              self._obs_ns(tb) if obs else 0,
                              self._obs_ns(te) if obs else 0))
@@ -637,7 +715,7 @@ class NativeDTD:
         retire protocol), retained outputs for linked readers, native
         completion with drop reporting."""
         tp = self.tp
-        hook, out_flows, tc_name = info
+        hook, out_flows, tc_name = info[0], info[1], info[2]
         obs = self._obs
         t0ns = t1ns = 0
         try:
@@ -649,6 +727,15 @@ class NativeDTD:
                 t0ns = self._obs_ns(tb)
                 t1ns = self._obs_ns(time.perf_counter())
             outs = self._normalize(result, out_flows, seq)
+            if self._dfsan_manifest is not None:
+                if out_tiles:
+                    # committed-output evidence for the dfsan replay:
+                    # only flows the body actually produced stamp a
+                    # write (the Python engine's observe_write rule)
+                    self._dfsan_commits[seq] = tuple(
+                        f for (_t, f, _i) in out_tiles if f in outs)
+                if type(result) is dict:
+                    self._dfsan_check_modes(seq, info, result)
             if out_tiles:
                 # retained per-flow value for linked readers: the
                 # produced output, else the input that flowed through
@@ -680,6 +767,22 @@ class NativeDTD:
         finally:
             self._complete(seq, w, n_lp, drop_own=not out_tiles,
                            t0ns=t0ns, t1ns=t1ns)
+
+    def _dfsan_check_modes(self, seq: int, info, result: dict) -> None:
+        """Dynamic access-mode capture (ISSUE 14): a dict return whose
+        key names a declared non-WRITE flow is the violation dfsan's
+        ``_release_begin`` flags on the Python engine — recorded here
+        (class-level flow layout, captured once per class in
+        ``_class_for``) and reported at the fold-time replay."""
+        flows = info[3]
+        for name in result:
+            fa = flows.get(name)
+            if fa is None:
+                continue
+            access, is_ctl = fa
+            if is_ctl or not (access & FlowAccess.WRITE):
+                self._dfsan_violations.append(
+                    (seq, info[2], name, access))
 
     def _complete(self, seq: int, w: int, n_lp: int,
                   drop_own: bool, t0ns: int = 0, t1ns: int = 0) -> None:
@@ -740,13 +843,16 @@ class NativeDTD:
         itself is collected."""
         self.rows.clear()
         self.outputs.clear()
+        if self._dfsan_manifest is not None:
+            self._dfsan_manifest.clear()
+            self._dfsan_commits.clear()
+            del self._dfsan_violations[:]
 
     # ------------------------------------------------------------- observe
     def stats(self) -> Dict[str, int]:
         buf = (ctypes.c_uint64 * len(_native.PDTD_STAT_KEYS))()
         self.lib.pdtd_stats(self._e, buf)
-        return {k: int(v) for k, v in zip(_native.PDTD_STAT_KEYS, buf)
-                if k != "reserved"}
+        return {k: int(v) for k, v in zip(_native.PDTD_STAT_KEYS, buf)}
 
     def obs_drain(self) -> List[np.ndarray]:
         """Snapshot every worker's event ring (non-consuming): one
@@ -775,21 +881,35 @@ class NativeDTD:
     def obs_retire(self) -> None:
         """Pool folded (terminated AND drained): freeze the adapter's
         snapshot, feed ring-fed PINS modules (the straggler watchdog's
-        native path), and free the C ring memory — a persistent serving
+        native path), run the dfsan replay over the frozen rings +
+        insert manifests (ISSUE 14 — before the context's termination
+        barrier advances the sanitizer base on the clean path; an
+        aborted pool folds after its barrier, so the replay seeds from
+        the pre-barrier base snapshot ``_ndtd_retire`` stashed on the
+        engine), and free the C ring memory — a persistent serving
         context must not pin one ring set per retired pool."""
         ad = self._obs_adapter
-        if ad is None:
-            return
-        ad.snapshot()
-        ctx = self.tp.context
-        if ctx is not None:
-            for mod in getattr(ctx, "pins_modules", ()):
-                feed = getattr(mod, "observe_native_rings", None)
-                if feed is not None:
-                    try:
-                        feed(ad.raw_arrays(), self.class_names)
-                    except Exception:  # noqa: BLE001 — observer only
-                        pass
+        if ad is not None:
+            ad.snapshot()
+            ctx = self.tp.context
+            if ctx is not None:
+                for mod in getattr(ctx, "pins_modules", ()):
+                    feed = getattr(mod, "observe_native_rings", None)
+                    if feed is not None:
+                        try:
+                            feed(ad.raw_arrays(), self.class_names)
+                        except Exception:  # noqa: BLE001 — observer
+                            pass
+        san = self._dfsan
+        if san is not None:
+            try:
+                san.replay_native_pool(self)
+            except Exception as exc:  # noqa: BLE001 — an observer
+                # failure must not sink the serving fold, but a silent
+                # one would fake a clean race report: be loud
+                warning("analysis",
+                        "dfsan native replay of %s failed: %s",
+                        self.tp.name, exc)
         if self._obs:
             self._obs = False
             self.lib.pdtd_obs_disable(self._e)

@@ -507,3 +507,42 @@ def test_obs_native_guard_rows_fire_in_both_directions():
     assert bench._compare_captures(
         {"obs_native_tasks_per_sec": 590000.0,
          "obs_native_overhead_pct": 8.3}, prior) == {}
+
+
+def test_sanitize_section_registered():
+    """ISSUE 14 bench contract: --section sanitize is a first-class
+    section; the native-dfsan taskrate row rides the throughput
+    drop-guard and the lane's report count rides the zero-baseline arm
+    of the latency guard."""
+    bench = _load_bench()
+    assert "sanitize" in bench.SECTIONS
+    assert bench._SECTION_KEYS["sanitize"] == ("sanitize",)
+    assert "tasks_per_sec_native_dfsan" in bench._GFLOPS_GUARD_KEYS
+    assert "sanitize_report_count" in bench._LATENCY_GUARD_KEYS
+    result = _fat_result()
+    result["detail"]["extra_configs"]["taskrate"][
+        "tasks_per_sec_native_dfsan"] = 412345.6
+    result["detail"]["extra_configs"]["sanitize"] = {
+        "report_count": 0, "summary": "asan:0,tsan:0,ubsan:0",
+        "ran": ["tsan", "asan", "ubsan"], "skipped": [], "clean": True}
+    compact = json.loads(bench._compact_summary(result))
+    assert compact["detail"]["tasks_per_sec_native_dfsan"] == 412345.6
+    assert compact["detail"]["sanitize_report_count"] == 0
+
+
+def test_native_dfsan_guard_fires_on_drop_and_any_report():
+    """A native-dfsan rate drop (the sanitizer got expensive) and ANY
+    sanitizer report against the zero baseline both fail the capture;
+    within-band stays quiet."""
+    bench = _load_bench()
+    prior = {"tasks_per_sec_native_dfsan": 400000.0,
+             "sanitize_report_count": 0}
+    out = bench._compare_captures(
+        {"tasks_per_sec_native_dfsan": 12000.0,   # fell to Python rate
+         "sanitize_report_count": 1}, prior)      # a finding appeared
+    assert "tasks_per_sec_native_dfsan" in out["throughput_regression"]
+    assert "sanitize_report_count" in out["latency_regression"]
+    assert "zero-baseline" in out["latency_regression"]
+    assert bench._compare_captures(
+        {"tasks_per_sec_native_dfsan": 390000.0,
+         "sanitize_report_count": 0}, prior) == {}

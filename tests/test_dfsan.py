@@ -32,11 +32,10 @@ def san_ctx():
 def _run_dtd_gemm(scheduler, release_batch, bypass_chain, nb_cores=4,
                   native_dtd=0):
     """One DTD GEMM run under the sanitizer; returns (races, digest).
-    ``native_dtd=1`` asserts the standing ISSUE 10 determinism guard:
-    the sanitizer is a per-task observer, so the pool falls back to the
-    instrumented Python path (the documented rule) and the per-tile
-    version digest must stay bitwise-identical to every other engine
-    configuration."""
+    ``native_dtd=1`` is the ISSUE 14 acceptance arm: dfsan no longer
+    forces the Python engine — the pool runs NATIVELY and the ring-fed
+    fold-time replay must produce a per-tile version digest
+    bitwise-identical to every Python-engine configuration."""
     mca_param.set("pins", "dfsan")
     mca_param.set("runtime.release_batch", release_batch)
     mca_param.set("runtime.bypass_chain", bypass_chain)
@@ -61,9 +60,13 @@ def _run_dtd_gemm(scheduler, release_batch, bypass_chain, nb_cores=4,
         tp.wait()
         races = [str(r) for r in ctx.dfsan.races]
         digest = ctx.dfsan.digest()
-        # the sanitizer observer must have kept the pool on the
-        # instrumented Python path regardless of runtime.native_dtd
-        assert tp._native is None
+        # ISSUE 14: with the ring-fed replay, the sanitizer keeps the
+        # NATIVE engine when the knob (and the toolchain) allows it
+        from parsec_tpu import _native
+        want_native = bool(native_dtd) and _native.available()
+        assert (tp._native is not None) == want_native
+        if want_native:
+            assert ctx.dfsan.stats["native_replayed_pools"] >= 1
         parsec.fini(ctx)
         return races, digest
     finally:

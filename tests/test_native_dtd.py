@@ -100,15 +100,18 @@ def test_engine_engages_by_default_and_knob_disables(nctx):
 
 
 @pytest.mark.parametrize("observer,expect_native", [
-    # residual Python-pinning list (ISSUE 13, documented in
-    # dsl/dtd_native.py): semantically-intrusive observers only
-    ("dfsan", False),           # stamps/orders every access
-    ("grapher", False),         # records every dep edge
+    # residual Python-pinning list (ISSUE 13 moved the line, ISSUE 14
+    # moved dfsan off it; documented per row in dsl/dtd_native.py):
+    # semantically-intrusive observers with no native source only
+    ("grapher", False),         # records every dep edge at release
     ("debug_history", False),   # EXE-mark ring expects every task
-    ("alperf", False),          # per-task sampler, no native source
-    ("counters", False),        # per-task rusage sampler
+    ("alperf", False),          # per-task rusage sampler, no native src
+    ("counters", False),        # per-task counter-snapshot sampler
     ("straggler", False),       # no trace → no native ring feed
     # observers that NO LONGER disqualify (the moved fallback line)
+    ("dfsan", True),            # ISSUE 14: ring-fed fold-time replay
+    #                             over insert manifests — same races,
+    #                             same digests, no Python hot loop
     ("trace", True),            # in-engine event rings record spans
     ("stage_timers", True),     # stage totals read from C++ atomics
     ("overhead", True),         # scrape-only (flips stage_timers)
@@ -117,7 +120,7 @@ def test_engine_engages_by_default_and_knob_disables(nctx):
     ("metrics", True),          # always-on registry is scrape-time
 ])
 def test_instrumented_fallback_rule(observer, expect_native):
-    """The ISSUE 13 fallback matrix: exactly which observers still
+    """The ISSUE 13/14 fallback matrix: exactly which observers still
     force the instrumented Python path (with runtime.native_dtd forced
     on, so a silent mis-classification cannot hide)."""
     if not _native.available():

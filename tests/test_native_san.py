@@ -1,0 +1,299 @@
+"""Sanitizer-grade native engine (ISSUE 14): the TSan/ASan/UBSan build
+lane (`native.sanitize` / PARSEC_NATIVE_SAN variants with per-variant
+binary caches), the seeded interleaving-stress suite's ZERO-REPORT
+contract (all-native driver, no Python frames to suppress), the PR 13
+pdtd_stats-vs-ring-growth race regression under TSan, the C
+lock-discipline recorder feeding dfsan's inversion detector, and the
+-Wall -Wextra -Werror native compile gate (+ clang-tidy when the
+binary exists)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from parsec_tpu import _native
+from parsec_tpu._native import sanlane
+from parsec_tpu.utils import mca_param
+
+_CORE = os.path.join(os.path.dirname(_native.__file__), "core.cpp")
+
+
+def _require(variant):
+    reason = sanlane.capable(variant)
+    if reason is not None:
+        pytest.skip(f"sanitizer lane unavailable: {reason}")
+
+
+# ---------------------------------------------------------------------------
+# knob + variant cache
+# ---------------------------------------------------------------------------
+
+def test_sanitize_knob_resolution(monkeypatch):
+    """Env PARSEC_NATIVE_SAN wins over the MCA knob; a typo fails
+    loudly instead of silently meaning the production build."""
+    monkeypatch.delenv("PARSEC_NATIVE_SAN", raising=False)
+    assert _native.variant() == "off"
+    mca_param.set("native.sanitize", "tsan")
+    try:
+        assert _native.variant() == "tsan"
+        monkeypatch.setenv("PARSEC_NATIVE_SAN", "ubsan")
+        assert _native.variant() == "ubsan"
+        monkeypatch.setenv("PARSEC_NATIVE_SAN", "thread-san")
+        with pytest.raises(ValueError, match="thread-san"):
+            _native.variant()
+    finally:
+        mca_param.unset("native.sanitize")
+    monkeypatch.delenv("PARSEC_NATIVE_SAN", raising=False)
+    mca_param.set("native.sanitize", "bogus")
+    try:
+        with pytest.raises(ValueError):              # choices-validated
+            mca_param.get("native.sanitize")         # at resolve time
+    finally:
+        mca_param.unset("native.sanitize")
+
+
+def test_variant_flags_and_paths_are_distinct():
+    """Every sanitizer variant gets its own binary path and its own
+    stamp content (source hash + flags), so sanitized and production
+    .so files COEXIST and a flag change rebuilds."""
+    paths = {_native.so_path(v) for v in ("off", "tsan", "asan", "ubsan")}
+    assert len(paths) == 4
+    assert _native.so_path("off").endswith("libparsec_core.so")
+    assert _native.so_path("tsan").endswith("libparsec_core.tsan.so")
+    stamps = {v: _native._stamp_want(v)
+              for v in ("off", "tsan", "asan", "ubsan")}
+    assert len(set(stamps.values())) == 4
+    # production stamp stays the bare source hash (PR 10 format — an
+    # existing deployment's stamp must remain valid)
+    assert stamps["off"] == _native._src_hash()
+    for v in ("tsan", "asan", "ubsan"):
+        assert stamps[v].startswith(_native._src_hash() + " ")
+        assert "-fsanitize" in stamps[v]
+        assert "-DPARSEC_SAN_YIELD=1" in stamps[v]
+
+
+def test_variant_cache_keeps_production_and_sanitized_separate():
+    """Satellite (CI): building the tsan variant must not touch the
+    production binary, both load keys stay independent, and a rebuild
+    is a cache hit."""
+    _require("tsan")
+    assert _native.available(), _native.build_error()   # production
+    prod_so = _native.so_path("off")
+    prod_mtime = os.path.getmtime(prod_so)
+    assert _native._build("tsan"), _native._build_errors.get("tsan")
+    tsan_so = _native.so_path("tsan")
+    assert os.path.exists(tsan_so) and os.path.exists(prod_so)
+    assert os.path.getmtime(prod_so) == prod_mtime
+    with open(tsan_so + ".srchash") as f:
+        assert f.read().strip() == _native._stamp_want("tsan")
+    tsan_mtime = os.path.getmtime(tsan_so)
+    assert _native._build("tsan")                        # cache hit
+    assert os.path.getmtime(tsan_so) == tsan_mtime
+
+
+def test_production_build_compiles_out_yield_points():
+    """The production .so binds the lane's ABI uniformly but its
+    injection points are compiled to nothing."""
+    lib = _native.load("off")
+    if lib is None:
+        pytest.skip(_native.build_error())
+    assert lib.psan_yield_enabled() == 0
+    lib.psan_seed(12345)                  # no-op, must not crash
+    assert hasattr(lib, "pdtd_lockdbg_enable")
+
+
+# ---------------------------------------------------------------------------
+# lock-discipline recorder + dfsan inversion feed
+# ---------------------------------------------------------------------------
+
+def test_lockdbg_records_acquisitions_and_zero_pairs():
+    """With dfsan live the engine records its lock acquisitions on C++
+    atomics; the shipped hot loop's discipline is nesting-free, so the
+    acquisition-PAIR mask must stay zero."""
+    if not _native.available():
+        pytest.skip("native core unavailable")
+    import parsec_tpu as parsec
+    from parsec_tpu.data import LocalCollection
+    from parsec_tpu.dsl import dtd
+    mca_param.set("pins", "dfsan")
+    try:
+        ctx = parsec.init(nb_cores=2)
+        ctx.start()
+        C = LocalCollection("C", {(0,): 0})
+        tp = dtd.Taskpool("lockdbg")
+        ctx.add_taskpool(tp)
+        for _ in range(50):
+            tp.insert_task(lambda x: x + 1, dtd.TileArg(C, (0,),
+                                                        dtd.INOUT))
+        assert tp._native is not None
+        eng = tp._native
+        tp.flush()
+        tp.wait()
+        st = eng.stats()
+        assert st["lock_acquires"] > 0
+        assert st["lock_pairs"] == 0
+        # the fold adds a SNAPSHOT of the engine's monotone counter
+        # taken at pool-fold time (the engine keeps taking locks
+        # during the final drain, and the Python _OrderedLock wrapper
+        # feeds the same row), so no inequality against the live C
+        # counter is stable — assert the feed happened instead
+        assert ctx.dfsan.stats["native_replayed_pools"] >= 1
+        assert ctx.dfsan.stats["lock_acquires"] > 0
+        assert not [r for r in ctx.dfsan.races
+                    if r.kind == "lock-order"]
+        parsec.fini(ctx)
+    finally:
+        mca_param.unset("pins")
+
+
+def test_feed_native_lock_pairs_flags_inversions():
+    """Unit: the pdtd pair-bitmask decode — a consistent order adds
+    edges silently, the reverse order is an inversion, and a
+    same-domain pair (two nested entry locks) is an inversion by
+    itself."""
+    from parsec_tpu.analysis.dfsan import DataflowSanitizer
+    doms = _native.PDTD_LOCK_DOMAINS
+    n = len(doms)
+    entry, grow = doms.index("entry"), doms.index("grow")
+    san = DataflowSanitizer()
+    san.feed_native_lock_pairs(1 << (entry * n + grow))  # entry -> grow
+    assert not san.races
+    san.feed_native_lock_pairs(1 << (grow * n + entry))  # reverse
+    inv = [r for r in san.races if r.kind == "lock-order"]
+    assert inv and "native-grow" in inv[0].message + inv[0].task + \
+        inv[0].other
+    san2 = DataflowSanitizer()
+    san2.feed_native_lock_pairs(1 << (entry * n + entry))  # self-nest
+    assert [r for r in san2.races if r.kind == "lock-order"], \
+        "nested same-domain entry locks must flag"
+    # the native order graph COMPOSES with the Python-side one
+    san3 = DataflowSanitizer()
+    san3.lock_acquired("native-entry", 0)
+    san3.lock_released("native-entry", 0)
+    san3.feed_native_lock_pairs(1 << (entry * n + grow))
+    san3.lock_acquired("native-grow", 0)
+    san3.lock_acquired("native-entry", 0)   # reverse via Python side
+    assert [r for r in san3.races if r.kind == "lock-order"]
+
+
+# ---------------------------------------------------------------------------
+# compile gates (satellite: CI/tooling)
+# ---------------------------------------------------------------------------
+
+def test_native_werror_compile_gate():
+    """core.cpp must compile clean under -Wall -Wextra -Werror — the
+    static half of the sanitizer lane, run as a tier-1 gate."""
+    try:
+        proc = subprocess.run(
+            ["g++", "-O1", "-Wall", "-Wextra", "-Werror",
+             "-std=c++17", "-fsyntax-only", "-pthread", _CORE],
+            capture_output=True, text=True, timeout=300)
+    except FileNotFoundError:
+        pytest.skip("g++ not found")
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_clang_tidy_concurrency_gate():
+    """clang-tidy's concurrency/bugprone checks, when the binary
+    exists (clean skip otherwise — this container ships g++ only)."""
+    if not sanlane.clang_tidy_available():
+        pytest.skip("clang-tidy not installed")
+    res = sanlane.run_clang_tidy()
+    assert res["warnings"] == 0, res["output"][-2000:]
+
+
+# ---------------------------------------------------------------------------
+# the zero-report stress contract (acceptance)
+# ---------------------------------------------------------------------------
+
+def test_tsan_stress_zero_reports():
+    """TSan over the all-native seeded stress (insert/steal/cancel/
+    abort/obs-drain/concurrent-scrape): ZERO reports. Every frame in
+    this process is our code — no suppressions exist to hide behind."""
+    _require("tsan")
+    res = sanlane.run_stress("tsan", "all", seed=42, iters=2)
+    assert res["rc"] == 0 and res["reports"] == 0, res["output"]
+
+
+def test_tsan_pins_stats_vs_ring_growth_race():
+    """Satellite 1 — the PR 13 post-review bug class, pinned: a scraper
+    thread hammers pdtd_stats + pdtd_obs_drain WHILE the workers grow
+    (and wrap) the obs rings. The old unsynchronized ``cap`` read was a
+    formal data race exactly here; the lane must stay silent."""
+    _require("tsan")
+    for seed in (7, 1234):
+        res = sanlane.run_stress("tsan", "pdtd", seed=seed, iters=2)
+        assert res["rc"] == 0 and res["reports"] == 0, \
+            f"seed={seed}: {res['output']}"
+
+
+def test_asan_stress_zero_reports():
+    _require("asan")
+    res = sanlane.run_stress("asan", "all", seed=42, iters=2)
+    assert res["rc"] == 0 and res["reports"] == 0, res["output"]
+
+
+def test_ubsan_stress_zero_reports():
+    _require("ubsan")
+    res = sanlane.run_stress("ubsan", "all", seed=42, iters=2)
+    assert res["rc"] == 0 and res["reports"] == 0, res["output"]
+
+
+def test_psan_seed_changes_explored_schedule():
+    """The yield-injection PRNG is reseedable — two seeds must both
+    hold the contract (the lane's reproducibility story: a failing
+    seed can be replayed exactly)."""
+    _require("tsan")
+    for seed in (1, 99999):
+        res = sanlane.run_stress("tsan", "plifo", seed=seed, iters=1)
+        assert res["rc"] == 0 and res["reports"] == 0, \
+            f"seed={seed}: {res['output']}"
+
+
+# ---------------------------------------------------------------------------
+# the Python lane: the REAL engine on the sanitized .so
+# ---------------------------------------------------------------------------
+
+def test_python_lane_tsan_reproducible_via_knob():
+    """Acceptance: the lane is reproducible via ``native.sanitize=
+    tsan`` — a fresh interpreter with the knob (env form) + the
+    preloaded runtime runs a REAL DTD pool on the TSan-instrumented
+    engine with zero reports."""
+    _require("tsan")
+    if sanlane.sanitizer_runtime("tsan") is None:
+        pytest.skip("libtsan.so not resolvable for LD_PRELOAD")
+    rc, out = sanlane.run_python_lane(
+        "tsan", sanlane.py_lane_script("tsan"), timeout=600)
+    assert "SANLANE_OK" in out, out[-3000:]
+    assert sanlane.count_reports(out) == 0, out[-3000:]
+    assert rc == 0, out[-3000:]
+
+
+# ---------------------------------------------------------------------------
+# ruff (satellite: CI/tooling — zero-new-warnings policy)
+# ---------------------------------------------------------------------------
+
+def test_ruff_clean_on_new_surfaces():
+    """`ruff check` over the files this issue touches (skips cleanly
+    where ruff is not installed — same contract as the analysis CLI
+    smoke)."""
+    try:
+        import ruff  # noqa: F401
+        cmd = [sys.executable, "-m", "ruff", "check"]
+    except ImportError:
+        import shutil
+        if shutil.which("ruff") is None:
+            pytest.skip("ruff not installed")
+        cmd = ["ruff", "check"]
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    targets = ["parsec_tpu/_native/sanlane.py",
+               "parsec_tpu/_native/__init__.py",
+               "parsec_tpu/analysis/dfsan.py",
+               "parsec_tpu/analysis/fixtures.py",
+               "parsec_tpu/dsl/dtd_native.py",
+               "tests/test_native_san.py"]
+    proc = subprocess.run(cmd + targets, capture_output=True,
+                          text=True, cwd=here, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
