@@ -1461,6 +1461,22 @@ def _section_serving():
     return {"serving": measure_serving()}
 
 
+def _section_serving_kv():
+    """KV state layer bench (ISSUE 15): a 100-tenant shared-system-
+    prompt open-loop trace through the radix prefix cache + paged KV
+    allocator, A/B'd against the no-sharing baseline at the SAME page
+    budget — headline = sustained req/s, speedup_vs_nosharing (target
+    >= 3x at fixed p99), kv_hit_rate, and effective prefill-tokens/s,
+    every completed request bitwise vs the no-sharing float32 replay;
+    plus a speculative-decode phase (draft branch accepted early,
+    deterministically rejected + cancelled once the context outgrows
+    the sliding window, COW pages released). Runs in a spawn child
+    with BLAS pools pinned to one thread (tiny-matrix bodies on 4
+    workers otherwise drown in BLAS oversubscription)."""
+    from parsec_tpu.serving.kv_bench import measure_serving_kv_pinned
+    return {"serving_kv": measure_serving_kv_pinned()}
+
+
 def _section_sanitize():
     """Zero-report contract of the sanitizer lane (ISSUE 14): for every
     variant this container can build (tsan/asan/ubsan; clean skip
@@ -1529,6 +1545,7 @@ SECTIONS = {
     "recovery": _section_recovery,
     "compile_amortization": _section_compile_amortization,
     "serving": _section_serving,
+    "serving_kv": _section_serving_kv,
     "elastic": _section_elastic,
     "observability": _section_observability,
     "latency": _section_latency,
@@ -1550,6 +1567,7 @@ _SECTION_KEYS = {
     "recovery": ("recovery",),
     "compile_amortization": ("compile_amortization",),
     "serving": ("serving",),
+    "serving_kv": ("serving_kv",),
     "elastic": ("elastic",),
     "observability": ("observability",),
     "latency": ("latency",),
@@ -1624,6 +1642,15 @@ _GFLOPS_GUARD_KEYS = ("value", "gemm_panel_fused_gflops",
                       # serving sustained requests/s rides the same
                       # drop guard
                       "serving_requests_per_sec",
+                      # ISSUE 15 KV state layer: sustained req/s on the
+                      # shared-prefix trace, the >=3x speedup over the
+                      # no-sharing arm, the prefix-cache hit rate, and
+                      # the effective prefill ingest rate — all
+                      # higher-is-better, all on the drop guard
+                      "serving_kv_requests_per_sec",
+                      "serving_kv_speedup",
+                      "kv_hit_rate",
+                      "serving_kv_prefill_tokens_per_sec",
                       # ISSUE 11: worst-phase ramp tracking (completed/
                       # offered %) of the elastic sawtooth — a drop
                       # means the autoscaler stopped keeping up
@@ -1659,6 +1686,10 @@ _LATENCY_GUARD_KEYS = ("eager_1k_p50_us", "rdv_1M_p50_us",
                        # serving: the well-behaved tenants' p99 under a
                        # faulty mixed-tenant load must not creep up
                        "serving_p99_ms",
+                       # ISSUE 15: the share arm's p99 on the shared-
+                       # prefix trace ("at fixed p99" is part of the
+                       # acceptance) rides the rise guard
+                       "serving_kv_p99_ms",
                        # ISSUE 11: tenant-migration routing-pause p99 —
                        # a rise means rescales got more disruptive
                        "elastic_migration_pause_p99_ms",
@@ -1912,6 +1943,18 @@ def _compact_summary(result):
             "serving_shed": pick("serving", "shed_count"),
             "serving_quarantined": pick("serving", "quarantine_count"),
             "serving_isolation": pick("serving", "isolation_check"),
+            "serving_kv_requests_per_sec": pick("serving_kv",
+                                                "requests_per_sec"),
+            "serving_kv_speedup": pick("serving_kv",
+                                       "speedup_vs_nosharing"),
+            "kv_hit_rate": pick("serving_kv", "kv_hit_rate"),
+            "serving_kv_prefill_tokens_per_sec": pick(
+                "serving_kv", "prefill_tokens_per_sec"),
+            "serving_kv_p99_ms": pick("serving_kv", "p99_ms"),
+            "serving_kv_bitwise": pick("serving_kv", "bitwise"),
+            "serving_kv_spec_accepted": pick("serving_kv",
+                                             "spec_accepted_steps"),
+            "serving_kv_acceptance": pick("serving_kv", "acceptance"),
             "elastic_ramp_tracking_pct": pick("elastic",
                                               "ramp_tracking_pct"),
             "elastic_migration_pause_p99_ms": pick(

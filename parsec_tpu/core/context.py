@@ -196,6 +196,9 @@ class Context:
         #                              first Context.submit)
         self.dfsan = None            # analysis.dfsan race sanitizer (PINS
         #                              module sets it; None = zero overhead)
+        self.kv_state = None         # serving KV state layer (paged
+        #                              prefix cache — serving/kv.py
+        #                              KVStateLayer attaches itself)
         # PINS modules selected by the `pins` MCA param; must come after
         # trace/grapher init (task_profiler installs a Trace on self.trace)
         from ..profiling import pins_modules as pins_modules_mod
@@ -534,6 +537,10 @@ class Context:
         }
         if self.serving is not None:
             out["serving"] = self.serving.report()
+        if self.kv_state is not None:
+            # KV state plane (pages in use / hit rate / spec counters)
+            # — scrape-time snapshot, the autoscaler's KV-pressure row
+            out["kv"] = self.kv_state.snapshot()
         out["capacity"] = self._capacity_block()
         if self.trace is not None:
             out["trace_dropped"] = self.trace.dropped()

@@ -368,6 +368,21 @@ class HBMManager:
                     self.stats["remote_stage_in"] += 1
         return [out[i] for i in range(len(pairs))]
 
+    def hint(self, key: Hashable, next_use: Optional[int] = None) -> None:
+        """Refresh an entry's next-use hint + LRU stamp WITHOUT staging
+        or evicting — the KV state layer's page-touch path (every page
+        write/read advances its expected next use, so page-level Belady
+        ranks cold prefix pages as victims ahead of hot ones). No-op
+        for unknown keys."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return
+            self._clock += 1
+            e["last_use"] = self._clock
+            if next_use is not None:
+                e["next_use"] = next_use
+
     def value(self, key: Hashable) -> Any:
         """Current value (device or spilled host) without staging."""
         with self._lock:

@@ -510,6 +510,7 @@ class Taskpool(CoreTaskpool):
         return task
 
     def insert_tasks(self, fn: Callable, rows, *, priority: int = 0,
+                     priorities: Optional[List[int]] = None,
                      device: DeviceType = DeviceType.ALL,
                      pure: bool = False) -> List[Optional[Any]]:
         """Batched :meth:`insert_task` — the insertion fast path. All
@@ -527,7 +528,17 @@ class Taskpool(CoreTaskpool):
         program order, tile tracking, and the cross-rank replay sequence
         are unchanged. Returns one opaque handle per row: a ``Task``
         (Python engine) or an int seq (native engine), ``None`` for a
-        remote shell."""
+        remote shell.
+
+        ``priorities`` (optional, one int per row) overrides
+        ``priority`` per row — the KV state layer uses it to put a
+        request's chunked-prefill rows on the wfq PREFILL lane
+        (priority < 0, ``sched/fair.py``) while its decode rows keep
+        the default lane, inside ONE batch (one admission check: a
+        request's task graph is admitted all-or-nothing). Per-row
+        priorities are a scheduling-lane hint consumed by the Python
+        engine's schedulers; the native engine receives the scalar
+        ``priority`` (lane-aware pools — wfq — never run native)."""
         timed = self.context is not None and self.context.stage_timers
         t0 = time.perf_counter() if timed else None
         self._check_insertable()
@@ -535,6 +546,12 @@ class Taskpool(CoreTaskpool):
         out: List[Optional[Task]] = []
         if not rows:
             return out
+        if priorities is not None:
+            priorities = list(priorities)
+            if len(priorities) != len(rows):
+                raise ValueError(
+                    f"priorities ({len(priorities)}) must match rows "
+                    f"({len(rows)})")
         if self.admission is not None:
             self.admission.admit(self, len(rows))
         eng = self._engine()
@@ -548,7 +565,7 @@ class Taskpool(CoreTaskpool):
         tc0 = self._task_class_for(fn, shape0, device, pure=pure)
         ready: List[Task] = []
         tile_cache: Dict[Any, _Tile] = {}
-        for args in rows:
+        for i, args in enumerate(rows):
             if self.error is not None:
                 # the pool failed mid-batch (poison body, peer death):
                 # flush what is already ready, then surface the abort to
@@ -559,8 +576,10 @@ class Taskpool(CoreTaskpool):
             shape = self._shape_of(args)
             tc = tc0 if shape == shape0 else \
                 self._task_class_for(fn, shape, device, pure=pure)
-            out.append(self._insert_one(tc, args, priority, ready,
-                                        tile_cache))
+            out.append(self._insert_one(
+                tc, args,
+                priorities[i] if priorities is not None else priority,
+                ready, tile_cache))
             if len(ready) >= 512:
                 # chunked flush: keep the workers fed while a long batch
                 # is still inserting (one schedule() per chunk, not per

@@ -434,6 +434,24 @@ def install_context_collectors(context) -> Callable[[], None]:
                       "engine's world_status, plus the autoscaler's "
                       "desired count when a controller is attached)",
                       ("rank", "key"))
+    g_kv_pages = reg.gauge("parsec_kv_pages_in_use",
+                           "KV state layer: pages currently allocated "
+                           "(prefix cache + live requests + draft "
+                           "branches) — the autoscaler's KV-pressure "
+                           "signal", ("rank",))
+    g_kv_hit = reg.gauge("parsec_kv_hit_rate",
+                         "KV prefix-cache hit rate (prompt tokens "
+                         "served from cached pages / prompt tokens "
+                         "looked up, cumulative)", ("rank",))
+    g_kv = reg.gauge("parsec_kv_state",
+                     "KV state layer counters (pages_free/capacity/"
+                     "cow_copies/evict_reclaims/peak_in_use/exhausted/"
+                     "tokens_prefilled/requests/requests_hit/"
+                     "spec_windows/spec_accepted_steps/"
+                     "spec_rejected_windows/spec_cancelled_branches "
+                     "plus the radix-tree nodes/cached_pages/"
+                     "evicted_* rows), read at scrape time",
+                     ("rank", "key"))
 
     pruned_ranks: set = set()         # gone ranks already swept
 
@@ -498,6 +516,29 @@ def install_context_collectors(context) -> Callable[[], None]:
             if n:
                 setg(g_tenant, n, rank=rank, tenant=ten,
                      key="native_tasks")
+        kvl = getattr(ctx, "kv_state", None)
+        if kvl is not None:
+            # scrape-time collectors ONLY (ISSUE 15 contract: the KV
+            # hot path pays nothing for observability) — the layer's
+            # snapshot is a lock-guarded dict copy
+            snap = kvl.snapshot()
+            pool_snap = snap.pop("pool", {})
+            tree_snap = snap.pop("tree", {})
+            setg(g_kv_pages, pool_snap.get("pages_in_use", 0),
+                 rank=rank)
+            setg(g_kv_hit, snap.get("hit_rate", 0.0), rank=rank)
+            for k in ("pages_free", "capacity", "cow_copies",
+                      "evict_reclaims", "peak_in_use", "exhausted"):
+                setg(g_kv, pool_snap.get(k, 0), rank=rank, key=k)
+            for k in ("nodes", "cached_pages", "evicted_nodes",
+                      "evicted_pages"):
+                setg(g_kv, tree_snap.get(k, 0), rank=rank,
+                     key=f"tree_{k}")
+            for k in ("tokens_prefilled", "requests", "requests_hit",
+                      "spec_windows", "spec_accepted_steps",
+                      "spec_rejected_windows",
+                      "spec_cancelled_branches"):
+                setg(g_kv, snap.get(k, 0), rank=rank, key=k)
         hbm = ctx.hbm
         if hbm is not None:
             with hbm._lock:

@@ -32,20 +32,39 @@ from typing import Dict, Optional, Sequence
 
 from .base import Scheduler
 from ..core.task import Task
+from ..utils import mca_param
 
 #: virtual-time quantum charged to a weight-1.0 pool per selected task
 _STRIDE1 = 1 << 20
 
+mca_param.register("serving.kv_prefill_interleave", 4,
+                   help="wfq per-pool prefill-lane cadence: when a pool "
+                        "has BOTH decode and prefill (priority < 0) "
+                        "tasks queued, every Nth selection from that "
+                        "pool serves the prefill lane — long chunked "
+                        "prefills make progress without starving the "
+                        "pool's decode p99 (0/1 = strict alternation, "
+                        "no decode preference)")
+
 
 class _PoolQueue:
-    __slots__ = ("dq", "vpass", "enqueued", "selected", "last_selected_t")
+    __slots__ = ("dq", "pq", "nsel", "vpass", "enqueued", "selected",
+                 "last_selected_t")
 
     def __init__(self, vfloor: float):
-        self.dq = deque()
+        self.dq = deque()            # default (decode) lane
+        self.pq = deque()            # prefill lane: priority < 0 tasks
+        self.nsel = 0                # per-pool selection cadence counter
         self.vpass = vfloor
         self.enqueued = 0
         self.selected = 0
         self.last_selected_t = 0.0
+
+    def backlogged(self) -> bool:
+        return bool(self.dq) or bool(self.pq)
+
+    def __len__(self) -> int:
+        return len(self.dq) + len(self.pq)
 
 
 class WFQScheduler(Scheduler):
@@ -85,16 +104,21 @@ class WFQScheduler(Scheduler):
                 q = self._queues.get(t.taskpool)
                 if q is None:
                     q = self._queues[t.taskpool] = _PoolQueue(floor)
-                elif not q.dq:
+                elif not q.backlogged():
                     # idle pool rejoining: forfeit accumulated lag so it
                     # cannot burst past active pools (start-time fairness)
                     q.vpass = max(q.vpass, floor)
-                q.dq.append(t)
+                # prefill lane (ISSUE 15): chunked-prefill tasks insert
+                # at priority < 0 — within the pool they yield to decode
+                # tasks at the kv_prefill_interleave cadence
+                (q.pq if getattr(t, "priority", 0) < 0
+                 else q.dq).append(t)
                 q.enqueued += 1
 
     def _drop_cancelled_locked(self, tp, q: _PoolQueue) -> None:
-        n = len(q.dq)
+        n = len(q)
         q.dq.clear()
+        q.pq.clear()
         del self._queues[tp]
         for _ in range(n):
             # idempotent-termination contract: the cancelled pool already
@@ -102,18 +126,24 @@ class WFQScheduler(Scheduler):
             tp.addto_nb_tasks(-1)
 
     def select(self, es) -> Optional[Task]:
+        # cached_get: select() runs once per task on every worker — a
+        # full registry get (global lock + env resolve) here would be
+        # a cross-worker serialization point
+        interleave = int(mca_param.cached_get(
+            "serving.kv_prefill_interleave", 4))
         with self._lock:
             # a persistent serving context sees thousands of pools over
             # its lifetime: drop the bookkeeping of finished ones here
             # (empty queue + terminated pool) or _queues grows forever
             done = [tp for tp, q in self._queues.items()
-                    if not q.dq and (tp.completed or tp.cancelled)]
+                    if not q.backlogged() and (tp.completed
+                                               or tp.cancelled)]
             for tp in done:
                 del self._queues[tp]
             while True:
                 best_tp, best_q = None, None
                 for tp, q in self._queues.items():
-                    if not q.dq:
+                    if not q.backlogged():
                         continue
                     if tp.cancelled:
                         self._drop_cancelled_locked(tp, q)
@@ -123,7 +153,20 @@ class WFQScheduler(Scheduler):
                 else:
                     if best_q is None:
                         return None
-                    task = best_q.dq.popleft()
+                    best_q.nsel += 1
+                    if not best_q.dq:
+                        task = best_q.pq.popleft()
+                    elif not best_q.pq:
+                        task = best_q.dq.popleft()
+                    elif best_q.nsel % max(interleave, 2) == 0:
+                        # both lanes backlogged: the prefill lane gets
+                        # every Nth slot of the pool's service — long
+                        # prompts make progress, decode keeps its p99.
+                        # interleave<=1 clamps to strict alternation
+                        # ("no decode preference"), never starvation
+                        task = best_q.pq.popleft()
+                    else:
+                        task = best_q.dq.popleft()
                     if best_q.vpass > self._vclock:
                         self._vclock = best_q.vpass
                     w = max(float(getattr(best_tp, "fair_weight", 1.0)),
@@ -135,7 +178,7 @@ class WFQScheduler(Scheduler):
 
     def pending_tasks(self) -> int:
         with self._lock:
-            return sum(len(q.dq) for q in self._queues.values())
+            return sum(len(q) for q in self._queues.values())
 
     def pool_stats(self) -> Dict[str, Dict[str, float]]:
         """Per-pool service accounting keyed by taskpool name — the
@@ -155,7 +198,8 @@ class WFQScheduler(Scheduler):
                     "weight": float(getattr(tp, "fair_weight", 1.0)),
                     "enqueued": q.enqueued,
                     "selected": q.selected,
-                    "pending": len(q.dq),
+                    "pending": len(q),
+                    "prefill_pending": len(q.pq),
                     "vpass": q.vpass,
                     "since_selected_s": (
                         round(now - q.last_selected_t, 6)

@@ -15,14 +15,25 @@ The proving workload is the Orca-style continuous-batching transformer
 decode loop in :mod:`.decode` (KV cache as a tiled collection under the
 HBM budget manager, per-request decode steps as DTD insertions), benched
 by ``bench.py --section serving`` via :mod:`.serving_bench`.
+
+The KV state layer (:mod:`.kv`, ROADMAP item 3 / ISSUE 15) adds the
+cross-request state plane: paged KV allocation (page-granular
+refcounts, COW, eviction), a radix prefix cache so requests sharing a
+prompt prefix share immutable pages, chunked prefill on the wfq
+prefill lane, and speculative decode as a cancellable draft-branch DTD
+pattern (:mod:`.spec`) — benched by ``bench.py --section serving_kv``
+via :mod:`.kv_bench`.
 """
 
 from .runtime import (AdmissionRejected, DeadlineExceeded, ServingRuntime,
                       Submission, Tenant, TenantQuarantined, enable)
 from .elastic import (AutoscalePolicy, ElasticController, ElasticWorker,
                       Signals)
+from .kv import (KVPagePool, KVPagesExhausted, KVStateLayer, RadixTree,
+                 layer_for)
 
 __all__ = ["AdmissionRejected", "DeadlineExceeded", "ServingRuntime",
            "Submission", "Tenant", "TenantQuarantined", "enable",
            "AutoscalePolicy", "ElasticController", "ElasticWorker",
-           "Signals"]
+           "Signals", "KVPagePool", "KVPagesExhausted", "KVStateLayer",
+           "RadixTree", "layer_for"]

@@ -11,10 +11,11 @@ per hosted tenant behind an :class:`~parsec_tpu.serving.elastic.
 ElasticWorker` agent. Requests route over ``AMTag.ELASTIC`` to the
 tenant's current owner; each completion returns the decode state
 vector, verified BITWISE against the float32 reference replay after
-the load ends. Per-request service time is modeled explicitly
-(``work_ms`` on the worker's request thread) so per-rank capacity is a
-deliberate parameter, not an accident of host speed — the decode
-payload itself stays the real kernel for the bitwise contract.
+the load ends. Per-rank capacity is the rank's REAL decode throughput
+(``work_ms=0``, the ISSUE 15 re-capture closing ROADMAP item 4's
+REMAINING note) — the autoscaler reacts to what the serving stack can
+genuinely sustain; pass ``work_ms > 0`` to model service time
+explicitly instead (capacity as a controlled parameter).
 
 Tenants also carry a persistent 4-tile profile shard that MIGRATES
 through the checkpoint vehicle on every rebalance; a sha256 digest at
@@ -328,11 +329,19 @@ class _Router:
 def measure_elastic(low_s: float = 4.0, high_s: float = 14.0,
                     tail_s: float = 12.0, low_rate: float = 8.0,
                     high_rate: float = 70.0,
-                    work_ms: float = 35.0) -> Dict:
+                    work_ms: float = 0.0) -> Dict:
     """The full sawtooth measurement (see module doc). Phase plan:
     ``low_rate`` for ``low_s``, ``high_rate`` for ``high_s`` (the
     autoscaler grows 2 → 4 ranks), ``low_rate`` again for ``tail_s``
-    (it drains back toward 2)."""
+    (it drains back toward 2).
+
+    ``work_ms=0`` (the default since ISSUE 15's re-capture — the
+    REMAINING note on closed ROADMAP item 4): per-rank capacity is the
+    rank's REAL decode throughput (the engine's actual insert→steps→
+    drain cost per request), not a modeled sleep — the autoscaler's
+    backlog signals now reflect what the serving stack can genuinely
+    sustain per rank. Pass a positive ``work_ms`` to restore the
+    modeled-service-time shape (capacity as a controlled parameter)."""
     import tempfile
     from ..comm.socket_engine import SocketCommEngine
     from ..core import context as ctx_mod
@@ -389,6 +398,40 @@ def measure_elastic(low_s: float = 4.0, high_s: float = 14.0,
             ctrl.placement[t] = None
             ctrl.migrate_tenant(t, dst)
         seed_migrations = len(ctrl.migration_pauses_ms)
+
+        cal = None
+        if work_ms <= 0:
+            # REAL-DECODE capacity (ISSUE 15 satellite): calibrate the
+            # sawtooth against the single rank's measured decode
+            # throughput BEFORE the autoscaler starts — the phase
+            # rates were historically tuned to the modeled work_ms,
+            # and real capacity varies per container; an uncalibrated
+            # high phase that one rank absorbs exercises nothing.
+            rid0 = 1_000_000
+            t_cal = time.monotonic()
+            interval = 1.0 / 300.0
+            next_t = time.monotonic()
+            for i in range(240):
+                router.submit(rid0 + i, _TENANTS[i % len(_TENANTS)],
+                              -1)
+                next_t += interval
+                d = next_t - time.monotonic()
+                if d > 0:
+                    time.sleep(d)
+            deadline_c = time.monotonic() + 60.0
+            while time.monotonic() < deadline_c:
+                with router.lock:
+                    if not router.outstanding:
+                        break
+                time.sleep(0.02)
+            with router.lock:
+                done_cal = sum(1 for c in router.completions
+                               if c["phase"] == -1)
+            cal = done_cal / (time.monotonic() - t_cal)
+            # saturate ~2.2x one rank's real capacity so the scaler
+            # MUST grow; low phases sit comfortably inside it
+            high_rate = max(low_rate * 3, min(2.2 * cal, 260.0))
+            low_rate = max(low_rate, round(0.25 * cal, 1))
         ctrl.start()
 
         # world-size timeline sampler (the ramp-tracking evidence)
@@ -534,6 +577,11 @@ def measure_elastic(low_s: float = 4.0, high_s: float = 14.0,
                 {k: d[k] for k in ("from", "to", "reason", "ok")}
                 for d in ctrl.decisions if d["acted"]][:16],
             "work_ms": work_ms,
+            "capacity_model": ("real-decode" if work_ms <= 0
+                               else "modeled-work-ms"),
+            "calibrated_rank_capacity_per_sec": (round(cal, 1)
+                                                 if cal else None),
+            "rates": {"low": low_rate, "high": high_rate},
         })
     finally:
         # mid-bench exceptions must not leave the autoscaler ACTING

@@ -1,0 +1,626 @@
+"""KV state layer: paged allocation + radix prefix cache (ISSUE 15).
+
+The serving runtime's missing state layer between
+:class:`~.decode.DecodeEngine` and the HBM manager (ROADMAP item 3 —
+the "millions of users" workload). Production LLM traffic is dominated
+by shared prefixes (system prompts, few-shot templates, multi-turn
+history); vLLM's PagedAttention (Kwon et al., SOSP 2023) and SGLang's
+RadixAttention (Zheng et al., 2024) show that paged, prefix-shared KV
+state is the single biggest req/s lever at fixed HBM. This module is
+the TPU-runtime-shaped version of that design:
+
+- **Paged allocation** (:class:`KVPagePool`): KV tiles become
+  fixed-size pages of ``serving.kv_page_tokens`` (k, v) rows; a request
+  holds a page table (ordered pids), pages are refcounted (the
+  ``pgraph_consume``-style consumer-countdown pattern of PR 10, held
+  under the pool lock since every caller is Python here), and the
+  allocation/eviction granularity is a PAGE, not a request. Page
+  arrays live in a shared :class:`PagedKVCollection` so DTD decode
+  tasks reference them as ordinary tiles, and every page is registered
+  with the context's HBM manager under a ``("kvpage", ...)`` key with a
+  next-use hint refreshed on each write — page-level Belady eviction,
+  and deliberately OUTSIDE the per-collection sweep a cancelled
+  tenant's submission triggers (pages are shared across tenants; a
+  cancellation releases that request's REFERENCES, never the bytes
+  another tenant is reading).
+- **Radix prefix tree** (:class:`RadixTree`): a token-prefix trie whose
+  nodes own refcounted runs of immutable, PAGE-ALIGNED page ids.
+  Requests sharing a prompt prefix share pages; match granularity is a
+  whole page (token-level divergence inside a page means that page is
+  recomputed — the vLLM block-granularity rule), node runs split at
+  page boundaries on divergence, nodes are LRU-evicted leaves-first and
+  eviction REFUSES nodes pinned by live requests (``lock_ref``).
+  Prefill becomes "match longest prefix, then chunked-prefill only the
+  suffix" (the chunk tasks ride the wfq prefill lane — ``sched/
+  fair.py`` — so long prompts can't starve decode p99).
+- **Copy-on-write** (:meth:`KVPagePool.cow`): writers of a shared page
+  (refs > 1) copy at the divergence point — the speculative-decode
+  draft branch (``serving/spec.py``) COWs the request's tail page
+  before appending draft rows, and releases the copies when the branch
+  loses.
+
+Cross-pool safety note (why sharing immutable pages between tenants'
+taskpools is race-free without cross-pool dependency tracking): DTD
+INPUT flows with no in-flight writer snapshot the tile value at INSERT
+time, and the radix tree only publishes a page after the prefill task
+that filled it has COMPLETED (publication happens in the prefill-state
+task's body, which is RAW-ordered behind every chunk's write-back).
+A freed page is only reallocated after every holder dropped its
+refcount, i.e. after their readers were inserted (snapshots taken) —
+so a later owner's rewrite can never be observed by an earlier
+reader. The dfsan sanitizer cannot see this refcount ordering; pools
+sharing pages under dfsan would report cross-pool WAW on reused pids
+(the tier-1 suites don't enable dfsan on this path).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.collection import LocalCollection
+from ..utils import mca_param
+from ..utils.debug import debug_verbose
+
+mca_param.register("serving.kv_page_tokens", 16,
+                   help="(k, v) rows per KV page — the allocation, "
+                        "sharing, and eviction granularity of the KV "
+                        "state layer")
+mca_param.register("serving.kv_pages", 0,
+                   help="page-pool capacity of the KV state layer "
+                        "(0 = unbounded); allocation beyond it evicts "
+                        "unpinned prefix-cache pages, then raises "
+                        "KVPagesExhausted")
+mca_param.register("serving.kv_prefix_cache", 1,
+                   help="radix prefix cache on/off: requests sharing a "
+                        "prompt prefix share immutable KV pages "
+                        "(0 = every request prefills its whole prompt)")
+mca_param.register("serving.kv_prefill_chunk", 4,
+                   help="pages per chunked-prefill task: long prompts "
+                        "prefill as independent chunk tasks on the wfq "
+                        "prefill lane instead of one monolithic insert")
+mca_param.register("serving.kv_spec_draft", 0,
+                   help="speculative-decode draft window length (steps "
+                        "per verify task; 0 = speculation off). Drafts "
+                        "run in a cancellable branch taskpool "
+                        "(serving/spec.py)")
+mca_param.register("serving.kv_decode_window", 1,
+                   help="multi-step decode scheduling: decode steps "
+                        "per task (vLLM --num-scheduler-steps shape) — "
+                        "the exact per-step kernel sequence runs in "
+                        "one body, amortizing per-task runtime "
+                        "overhead W-fold; results stay bitwise the "
+                        "W=1 chain's by construction")
+
+
+class KVPagesExhausted(MemoryError):
+    """The page pool is at capacity and nothing is evictable — the
+    caller (DecodeEngine.request) surfaces this as AdmissionRejected so
+    open-loop clients back off instead of crashing."""
+
+
+class PagedKVCollection(LocalCollection):
+    """The shared page store, addressable as DTD tiles keyed ``(pid,)``.
+
+    One collection per :class:`KVStateLayer`, shared by every tenant's
+    decode pool on the context — the whole point is that two tenants'
+    tasks read the SAME prefix page tile. Runtime write-backs (the
+    INOUT flow of prefill-chunk / decode-step tasks) land here; each
+    write refreshes the page's HBM entry + next-use hint through the
+    owning pool."""
+
+    def __init__(self, name: str, pool: "KVPagePool"):
+        super().__init__(name)
+        self._pool = pool
+
+    def write_tile(self, key, value) -> None:
+        super().write_tile(key, value)
+        self._pool._on_page_write(key[0], value)
+
+
+class KVPagePool:
+    """Fixed-size KV page allocator with refcounts, COW, and page-level
+    HBM accounting. All bookkeeping under one lock (allocation is off
+    the per-step hot path — a request allocates its whole table once)."""
+
+    def __init__(self, name: str, page_tokens: int, d_model: int,
+                 capacity: int = 0, hbm=None):
+        self.name = name
+        self.page_tokens = int(page_tokens)
+        self.d_model = int(d_model)
+        self.capacity = int(capacity)          # 0 = unbounded
+        self.hbm = hbm
+        self.dc = PagedKVCollection(f"{name}_pages", self)
+        self._lock = threading.RLock()
+        self._refs: Dict[int, int] = {}        # pid -> refcount
+        self._free: List[int] = []
+        self._next_pid = 0
+        self._clock = 0                        # next-use hint clock
+        # reclaim callback installed by the radix tree: called (n
+        # pages wanted) under pressure; returns pages actually freed
+        self._reclaim: Optional[Callable[[int], int]] = None
+        self.stats = {"allocs": 0, "frees": 0, "cow_copies": 0,
+                      "evict_reclaims": 0, "peak_in_use": 0,
+                      "exhausted": 0}
+
+    # ----------------------------------------------------------- internal
+    def _hbm_key(self, pid: int):
+        # deliberately NOT (id(dc), key)-shaped: the serving runtime's
+        # cancel-time sweep drops every HBM entry of a cancelled pool's
+        # collections, and pages are shared across tenants — a page
+        # dies only when its refcount does (drop in _free_locked)
+        return ("kvpage", id(self), pid)
+
+    def _on_page_write(self, pid: int, value) -> None:
+        hbm = self.hbm
+        if hbm is None:
+            return
+        with self._lock:
+            if pid not in self._refs:
+                return
+            self._clock += 1
+            nu = self._clock + 1
+        # re-register: the HBM entry must hold the CURRENT page bytes
+        # (a stale entry would stage superseded data on ensure)
+        key = self._hbm_key(pid)
+        hbm.drop(key)
+        try:
+            hbm.register(key, value, next_use=nu)
+        except MemoryError:
+            pass                   # page larger than the whole budget
+
+    def touch(self, pid: int) -> None:
+        """Refresh a page's HBM next-use hint (a cache hit means the
+        page is about to be read by a whole request's decode chain) —
+        :meth:`HBMManager.hint`, no staging, no eviction."""
+        hbm = self.hbm
+        if hbm is None:
+            return
+        with self._lock:
+            if pid not in self._refs:
+                return
+            self._clock += 1
+            nu = self._clock + 1
+        hbm.hint(self._hbm_key(pid), next_use=nu)
+
+    def _free_locked(self, pid: int) -> None:
+        self._refs.pop(pid, None)
+        self._free.append(pid)
+        self.stats["frees"] += 1
+        self.dc.drop_tile((pid,))
+        if self.hbm is not None:
+            self.hbm.drop(self._hbm_key(pid))
+
+    def _fresh_page(self) -> np.ndarray:
+        # UNINITIALIZED on purpose: every row a page consumer ever
+        # reads is written first (prefill fills its rows, a decode
+        # step reads tail[:slot+1], and a page only joins the "prev"
+        # set once every slot is written), so a memset per page would
+        # be pure allocation-path cost — measured at ~60% of the
+        # request-admission critical section under the pool lock
+        return np.empty((2, self.page_tokens, self.d_model),
+                        dtype=np.float32)
+
+    # ------------------------------------------------------------ public
+    def alloc(self, n: int = 1) -> List[int]:
+        """Allocate ``n`` UNINITIALIZED pages (refcount 1 each — see
+        :meth:`_fresh_page` for the every-row-written-first contract
+        that makes a memset dead cost), evicting
+        reclaimable prefix-cache pages under capacity pressure. Raises
+        :class:`KVPagesExhausted` when the budget cannot hold — the
+        request-granularity failure the paged design exists to avoid
+        becomes an explicit, page-granular admission signal."""
+        with self._lock:
+            if self.capacity:
+                want = n - (self.capacity - self.pages_in_use())
+                if want > 0 and self._reclaim is not None:
+                    freed = self._reclaim(want)
+                    if freed:
+                        self.stats["evict_reclaims"] += freed
+                if self.pages_in_use() + n > self.capacity:
+                    self.stats["exhausted"] += 1
+                    raise KVPagesExhausted(
+                        f"KV page pool {self.name}: {n} pages requested,"
+                        f" {self.pages_in_use()}/{self.capacity} in use "
+                        "and nothing evictable (serving.kv_pages)")
+            out = []
+            for _ in range(n):
+                pid = self._free.pop() if self._free else self._next_pid
+                if pid == self._next_pid:
+                    self._next_pid += 1
+                self._refs[pid] = 1
+                self.stats["allocs"] += 1
+                out.append(pid)
+                self.dc.write_tile((pid,), self._fresh_page())
+            used = self.pages_in_use()
+            if used > self.stats["peak_in_use"]:
+                self.stats["peak_in_use"] = used
+            return out
+
+    def retain(self, pid: int, n: int = 1) -> None:
+        with self._lock:
+            if pid not in self._refs:
+                raise KeyError(f"retain of freed page {pid}")
+            self._refs[pid] += n
+
+    def release(self, pid: int) -> None:
+        """Drop one reference; the last one returns the page to the
+        free list, drops its tile and its HBM entry."""
+        with self._lock:
+            refs = self._refs.get(pid)
+            if refs is None:
+                return                # idempotent: already freed
+            if refs > 1:
+                self._refs[pid] = refs - 1
+            else:
+                self._free_locked(pid)
+
+    def cow(self, pid: int) -> int:
+        """Copy-on-write: a private copy of ``pid`` (refcount 1) for a
+        writer that must not mutate a shared page — the divergence-
+        point copy. The source's refcount is untouched (the caller
+        still holds its reference)."""
+        src = self.dc.data_of((pid,))
+        if src is None:
+            raise KeyError(f"cow of unknown page {pid}")
+        [new] = self.alloc(1)
+        self.dc.write_tile((new,), np.array(src, copy=True))
+        with self._lock:
+            self.stats["cow_copies"] += 1
+        return new
+
+    def refs(self, pid: int) -> int:
+        with self._lock:
+            return self._refs.get(pid, 0)
+
+    def pages_in_use(self) -> int:
+        return len(self._refs)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"pages_in_use": len(self._refs),
+                    "pages_free": len(self._free),
+                    "capacity": self.capacity, **self.stats}
+
+
+# ---------------------------------------------------------------------------
+# radix prefix tree
+# ---------------------------------------------------------------------------
+
+class _RadixNode:
+    """One trie node: a PAGE-ALIGNED token run backed by the page ids
+    that hold its (k, v) rows. Children are keyed by their FIRST PAGE
+    of tokens (a pt-tuple) — two continuations that diverge inside a
+    page are simply different children, so no split ever has to cut
+    through a page. ``lock_ref`` pins the node against eviction while
+    a live request references its pages."""
+
+    __slots__ = ("tokens", "pages", "children", "parent", "lock_ref",
+                 "last_use")
+
+    def __init__(self, tokens: Tuple[int, ...], pages: Tuple[int, ...],
+                 parent: Optional["_RadixNode"]):
+        self.tokens = tokens
+        self.pages = pages
+        self.children: Dict[Tuple[int, ...], "_RadixNode"] = {}
+        self.parent = parent
+        self.lock_ref = 0
+        self.last_use = 0
+
+
+class MatchHandle:
+    """The result of :meth:`RadixTree.match`: the shared page ids (one
+    pool reference each, owned by the caller) and the pinned node path.
+    ``unlock()`` releases the PINS only — page references are released
+    by the request's own release path (uniform with owned pages)."""
+
+    __slots__ = ("pids", "n_tokens", "_nodes", "_tree", "_unlocked")
+
+    def __init__(self, tree: "RadixTree", pids: List[int],
+                 n_tokens: int, nodes: List[_RadixNode]):
+        self._tree = tree
+        self.pids = pids
+        self.n_tokens = n_tokens
+        self._nodes = nodes
+        self._unlocked = False
+
+    def unlock(self) -> None:
+        if self._unlocked:
+            return
+        self._unlocked = True
+        with self._tree._lock:
+            for node in self._nodes:
+                if node.lock_ref > 0:
+                    node.lock_ref -= 1
+
+
+class RadixTree:
+    """Token-prefix trie over refcounted, immutable, page-aligned page
+    runs (SGLang's RadixAttention shape at vLLM's block granularity).
+
+    The tree owns ONE pool reference per cached page (taken at
+    :meth:`insert`, dropped at eviction); matching requests take their
+    own references. Node runs are multiples of ``page_tokens``; splits
+    happen at page boundaries, so a page id never straddles nodes."""
+
+    def __init__(self, pool: KVPagePool):
+        self.pool = pool
+        self.pt = pool.page_tokens
+        self._root = _RadixNode((), (), None)
+        # ONE lock with the pool (re-entrant): alloc-under-pressure
+        # calls tree eviction while match/insert call pool retain/
+        # release — two locks here would be an ABBA deadlock between a
+        # matching thread and an allocating one
+        self._lock = pool._lock
+        self._clock = 0
+        self.stats = {"nodes": 0, "cached_pages": 0, "inserts": 0,
+                      "evicted_nodes": 0, "evicted_pages": 0,
+                      "splits": 0}
+        pool._reclaim = self._reclaim_for_pool
+
+    # ----------------------------------------------------------- helpers
+    @staticmethod
+    def _common(a: Sequence[int], b: Sequence[int]) -> int:
+        n = min(len(a), len(b))
+        for i in range(n):
+            if a[i] != b[i]:
+                return i
+        return n
+
+    def _split_locked(self, node: _RadixNode, at_pages: int) -> None:
+        """Split ``node`` at the page boundary ``at_pages``: the node
+        keeps the head run (identity preserved — live MatchHandles may
+        hold it), a new child inherits the tail run and the children.
+        The child starts UNPINNED: evicting it under a live request
+        only loses cache warmth, never bytes (the request holds page
+        refcounts of its own)."""
+        cut_t = at_pages * self.pt
+        tail = _RadixNode(node.tokens[cut_t:], node.pages[at_pages:],
+                          node)
+        tail.children = node.children
+        for ch in tail.children.values():
+            ch.parent = tail
+        tail.last_use = node.last_use
+        node.tokens = node.tokens[:cut_t]
+        node.pages = node.pages[:at_pages]
+        node.children = {tail.tokens[:self.pt]: tail}
+        self.stats["nodes"] += 1
+        self.stats["splits"] += 1
+
+    # ------------------------------------------------------------ public
+    def match(self, tokens: Sequence[int]) -> MatchHandle:
+        """Longest page-aligned cached prefix of ``tokens``. Returns a
+        :class:`MatchHandle` holding one pool reference per matched
+        page (caller-owned) and an eviction pin on every node of the
+        matched path."""
+        tokens = tuple(tokens)
+        pids: List[int] = []
+        nodes: List[_RadixNode] = []
+        with self._lock:
+            self._clock += 1
+            node, off = self._root, 0
+            while True:
+                nxt = node.children.get(tokens[off:off + self.pt]) \
+                    if off + self.pt <= len(tokens) else None
+                if nxt is None:
+                    break
+                m = self._common(nxt.tokens, tokens[off:])
+                m_pages = m // self.pt
+                if m_pages == 0:
+                    break              # fewer than a page in common
+                nxt.last_use = self._clock
+                nxt.lock_ref += 1
+                nodes.append(nxt)
+                take = nxt.pages[:m_pages]
+                for pid in take:
+                    self.pool.retain(pid)
+                    self.pool.touch(pid)
+                pids.extend(take)
+                if m_pages < len(nxt.pages):
+                    break              # diverged inside this node's run
+                node, off = nxt, off + m
+        return MatchHandle(self, pids, len(pids) * self.pt, nodes)
+
+    def insert(self, tokens: Sequence[int], pids: Sequence[int]) -> int:
+        """Publish ``tokens`` (page-aligned: ``len(tokens) == len(pids)
+        * page_tokens``) as a cached path backed by ``pids``. The tree
+        retains each NEWLY cached page; already-cached prefixes are
+        deduplicated (their existing pages stay authoritative). Returns
+        the number of pages newly cached. Call only after the pages'
+        bytes are final (the prefill-state task body)."""
+        tokens = tuple(tokens)
+        pids = list(pids)
+        if len(tokens) != len(pids) * self.pt:
+            raise ValueError(
+                f"insert of {len(tokens)} tokens with {len(pids)} pages"
+                f" (page_tokens {self.pt}): publication is page-aligned")
+        added = 0
+        with self._lock:
+            self._clock += 1
+            self.stats["inserts"] += 1
+            node, off, pi = self._root, 0, 0
+            while off < len(tokens):
+                nxt = node.children.get(tokens[off:off + self.pt])
+                if nxt is None:
+                    child = _RadixNode(tokens[off:], tuple(pids[pi:]),
+                                       node)
+                    child.last_use = self._clock
+                    node.children[tokens[off:off + self.pt]] = child
+                    n_new = len(child.pages)
+                    for pid in child.pages:
+                        self.pool.retain(pid)
+                    self.stats["nodes"] += 1
+                    self.stats["cached_pages"] += n_new
+                    added += n_new
+                    return added
+                # the child key is its whole first page, so at least
+                # one page is always in common here
+                m = self._common(nxt.tokens, tokens[off:])
+                m_pages = m // self.pt
+                nxt.last_use = self._clock
+                if m_pages < len(nxt.pages):
+                    self._split_locked(nxt, m_pages)
+                node, off, pi = nxt, off + m_pages * self.pt, \
+                    pi + m_pages
+        return added
+
+    def _evictable_leaves_locked(self) -> List[_RadixNode]:
+        out = []
+        stack = [self._root]
+        while stack:
+            n = stack.pop()
+            for ch in n.children.values():
+                if ch.children:
+                    stack.append(ch)
+                elif ch.lock_ref == 0:
+                    out.append(ch)
+        return out
+
+    def evict(self, n_pages: int) -> int:
+        """LRU eviction: drop unpinned LEAF nodes (bottom-up — a parent
+        becomes a leaf once its children are gone) until ``n_pages``
+        page references were released or nothing evictable remains.
+        Pinned nodes (``lock_ref > 0``: a live request's matched path)
+        are REFUSED. Returns pages released."""
+        freed = 0
+        with self._lock:
+            while freed < n_pages:
+                leaves = self._evictable_leaves_locked()
+                if not leaves:
+                    break
+                victim = min(leaves, key=lambda n: n.last_use)
+                parent = victim.parent
+                del parent.children[victim.tokens[:self.pt]]
+                for pid in victim.pages:
+                    self.pool.release(pid)
+                freed += len(victim.pages)
+                self.stats["nodes"] -= 1
+                self.stats["cached_pages"] -= len(victim.pages)
+                self.stats["evicted_nodes"] += 1
+                self.stats["evicted_pages"] += len(victim.pages)
+                debug_verbose(3, "kv", "evicted radix node (%d pages)",
+                              len(victim.pages))
+        return freed
+
+    def _reclaim_for_pool(self, n_pages: int) -> int:
+        """Pool-pressure callback: evicted pages whose ONLY reference
+        was the tree go straight back to the free list."""
+        return self.evict(n_pages)
+
+    def node_count(self) -> int:
+        with self._lock:
+            return self.stats["nodes"]
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return dict(self.stats)
+
+
+# ---------------------------------------------------------------------------
+# the per-context layer
+# ---------------------------------------------------------------------------
+
+class KVStateLayer:
+    """The shared KV state plane of one serving context: page pool +
+    radix prefix tree + the paged collection, attached as
+    ``context.kv_state`` so statusz and the scrape-time metrics
+    collectors (``parsec_kv_pages_in_use`` / ``parsec_kv_hit_rate``)
+    can read it with zero hot-path cost.
+
+    One layer per context, shared across every tenant's
+    :class:`~.decode.DecodeEngine` — cross-tenant sharing of identical
+    prefixes is the point (pages are immutable and content-addressed by
+    token prefix; no tenant data crosses: only a request that presents
+    the SAME tokens reads a cached page)."""
+
+    def __init__(self, ctx, d_model: int, page_tokens: Optional[int] = None,
+                 capacity: Optional[int] = None,
+                 share: Optional[bool] = None):
+        self.ctx = ctx
+        self.page_tokens = int(
+            page_tokens if page_tokens is not None else
+            mca_param.get("serving.kv_page_tokens", 16))
+        cap = int(capacity if capacity is not None else
+                  mca_param.get("serving.kv_pages", 0))
+        self.share = bool(
+            share if share is not None else
+            str(mca_param.get("serving.kv_prefix_cache", 1)).lower()
+            not in ("0", "off", "false"))
+        self.pool = KVPagePool(f"kv{id(self) & 0xffff:x}",
+                               self.page_tokens, d_model,
+                               capacity=cap,
+                               hbm=getattr(ctx, "hbm", None))
+        self.tree = RadixTree(self.pool)
+        self.dc = self.pool.dc
+        self._lock = threading.Lock()
+        self.stats = {"requests": 0, "requests_hit": 0,
+                      "tokens_looked_up": 0, "tokens_hit": 0,
+                      "tokens_prefilled": 0,
+                      "spec_windows": 0, "spec_accepted_steps": 0,
+                      "spec_rejected_windows": 0,
+                      "spec_cancelled_branches": 0}
+        if ctx is not None:
+            ctx.kv_state = self
+
+    # ------------------------------------------------------------ lookup
+    def match(self, tokens: Sequence[int]) -> MatchHandle:
+        """Prefix-cache lookup with hit accounting. With sharing off
+        (``serving.kv_prefix_cache=0`` — the A/B baseline) this is a
+        guaranteed miss at zero tree cost."""
+        if not self.share:
+            h = MatchHandle(self.tree, [], 0, [])
+        else:
+            h = self.tree.match(tokens)
+        with self._lock:
+            self.stats["requests"] += 1
+            self.stats["tokens_looked_up"] += len(tokens)
+            self.stats["tokens_hit"] += h.n_tokens
+            if h.n_tokens:
+                self.stats["requests_hit"] += 1
+        return h
+
+    def publish(self, tokens: Sequence[int], pids: Sequence[int]) -> int:
+        if not self.share or not pids:
+            return 0
+        return self.tree.insert(tokens, pids)
+
+    def note_prefilled(self, n_tokens: int) -> None:
+        with self._lock:
+            self.stats["tokens_prefilled"] += n_tokens
+
+    def note_spec(self, windows: int = 0, accepted: int = 0,
+                  rejected: int = 0, cancelled: int = 0) -> None:
+        with self._lock:
+            self.stats["spec_windows"] += windows
+            self.stats["spec_accepted_steps"] += accepted
+            self.stats["spec_rejected_windows"] += rejected
+            self.stats["spec_cancelled_branches"] += cancelled
+
+    def hit_rate(self) -> float:
+        with self._lock:
+            lk = self.stats["tokens_looked_up"]
+            return (self.stats["tokens_hit"] / lk) if lk else 0.0
+
+    # ----------------------------------------------------- observability
+    def snapshot(self) -> Dict:
+        """The statusz/metrics block — scrape-time only, no hot-path
+        accounting beyond the counters already kept."""
+        with self._lock:
+            stats = dict(self.stats)
+        return {"page_tokens": self.page_tokens,
+                "share": self.share,
+                "hit_rate": round(self.hit_rate(), 6),
+                "pool": self.pool.snapshot(),
+                "tree": self.tree.snapshot(),
+                **stats}
+
+
+def layer_for(ctx, d_model: int, **kw) -> KVStateLayer:
+    """Get-or-create the context's KV state layer (idempotent;
+    parameters apply at creation)."""
+    layer = getattr(ctx, "kv_state", None)
+    if layer is None:
+        layer = KVStateLayer(ctx, d_model, **kw)
+    return layer

@@ -1,0 +1,424 @@
+"""Shared-prefix KV-layer benchmark (``bench.py --section serving_kv``).
+
+ISSUE 15's acceptance surface — the "millions of users" workload shape:
+an open-loop trace of ``n_tenants`` tenants whose prompts share a
+global SYSTEM prompt plus a per-tenant few-shot template, differing
+only in a short per-request suffix (the production distribution both
+PagedAttention and RadixAttention report: long shared head, short
+unique tail).
+
+Two arms at the SAME page budget (``serving.kv_pages``), same trace,
+same context shape:
+
+- **noshare** — ``serving.kv_prefix_cache=0``: every request chunk-
+  prefills its whole prompt into its own pages (paged allocation still
+  on — this is the no-SHARING baseline, not the no-paging one).
+- **share** — the radix prefix cache on: after a prefix is first
+  prefilled, later requests match it and prefill only the suffix.
+
+Arrivals are open-loop with bounded retry on ``AdmissionRejected``
+(page-budget exhaustion = explicit backpressure, not a crash). Two
+load shapes: a BURST phase (whole trace offered at once) whose
+sustained completed req/s per arm gives ``speedup_vs_nosharing``
+(target ≥ 3×), and an ISO-LOAD phase (both arms paced at 75% of the
+no-sharing arm's measured capacity) where "fixed p99" is checked —
+the share arm's p99 at identical offered load must not exceed the
+no-sharing arm's. Every completed request of every phase is checked
+bitwise against the no-sharing float32 reference replay
+(:func:`~.decode.reference_decode_paged`) — sharing must be invisible
+to results.
+
+A third phase exercises SPECULATIVE decode (short prompts so the
+sliding-window draft model is exact early — acceptances — then
+deterministically diverges — rejection + branch cancellation), A/B'd
+against the same trace with speculation off for a latency ratio.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List
+
+from ..utils.stats import pctl as _pctl
+
+_PAGE_TOKENS = 16
+_SYS_PAGES = 56            # global system prompt: 896 tokens
+_TENANT_PAGES = 4          # per-tenant few-shot template: 64 tokens
+_UNIQUE_TOKENS = 16        # per-request unique suffix: 1 page
+_DECODE_STEPS = 4
+_PREFILL_CHUNK = 1         # pages per chunked-prefill task
+_DECODE_WINDOW = 4         # multi-step decode scheduling, BOTH arms
+_PAGE_BUDGET = 3000        # pages — identical in BOTH arms
+
+
+def _sys_tokens() -> tuple:
+    return tuple(10_000 + i for i in range(_SYS_PAGES * _PAGE_TOKENS))
+
+
+def _tenant_tokens(ti: int) -> tuple:
+    return tuple(20_000 + ti * 1_000 + i
+                 for i in range(_TENANT_PAGES * _PAGE_TOKENS))
+
+
+def _request_tokens(ti: int, ri: int) -> tuple:
+    uniq = tuple(40_000 + ti * 10_000 + ri * 100 + i
+                 for i in range(_UNIQUE_TOKENS))
+    return _sys_tokens() + _tenant_tokens(ti) + uniq
+
+
+def _run_arm(share: bool, n_tenants: int, reqs_per_tenant: int,
+             spec_draft: int = 0, prompt_fn=None, n_steps: int =
+             _DECODE_STEPS, submit_threads: int = 4,
+             rate_per_sec: float = 0.0,
+             decode_window: int = _DECODE_WINDOW) -> Dict:
+    """One arm: fresh context + KV layer, submit the whole trace
+    open-loop (bounded retry on admission rejection), drain, verify
+    bitwise, report sustained rates."""
+    import parsec_tpu as parsec
+    from .. import serving as srv
+    from ..serving.decode import DecodeConfig, DecodeEngine
+    from ..serving.kv import KVStateLayer
+    from ..utils import mca_param
+
+    mca_param.set("sched", "wfq")
+    mca_param.set("serving.kv_prefill_chunk", _PREFILL_CHUNK)
+    mca_param.set("serving.kv_decode_window", decode_window)
+    if spec_draft:
+        mca_param.set("serving.kv_spec_draft", spec_draft)
+    ctx = parsec.init(nb_cores=4)
+    prompt_fn = prompt_fn or _request_tokens
+    try:
+        srv.enable(ctx)
+        ctx.start()
+        cfg = DecodeConfig()
+        layer = KVStateLayer(ctx, cfg.d_model,
+                             page_tokens=_PAGE_TOKENS,
+                             capacity=_PAGE_BUDGET, share=share)
+        engines = [DecodeEngine(ctx, f"kt{ti}", cfg=cfg,
+                                tenant=f"kt{ti}", kv_layer=layer).start()
+                   for ti in range(n_tenants)]
+
+        reqs: List = []
+        reqs_lock = threading.Lock()
+        retries = [0]
+
+        def submit_one(ti: int, rid: int, toks, steps: int,
+                       record: bool = True) -> None:
+            # a rejected submission retries with a short backoff (the
+            # page budget IS the admission signal) instead of being
+            # silently dropped from the offered load
+            arrival = time.monotonic()
+            deadline = arrival + 120.0
+            while True:
+                try:
+                    r = engines[ti].request(rid, steps, tokens=toks)
+                    # latency clocks from ARRIVAL, not admission: the
+                    # noshare arm queues in this retry loop, the share
+                    # arm queues in-engine — p99 must charge both the
+                    # same way or the budget-constrained arm's queueing
+                    # would be invisible
+                    r.submitted_t = arrival
+                    if record:
+                        with reqs_lock:
+                            reqs.append((ti, r))
+                    return
+                except srv.AdmissionRejected:
+                    if record:
+                        with reqs_lock:
+                            retries[0] += 1
+                    if time.monotonic() > deadline:
+                        return
+                    time.sleep(0.005)
+
+        # warm phase (excluded from the measurement): one request per
+        # tenant populates the prefix cache — the measured window is
+        # the STEADY-STATE of a long-running service (sessions arriving
+        # against an established cache), identical in both arms so the
+        # noshare baseline pays the same warmup (incl. page-budget
+        # backpressure: warming 100 unshared 46-page prompts does not
+        # fit 3000 pages at once)
+        for ti in range(n_tenants):
+            submit_one(ti, ti * 1_000 + 999, prompt_fn(ti, 999), 1,
+                       record=False)
+            if ti % 25 == 24:
+                for eng in engines:
+                    eng.drain(timeout=120.0)
+        for eng in engines:
+            eng.drain(timeout=120.0)
+        warm_hit = layer.stats["tokens_hit"]
+        warm_lk = layer.stats["tokens_looked_up"]
+
+        def submit_range(tis) -> None:
+            # open-loop per submitter: sweep rounds over its tenants.
+            # With ``rate_per_sec`` the sweep is PACED (each submitter
+            # carries its share of the global arrival rate, a late
+            # server never slows arrivals) — the iso-load latency
+            # phase; 0 = burst (the capacity phase).
+            interval = (len(shards) / rate_per_sec
+                        if rate_per_sec else 0.0)
+            next_t = time.monotonic()
+            for ri in range(reqs_per_tenant):
+                for ti in tis:
+                    if interval:
+                        delay = next_t - time.monotonic()
+                        if delay > 0:
+                            time.sleep(delay)
+                        next_t += interval
+                    submit_one(ti, ti * 1_000 + ri, prompt_fn(ti, ri),
+                               n_steps)
+
+        # completion-driven release (the elastic bench's completer
+        # shape): a finished request's pages go back to the pool AS IT
+        # COMPLETES — under a saturated page budget the submitters'
+        # admission retries are fed by these releases; releasing only
+        # at end-of-run would deadlock the open loop against the
+        # budget. ``req.result``/``latency_s`` survive release for the
+        # bitwise check below.
+        finished: List = []
+        stop = threading.Event()
+
+        def completer() -> None:
+            while True:
+                moved = 0
+                for ti, eng in enumerate(engines):
+                    with eng._lock:
+                        done = [r for r in eng.pending.values()
+                                if r.done_evt.is_set()]
+                    for r in done:
+                        eng.release(r)
+                        finished.append((ti, r))
+                        moved += 1
+                if not moved:
+                    if stop.is_set():
+                        return
+                    time.sleep(0.003)
+
+        t0 = time.monotonic()
+        ct = threading.Thread(target=completer, daemon=True)
+        ct.start()
+        shards = [list(range(ti, n_tenants, submit_threads))
+                  for ti in range(submit_threads)]
+        threads = [threading.Thread(target=submit_range, args=(s,),
+                                    daemon=True) for s in shards if s]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300.0)
+        deadline = time.monotonic() + 120.0
+        while any(eng.pending for eng in engines) and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)            # completer empties pending
+        stop.set()
+        ct.join(timeout=10.0)
+        t_total = time.monotonic() - t0
+
+        bad = sum(1 for ti, r in finished if not engines[ti].verify(r))
+        lats = sorted(r.latency_s() * 1e3 for _ti, r in finished
+                      if r.latency_s() is not None)
+        n = len(finished)
+        snap = layer.snapshot()
+        pool_snap = snap["pool"]
+        prompt_tokens = sum(len(r.tokens) for _ti, r in finished)
+        out = {
+            "share": share,
+            "requests": n,
+            "offered": n_tenants * reqs_per_tenant,
+            "admission_retries": retries[0],
+            "wall_s": round(t_total, 3),
+            "requests_per_sec": round(n / t_total, 2) if t_total else 0,
+            # EFFECTIVE prompt ingest rate: tokens of completed
+            # requests' prompts per second (cached or computed — the
+            # user-visible prefill bandwidth)
+            "prefill_tokens_per_sec":
+                round(prompt_tokens / t_total, 1) if t_total else 0,
+            "prefill_tokens_computed": snap["tokens_prefilled"],
+            "p50_ms": round(_pctl(lats, 0.50), 2) if lats else None,
+            "p99_ms": round(_pctl(lats, 0.99), 2) if lats else None,
+            "bitwise": "OK" if (bad == 0 and n > 0) else "FAIL",
+            "bitwise_bad": bad,
+            # hit rate over the MEASURED window only (warmup excluded)
+            "kv_hit_rate": round(
+                (layer.stats["tokens_hit"] - warm_hit)
+                / max(1, layer.stats["tokens_looked_up"] - warm_lk), 4),
+            "pages_in_use_peak": pool_snap["peak_in_use"],
+            "pages_budget": pool_snap["capacity"],
+            "pool_exhausted_events": pool_snap["exhausted"],
+            "cow_copies": pool_snap["cow_copies"],
+            "evict_reclaims": pool_snap["evict_reclaims"],
+            "spec": {k: snap[k] for k in
+                     ("spec_windows", "spec_accepted_steps",
+                      "spec_rejected_windows",
+                      "spec_cancelled_branches")},
+        }
+        for eng in engines:
+            eng.close()
+        out["pages_in_use_final"] = layer.pool.pages_in_use()
+        out["pages_cached_final"] = layer.tree.snapshot()["cached_pages"]
+        return out
+    finally:
+        for knob in ("sched", "serving.kv_prefill_chunk",
+                     "serving.kv_decode_window", "serving.kv_spec_draft"):
+            mca_param.unset(knob)
+        parsec.fini(ctx)
+
+
+def _spec_phase(n_tenants: int = 8, reqs_per_tenant: int = 2) -> Dict:
+    """Speculative-decode A/B on a short-prompt trace: one page of
+    prompt keeps early contexts inside the draft's sliding window
+    (exact ⇒ accepted), 24 steps pushes past it (diverges ⇒ branch
+    cancelled) — both paths exercised, results bitwise either way."""
+
+    def prompts(ti: int, ri: int) -> tuple:
+        return tuple(60_000 + ti * 100 + ri * 7 + i
+                     for i in range(_PAGE_TOKENS))
+
+    # window=1 in BOTH arms: the classic speculative-decode A/B is
+    # draft+batched-verify vs the plain per-step chain (the multi-step
+    # window row is measured separately by the capacity arms)
+    base = _run_arm(True, n_tenants, reqs_per_tenant, spec_draft=0,
+                    prompt_fn=prompts, n_steps=24, submit_threads=2,
+                    decode_window=1)
+    spec = _run_arm(True, n_tenants, reqs_per_tenant, spec_draft=6,
+                    prompt_fn=prompts, n_steps=24, submit_threads=2,
+                    decode_window=1)
+    ratio = (round(base["p50_ms"] / spec["p50_ms"], 3)
+             if base.get("p50_ms") and spec.get("p50_ms") else None)
+    return {
+        "baseline_p50_ms": base.get("p50_ms"),
+        "spec_p50_ms": spec.get("p50_ms"),
+        "spec_latency_speedup": ratio,
+        "bitwise": "OK" if (base["bitwise"] == "OK"
+                            and spec["bitwise"] == "OK") else "FAIL",
+        **spec["spec"],
+        "draft_pages_released": spec["pages_in_use_final"]
+        == spec["pages_cached_final"],
+    }
+
+
+def _measure_child(q, n_tenants: int, reqs_per_tenant: int) -> None:
+    """Spawn-child entry: the measurement in a fresh process whose BLAS
+    pools were pinned to ONE thread by the parent's env (read at
+    library load — see :func:`measure_serving_kv_pinned`). The GIL
+    switch interval is pinned low too (both arms): decode bodies are
+    dozens of tiny GIL-dropping numpy calls, and the default 5 ms
+    interval turns every re-acquire into a convoy stall — the same
+    class of cost PR 3/PR 10 batched completions to avoid."""
+    try:
+        import sys
+        sys.setswitchinterval(0.0002)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        q.put(("ok", measure_serving_kv(n_tenants, reqs_per_tenant)))
+    except BaseException as exc:  # noqa: BLE001 — report to parent
+        import traceback
+        q.put(("error", f"{exc}\n{traceback.format_exc()}"))
+
+
+def measure_serving_kv_pinned(n_tenants: int = 100,
+                              reqs_per_tenant: int = 4) -> Dict:
+    """Run :func:`measure_serving_kv` in a spawn child with BLAS thread
+    pools pinned to 1 (OPENBLAS/OMP/MKL env, read at import time).
+    Unpinned, each of the 4 workers' tiny-matrix numpy calls opens a
+    multi-thread BLAS parallel region — 16+ spinning threads inflate a
+    0.1 ms decode body ~100x and the measurement stops being about the
+    runtime at all."""
+    import multiprocessing as mp
+    import os
+    pins = {"OPENBLAS_NUM_THREADS": "1", "OMP_NUM_THREADS": "1",
+            "MKL_NUM_THREADS": "1"}
+    old = {k: os.environ.get(k) for k in pins}
+    os.environ.update(pins)
+    try:
+        mpctx = mp.get_context("spawn")
+        q = mpctx.Queue()
+        p = mpctx.Process(target=_measure_child,
+                          args=(q, n_tenants, reqs_per_tenant))
+        p.start()
+        try:
+            status, payload = q.get(timeout=1800)
+        finally:
+            p.join(timeout=30.0)
+            if p.is_alive():
+                p.terminate()
+        if status != "ok":
+            raise RuntimeError(f"serving_kv child failed: {payload}")
+        return payload
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def measure_serving_kv(n_tenants: int = 100,
+                       reqs_per_tenant: int = 4) -> Dict:
+    """The full ``--section serving_kv`` measurement (see module doc).
+
+    Two load shapes per the acceptance criterion ("≥3× sustained req/s
+    ... at fixed p99"):
+
+    - **capacity** (burst): the whole trace offered open-loop with
+      bounded admission retry; sustained completed req/s per arm —
+      ``speedup_vs_nosharing`` is their ratio. Cross-arm p99 is NOT
+      comparable here (the budget-constrained arm's queueing hides in
+      admission backoff).
+    - **iso-load** (paced): both arms at the SAME offered rate (75% of
+      the no-sharing arm's measured capacity — both sustain it);
+      "fixed p99" = the share arm's p99 must not exceed the no-sharing
+      arm's at identical load.
+    """
+    noshare = _run_arm(False, n_tenants, reqs_per_tenant)
+    share = _run_arm(True, n_tenants, reqs_per_tenant)
+    iso_rate = max(2.0, 0.75 * noshare["requests_per_sec"])
+    iso_n = _run_arm(False, n_tenants, 2, rate_per_sec=iso_rate)
+    iso_s = _run_arm(True, n_tenants, 2, rate_per_sec=iso_rate)
+    spec = _spec_phase()
+
+    speedup = (round(share["requests_per_sec"]
+                     / noshare["requests_per_sec"], 3)
+               if noshare["requests_per_sec"] else None)
+    p99_ok = (isinstance(iso_s.get("p99_ms"), (int, float)) and
+              isinstance(iso_n.get("p99_ms"), (int, float)) and
+              iso_s["p99_ms"] <= iso_n["p99_ms"])
+    accept = (speedup is not None and speedup >= 3.0
+              and share["kv_hit_rate"] > 0
+              and share["bitwise"] == "OK"
+              and noshare["bitwise"] == "OK"
+              and iso_s["bitwise"] == "OK"
+              and iso_n["bitwise"] == "OK"
+              and spec["bitwise"] == "OK"
+              and p99_ok)
+    return {
+        "n_tenants": n_tenants,
+        "reqs_per_tenant": reqs_per_tenant,
+        "page_tokens": _PAGE_TOKENS,
+        "prompt_tokens": (_SYS_PAGES + _TENANT_PAGES) * _PAGE_TOKENS
+        + _UNIQUE_TOKENS,
+        "decode_steps": _DECODE_STEPS,
+        "pages_budget": _PAGE_BUDGET,
+        "requests_per_sec": share["requests_per_sec"],
+        "requests_per_sec_nosharing": noshare["requests_per_sec"],
+        "speedup_vs_nosharing": speedup,
+        "kv_hit_rate": share["kv_hit_rate"],
+        "prefill_tokens_per_sec": share["prefill_tokens_per_sec"],
+        # the guarded p99 row: the share arm at the iso-load rate (a
+        # stable sub-saturation point; burst p99 is backlog-shaped)
+        "p99_ms": iso_s.get("p99_ms"),
+        "p99_ms_nosharing_iso": iso_n.get("p99_ms"),
+        "iso_rate_per_sec": round(iso_rate, 2),
+        "p99_fixed_ok": p99_ok,
+        "bitwise": "OK" if (share["bitwise"] == "OK"
+                            and noshare["bitwise"] == "OK"
+                            and iso_s["bitwise"] == "OK"
+                            and iso_n["bitwise"] == "OK") else "FAIL",
+        "share": share,
+        "noshare": noshare,
+        "iso_share": iso_s,
+        "iso_noshare": iso_n,
+        "spec": spec,
+        "spec_accepted_steps": spec.get("spec_accepted_steps"),
+        "spec_cancelled_branches": spec.get("spec_cancelled_branches"),
+        "acceptance": "OK" if accept else "FAIL",
+    }

@@ -664,6 +664,9 @@ class ServingRuntime:
             out["pools"] = sched.pool_stats()
         if self.elastic is not None:
             out["elastic"] = self.elastic.status()
+        kvl = getattr(self.ctx, "kv_state", None)
+        if kvl is not None:
+            out["kv"] = kvl.snapshot()
         return out
 
 

@@ -1,0 +1,429 @@
+"""Speculative decode as a DTD pattern (ISSUE 15, tentpole part 3).
+
+Classic speculative decoding runs a CHEAP draft model ahead of the true
+model and verifies a whole window of draft tokens in one true-model
+forward pass; accepted positions are provably identical to what the
+true model would have produced, and a rejected draft branch is thrown
+away. This module maps that onto the task-dataflow runtime:
+
+- **Draft branch** = its own cancellable taskpool per request (the
+  cancellation unit ``Taskpool.cancel`` gives us: queued tasks dropped
+  at select time, in-flight ones drain). The draft model here is the
+  TRUE weights with SLIDING-WINDOW attention (last ``window`` rows
+  only) — genuinely cheaper on long contexts, and EXACT while the
+  context still fits the window (early drafts accept bitwise; once the
+  context outgrows the window, drafts diverge and the branch loses —
+  both acceptance and rejection paths are exercised deterministically
+  by context length). Draft steps append their (k, v) rows into
+  COPY-ON-WRITE pages (:meth:`~.kv.KVPagePool.cow` of the request's
+  tail page — the divergence-point copy, the second writer the COW
+  design exists for), so the main chain's pages are never touched by
+  speculation.
+- **Verify tasks** in the MAIN pool replace the per-step decode tasks:
+  one verify task replays a whole window of ``serving.kv_spec_draft``
+  true steps through the EXACT :func:`~.decode._step_kernel` sequence
+  (results are bitwise the non-speculative chain's by construction —
+  speculation is invisible to results), compares each true state
+  against the draft branch's state for that position (read at
+  execution time; a draft that has not produced the position yet
+  counts as rejected — acceptance is dynamic, correctness is not),
+  and on the first mismatch CANCELS the losing branch.
+- **Rejected-branch pages are released back to the pool** once the
+  cancelled branch drained (release waits for the branch pool's
+  completion event so an in-flight draft's write-back can never race a
+  reallocated page).
+
+What speculation buys on THIS runtime: the host-side per-task overhead
+dominates a decode step (the bodies are tiny), so folding ``L`` steps
+into one verify task cuts the main pool's per-request task count by
+``L``× while the draft chain rides a low-weight pool under wfq — the
+same economics as verifying L tokens in one forward pass on real
+hardware.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..data.collection import LocalCollection
+from ..dsl import dtd
+from ..utils import mca_param
+from ..utils.debug import debug_verbose
+
+mca_param.register("serving.kv_spec_window", 0,
+                   help="sliding-attention window (rows) of the "
+                        "speculative draft model; 0 = 2 pages worth")
+mca_param.register("serving.kv_spec_weight", 0.25,
+                   help="fair-share weight of a request's speculative "
+                        "draft branch pool relative to weight 1.0")
+mca_param.register("serving.kv_spec_patience_ms", 5.0,
+                   help="how long a verify window waits for the draft "
+                        "branch's proposed state per position before "
+                        "scoring it rejected — verification CONSUMES "
+                        "the draft's proposal (real spec decode waits "
+                        "for draft tokens by construction), but the "
+                        "wait runs on a worker thread so it stays "
+                        "SHORT: a slow branch degrades to rejection, "
+                        "never to a stalled runtime; a lost or "
+                        "cancelled branch is never waited for")
+
+
+def _sliding_step(x, prevs, tail, slot, window, model):
+    """Draft-model decode step: identical to
+    :func:`~.decode._step_kernel` except attention only sees the LAST
+    ``window`` cached rows. While the context fits the window this is
+    bitwise the true step (same arrays, same op order after the
+    no-op slice); beyond it, the draft diverges — by design."""
+    from .decode import _attend
+    k = x @ model.Wk
+    v = x @ model.Wv
+    tail = tail.copy()
+    tail[0, slot] = k
+    tail[1, slot] = v
+    if prevs:
+        K = np.concatenate([p[0] for p in prevs] + [tail[0, :slot + 1]],
+                           axis=0)
+        V = np.concatenate([p[1] for p in prevs] + [tail[1, :slot + 1]],
+                           axis=0)
+    else:
+        K = tail[0, :slot + 1]
+        V = tail[1, :slot + 1]
+    return _attend(x, K[-window:], V[-window:], model), tail
+
+
+def _draft_window_body(*vals):
+    """One draft-chain WINDOW in the branch pool (INOUT draft state
+    tile, INOUT the window's COW/draft pages, INPUT prior pages):
+    ``steps`` sliding-window draft steps in one task body — the draft
+    chain advances a whole window per scheduler pass, so it keeps pace
+    with the (equally windowed) verify chain. Each position's proposed
+    state is published into the side-channel collection AS COMPUTED
+    (atomic tile replace; the verify reader tolerates absence)."""
+    meta = vals[-1]
+    n_rw = meta["n_rw"]
+    x = vals[0]
+    rw = [v.copy() for v in vals[1:1 + n_rw]]
+    dc_read = meta["dc_read"]
+    ro = [dc_read((pid,)) for pid in meta["prev_pids"]]
+    pages = ro + rw
+    pt, model = meta["pt"], meta["model"]
+    ddc = meta["ddc"]
+    j_base = len(ro)
+    for i in range(meta["steps"]):
+        t = meta["t0"] + i
+        j, slot = divmod(t, pt)
+        x, new_tail = _sliding_step(x, pages[:j], pages[j], slot,
+                                    meta["window"], model)
+        pages[j] = new_tail
+        rw[j - j_base] = new_tail
+        ddc.write_tile((meta["req"], t), x)
+    return (x, *rw)
+
+
+def verify_exec(vals, meta):
+    """Body of one verify window (dispatched from
+    :func:`~.decode._paged_body`): replay ``steps`` TRUE decode steps
+    in one task — the exact per-step kernel sequence of the
+    non-speculative chain — and score the draft branch's states
+    against them. ``vals`` = (state, *window INOUT pages, *prior INPUT
+    pages, meta)."""
+    from .decode import PoisonBody, _step_kernel
+    n_rw = meta["n_rw"]
+    x = vals[0]
+    rw = [v.copy() for v in vals[1:1 + n_rw]]
+    dc_read = meta["dc_read"]
+    ro = [dc_read((pid,)) for pid in meta["prev_pids"]]
+    pages = ro + rw               # absolute page order 0..j1
+    pt, model = meta["pt"], meta["model"]
+    t0, steps = meta["t0"], meta["steps"]
+    j0 = len(ro)
+    draft_read = meta["draft_read"]
+    accepted, matched = 0, True
+    for i in range(steps):
+        t = t0 + i
+        if meta.get("poison_at") is not None and t == meta["poison_at"]:
+            raise PoisonBody(
+                f"poison body: request {meta['req']} step {t}")
+        j, slot = divmod(t, pt)
+        x, new_tail = _step_kernel(x, pages[:j], pages[j], slot, model)
+        pages[j] = new_tail
+        rw[j - j0] = new_tail
+        if matched:
+            d = draft_read((meta["req"], t))
+            if d is not None and d.shape == x.shape and \
+                    np.array_equal(d, x):
+                accepted += 1
+            else:
+                matched = False
+    meta["on_verify"](meta["widx"], accepted, steps)
+    return (x, *rw)
+
+
+class SpecController:
+    """Per-request speculative-decode coordinator: builds the verify
+    windows for the main batch, launches the draft branch once the
+    prefill state is final, cancels the branch on the first rejected
+    window, and releases the branch's COW pages when the request is
+    released."""
+
+    def __init__(self, engine, req, draft_len: int):
+        self.engine = engine
+        self.req = req
+        self.layer = engine.kv_layer
+        self.draft_len = max(1, int(draft_len))
+        w = int(mca_param.get("serving.kv_spec_window", 0))
+        self.window = w if w > 0 else 2 * self.layer.page_tokens
+        self._lock = threading.Lock()
+        self._cancelled = False
+        self._released = False
+        self.branch_tp = None
+        self.branch_sub = None
+        self.draft_pids: List[int] = []
+        # draft states keyed (rid, t) — read by verify bodies at
+        # execution time (acceptance is dynamic; never correctness)
+        self.draft_dc = LocalCollection(
+            f"{engine.name}_draft{req.rid}",
+            myrank=getattr(engine.ctx, "my_rank", 0))
+        self.accepted_steps = 0
+        self.rejected = False
+
+    # ------------------------------------------------- main-pool windows
+    def verify_rows(self, poison_at: Optional[int]
+                    ) -> Tuple[List[list], List[int]]:
+        """The request's decode rows as verify windows (for the one
+        all-or-nothing ``insert_tasks`` batch)."""
+        eng, req = self.engine, self.req
+        pt = self.layer.page_tokens
+        dc = self.layer.dc
+        S = len(req.tokens)
+        rows, prios = [], []
+        widx = 0
+        t = S
+        end = S + req.n_steps
+        while t < end:
+            steps = min(self.draft_len, end - t)
+            j0, j1 = t // pt, (t + steps - 1) // pt
+            args = [dtd.TileArg(eng.state, (req.rid,), dtd.INOUT)]
+            args += [dtd.TileArg(dc, (req.pages[j],), dtd.INOUT)
+                     for j in range(j0, j1 + 1)]
+            args.append(dtd.ValueArg({
+                "kind": "verify", "req": req.rid, "t0": t,
+                "steps": steps, "pt": pt, "model": eng.model,
+                "n_rw": j1 - j0 + 1, "widx": widx,
+                "poison_at": poison_at,
+                "prev_pids": tuple(req.pages[:j0]),
+                "dc_read": dc.data_of,
+                "draft_read": self._draft_read,
+                "on_verify": self._on_verify}))
+            rows.append(args)
+            prios.append(0)
+            widx += 1
+            t += steps
+        return rows, prios
+
+    # ----------------------------------------------------- draft branch
+    def start_branch(self) -> None:
+        """Launch the draft branch once the prefill state is final.
+
+        The draft chain's INPUT deps on the main pool's pages are
+        INSERT-time snapshots (cross-pool reads are untracked), so the
+        branch may only be inserted after the prompt pages and the
+        prefill state were written back — a tiny bounded watcher
+        thread (off every hot path) inserts it at that point."""
+        threading.Thread(target=self._launch_when_ready,
+                         daemon=True).start()
+
+    def _launch_when_ready(self) -> None:
+        """Wait (bounded) for the request's prefill-state write-back —
+        detected by OBJECT IDENTITY against the placeholder the engine
+        wrote at request time (the runtime's write-back replaces the
+        tile object) — then insert the draft chain. A cancelled or
+        failed pool simply never launches a branch."""
+        eng, req = self.engine, self.req
+        import time as _time
+        deadline = _time.monotonic() + 30.0
+        placeholder = getattr(req, "_spec_x0_ph", None)
+        x0 = None
+        while _time.monotonic() < deadline:
+            with self._lock:
+                if self._cancelled or self._released:
+                    return
+            tp = eng.tp
+            if tp is None or tp.cancelled or tp.error is not None:
+                return
+            x0 = eng.state.data_of((req.rid,))
+            if x0 is not None and x0 is not placeholder:
+                break
+            if req.done_evt.wait(0.002):
+                return                # request finished before we drafted
+            x0 = None
+        if x0 is None:
+            return
+        try:
+            self._insert_branch(np.asarray(x0))
+        except Exception as exc:  # noqa: BLE001 — speculation is optional
+            debug_verbose(2, "spec", "draft branch of rid %d not "
+                          "launched: %s", req.rid, exc)
+
+    def _insert_branch(self, x0: np.ndarray) -> None:
+        from .kv import KVPagesExhausted
+        eng, req = self.engine, self.req
+        layer, pt = self.layer, self.layer.page_tokens
+        S = len(req.tokens)
+        end = S + req.n_steps
+        pool = layer.pool
+        # page plan for the draft chain: COW the request's current
+        # tail page (the divergence point — the true chain will write
+        # the same slots), fresh pages for every later boundary
+        j_first = S // pt
+        n_draft_pages = (end + pt - 1) // pt - j_first
+        try:
+            first = pool.cow(req.pages[j_first])
+            extra = pool.alloc(max(0, n_draft_pages - 1))
+        except (KVPagesExhausted, KeyError) as exc:
+            debug_verbose(2, "spec", "no pages for draft branch of "
+                          "rid %d: %s", req.rid, exc)
+            return
+        dpids = [first] + extra
+        tp = dtd.Taskpool(f"{eng.name}_spec{req.rid}")
+        sub = None
+        ctx = eng.ctx
+        weight = float(mca_param.get("serving.kv_spec_weight", 0.25))
+        try:
+            if getattr(ctx, "serving", None) is not None and \
+                    eng.submission is not None:
+                sub = ctx.submit(tp, tenant=eng.tenant, weight=weight)
+            else:
+                ctx.add_taskpool(tp)
+        except Exception:
+            for pid in dpids:
+                pool.release(pid)
+            raise
+        with self._lock:
+            if self._cancelled or self._released:
+                tp.cancel()
+                for pid in dpids:
+                    pool.release(pid)
+                return
+            self.branch_tp = tp
+            self.branch_sub = sub
+            self.draft_pids = dpids
+        dc = layer.dc
+        ddc = self.draft_dc
+        ddc.write_tile(("s",), x0)
+        rows = []
+        t = S
+        while t < end:
+            steps = min(self.draft_len, end - t)
+            j0, j1 = t // pt, (t + steps - 1) // pt
+            args = [dtd.TileArg(ddc, ("s",), dtd.INOUT)]
+            args += [dtd.TileArg(dc, (dpids[j - j_first],), dtd.INOUT)
+                     for j in range(j0, j1 + 1)]
+            # prior pages by pid: the request's immutable prefix
+            # (final at launch time) then the draft's own earlier
+            # pages (ordered by the ddc INOUT chain)
+            prev_pids = tuple(req.pages[:j_first]) + \
+                tuple(dpids[jj - j_first] for jj in range(j_first, j0))
+            args.append(dtd.ValueArg({
+                "t0": t, "steps": steps, "pt": pt,
+                "n_rw": j1 - j0 + 1, "window": self.window,
+                "model": eng.model, "req": req.rid, "ddc": ddc,
+                "prev_pids": prev_pids, "dc_read": dc.data_of}))
+            rows.append(args)
+            t += steps
+        try:
+            tp.insert_tasks(_draft_window_body, rows)
+        except Exception as exc:  # noqa: BLE001 — speculation optional
+            debug_verbose(2, "spec", "draft insert of rid %d failed: "
+                          "%s", req.rid, exc)
+            self.cancel_branch(count=False)
+
+    # ------------------------------------------------------ verification
+    def _draft_read(self, key):
+        """The verify window's view of the draft branch: the proposed
+        state for ``key = (rid, t)``, waited for with BOUNDED patience
+        (``serving.kv_spec_patience_ms``) — verification consumes the
+        draft's proposal, so it grants the branch a grace window; a
+        branch that already lost (cancelled/rejected) or a request past
+        its drafts is never waited for."""
+        import time as _time
+        v = self.draft_dc.data_of(key)
+        if v is not None:
+            return v
+        patience = float(mca_param.get("serving.kv_spec_patience_ms",
+                                       5.0)) / 1e3
+        deadline = _time.monotonic() + patience
+        while _time.monotonic() < deadline:
+            with self._lock:
+                if self._cancelled or self._released:
+                    return None
+            if self.rejected:
+                return None
+            tp = self.branch_tp
+            if tp is not None and (tp.cancelled or tp.error is not None):
+                return None
+            v = self.draft_dc.data_of(key)
+            if v is not None:
+                return v
+            _time.sleep(0.0005)
+        return v
+
+    def _on_verify(self, widx: int, accepted: int, steps: int) -> None:
+        self.accepted_steps += accepted
+        self.layer.note_spec(windows=1, accepted=accepted,
+                             rejected=1 if accepted < steps else 0)
+        if accepted < steps:
+            self.rejected = True
+            self.cancel_branch()
+
+    def cancel_branch(self, count: bool = True) -> None:
+        """Cancel the losing draft branch: queued draft tasks drop at
+        select time; the branch's pages return to the pool at
+        :meth:`release` (after the branch drained)."""
+        with self._lock:
+            if self._cancelled:
+                return
+            self._cancelled = True
+            tp, sub = self.branch_tp, self.branch_sub
+        if tp is None:
+            return
+        try:
+            if sub is not None:
+                sub.cancel()
+            else:
+                tp.cancel()
+        except Exception:  # noqa: BLE001 — already terminated
+            pass
+        if count:
+            self.layer.note_spec(cancelled=1)
+
+    def release(self, timeout: float = 10.0) -> None:
+        """Release the branch's resources (idempotent): cancel if still
+        running, wait for the branch pool to drain (an in-flight
+        draft's write-back must never race a reallocated page), then
+        return the COW/draft pages to the pool."""
+        with self._lock:
+            if self._released:
+                return
+            self._released = True
+        self.cancel_branch(count=False)
+        tp = self.branch_tp
+        if tp is not None and not tp._complete_evt.wait(timeout):
+            # the branch did NOT drain: a still-in-flight draft's
+            # write-back would corrupt a reallocated page — LEAK the
+            # pids (loudly) rather than release them for reuse
+            from ..utils.debug import warning
+            warning("spec", "draft branch of rid %d not drained in "
+                    "%.1fs; leaking %d draft pages instead of "
+                    "releasing them for reuse", self.req.rid, timeout,
+                    len(self.draft_pids))
+            self.draft_pids = []
+            return
+        for pid in self.draft_pids:
+            self.layer.pool.release(pid)
+        self.draft_pids = []
+        for key in self.draft_dc.keys():
+            self.draft_dc.drop_tile(key)

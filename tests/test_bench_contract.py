@@ -118,8 +118,13 @@ def test_compact_summary_survives_error_rows():
     result["detail"]["extra_configs"] = {
         k: {"error": "boom"} for k in result["detail"]["extra_configs"]}
     line = bench._compact_summary(result)
+    assert len(line.encode()) < 2000
     parsed = json.loads(line)
-    assert parsed["detail"]["gemm_panel_fused_gflops"] is None
+    # the headline survives; errored sections' rows are either present
+    # as null or shed by the size relief valve (the guards skip
+    # missing keys on either side) — never a bogus number
+    assert parsed["value"] == 110000.12
+    assert parsed["detail"].get("gemm_panel_fused_gflops") is None
 
 
 def test_section_keys_cover_registry():
@@ -380,6 +385,62 @@ def test_serving_guard_rows_fire_in_both_directions():
     assert bench._compare_captures(
         {"serving_requests_per_sec": 49.0, "serving_p99_ms": 10.5},
         prior) == {}
+
+
+def test_serving_kv_section_registered():
+    """--section serving_kv is a first-class section (ISSUE 15 bench
+    contract): registry, error keys, compact summary, and the guards
+    stay wired — sustained req/s, the >=3x sharing speedup, the
+    prefix-cache hit rate, and prefill-tokens/s ride the throughput
+    drop-guard; the share arm's p99 rides the latency rise-guard."""
+    bench = _load_bench()
+    assert "serving_kv" in bench.SECTIONS
+    assert bench._SECTION_KEYS["serving_kv"] == ("serving_kv",)
+    for key in ("serving_kv_requests_per_sec", "serving_kv_speedup",
+                "kv_hit_rate", "serving_kv_prefill_tokens_per_sec"):
+        assert key in bench._GFLOPS_GUARD_KEYS, key
+    assert "serving_kv_p99_ms" in bench._LATENCY_GUARD_KEYS
+    result = _fat_result()
+    result["detail"]["extra_configs"]["serving_kv"] = {
+        "requests_per_sec": 61.2, "speedup_vs_nosharing": 3.4,
+        "kv_hit_rate": 0.97, "prefill_tokens_per_sec": 42000.1,
+        "p99_ms": 650.2, "bitwise": "OK", "spec_accepted_steps": 70,
+        "acceptance": "OK"}
+    compact = json.loads(bench._compact_summary(result))
+    d = compact["detail"]
+    assert d["serving_kv_requests_per_sec"] == 61.2
+    assert d["serving_kv_speedup"] == 3.4
+    assert d["kv_hit_rate"] == 0.97
+    assert d["serving_kv_prefill_tokens_per_sec"] == 42000.1
+    assert d["serving_kv_p99_ms"] == 650.2
+    assert d["serving_kv_bitwise"] == "OK"
+    assert d["serving_kv_spec_accepted"] == 70
+    assert d["serving_kv_acceptance"] == "OK"
+
+
+def test_serving_kv_guard_rows_fire_in_both_directions():
+    bench = _load_bench()
+    prior = {"serving_kv_requests_per_sec": 60.0,
+             "serving_kv_speedup": 3.5, "kv_hit_rate": 0.95,
+             "serving_kv_prefill_tokens_per_sec": 40000.0,
+             "serving_kv_p99_ms": 600.0}
+    out = bench._compare_captures(
+        {"serving_kv_requests_per_sec": 40.0,     # -33%: regressed
+         "serving_kv_speedup": 2.0,               # sharing win gone
+         "kv_hit_rate": 0.5,                      # cache stopped hitting
+         "serving_kv_prefill_tokens_per_sec": 20000.0,
+         "serving_kv_p99_ms": 950.0},             # +58%: p99 blew up
+        prior)
+    for key in ("serving_kv_requests_per_sec", "serving_kv_speedup",
+                "kv_hit_rate", "serving_kv_prefill_tokens_per_sec"):
+        assert key in out["throughput_regression"], key
+    assert "serving_kv_p99_ms" in out["latency_regression"]
+    # within-band changes stay quiet
+    assert bench._compare_captures(
+        {"serving_kv_requests_per_sec": 58.0,
+         "serving_kv_speedup": 3.4, "kv_hit_rate": 0.94,
+         "serving_kv_prefill_tokens_per_sec": 39000.0,
+         "serving_kv_p99_ms": 640.0}, prior) == {}
 
 
 def test_amort_section_registered():

@@ -1,0 +1,289 @@
+"""KV state layer units (ISSUE 15): page pool, radix prefix tree.
+
+Covers paged allocation (refcounts, free-list reuse, capacity
+exhaustion, COW under two writers), the HBM page-entry wiring
+(register-on-write with next-use hints, drop-on-free, ``hint()``),
+and the radix tree in isolation — insert/match/split on divergence,
+refcount drop → page reclaim, eviction refusing pinned nodes, and a
+property-style comparison against a naive prefix model over random
+token streams.
+"""
+
+import numpy as np
+import pytest
+
+from parsec_tpu.serving.kv import (KVPagePool, KVPagesExhausted,
+                                   RadixTree)
+
+PT = 4          # page tokens
+D = 8           # d_model
+
+
+def mkpool(capacity=0, hbm=None):
+    return KVPagePool("t", PT, D, capacity=capacity, hbm=hbm)
+
+
+# ---------------------------------------------------------------------------
+# page pool
+# ---------------------------------------------------------------------------
+
+def test_pool_alloc_release_reuse():
+    pool = mkpool()
+    a, b = pool.alloc(2)
+    assert pool.pages_in_use() == 2
+    assert pool.refs(a) == pool.refs(b) == 1
+    assert pool.dc.data_of((a,)).shape == (2, PT, D)
+    pool.retain(a)
+    pool.release(a)
+    assert pool.refs(a) == 1          # still held
+    pool.release(a)
+    assert pool.refs(a) == 0
+    assert pool.dc.data_of((a,)) is None   # tile dropped at free
+    [c] = pool.alloc(1)
+    assert c == a                     # free-list reuse
+    assert pool.dc.data_of((c,)).shape == (2, PT, D)   # fresh buffer
+    pool.release(c)
+    pool.release(c)                   # idempotent double-free: no-op
+    pool.release(b)
+    assert pool.pages_in_use() == 0
+
+
+def test_pool_capacity_exhaustion_raises():
+    pool = mkpool(capacity=3)
+    pids = pool.alloc(3)
+    with pytest.raises(KVPagesExhausted):
+        pool.alloc(1)
+    assert pool.stats["exhausted"] == 1
+    pool.release(pids[0])
+    [d] = pool.alloc(1)               # freed page satisfies the retry
+    assert d == pids[0]
+
+
+def test_pool_cow_under_two_writers():
+    """The divergence-point copy: two writers of a shared page each get
+    a private copy; the original's bytes and refcount are untouched."""
+    pool = mkpool()
+    [src] = pool.alloc(1)
+    orig = pool.dc.data_of((src,))
+    orig[0, 0, 0] = 7.0
+    pool.retain(src)                  # two holders share the page
+    c1 = pool.cow(src)
+    c2 = pool.cow(src)
+    assert len({src, c1, c2}) == 3
+    assert pool.refs(src) == 2        # cow never touches the source
+    assert pool.refs(c1) == pool.refs(c2) == 1
+    pool.dc.data_of((c1,))[0, 0, 0] = 1.0
+    pool.dc.data_of((c2,))[0, 0, 0] = 2.0
+    assert pool.dc.data_of((src,))[0, 0, 0] == 7.0
+    assert pool.stats["cow_copies"] == 2
+
+
+def test_pool_hbm_page_entries():
+    """Pages register with the HBM manager under ("kvpage", ...) keys
+    (outside any collection-sweep namespace), refresh next-use hints on
+    write, and drop on free."""
+    import jax  # noqa: F401 — HBMManager imports jax
+    from parsec_tpu.device.hbm import HBMManager
+    hbm = HBMManager(1 << 20)
+    pool = mkpool(hbm=hbm)
+    [a] = pool.alloc(1)
+    key = ("kvpage", id(pool), a)
+    assert key in hbm._entries
+    nu0 = hbm._entries[key]["next_use"]
+    pool.dc.write_tile((a,), np.ones((2, PT, D), dtype=np.float32))
+    assert hbm._entries[key]["next_use"] > nu0
+    # hint(): refresh without staging; unknown keys are a no-op
+    pool.touch(a)
+    hbm.hint(("kvpage", 0, 999), next_use=5)
+    pool.release(a)
+    assert key not in hbm._entries
+
+
+# ---------------------------------------------------------------------------
+# radix tree
+# ---------------------------------------------------------------------------
+
+def toks(*pages):
+    """Build a token tuple from page-sized runs of a base value."""
+    out = []
+    for base in pages:
+        out.extend(base * 100 + i for i in range(PT))
+    return tuple(out)
+
+
+def publish(tree, tokens):
+    """Alloc + insert pages for a page-aligned token sequence, then
+    drop the publisher's own references (the tree keeps its own) —
+    the engine's publish-at-prefill-completion shape."""
+    n = len(tokens) // PT
+    pids = tree.pool.alloc(n)
+    tree.insert(tokens, pids)
+    for pid in pids:
+        tree.pool.release(pid)
+    return pids
+
+
+def test_tree_insert_match_exact_and_partial():
+    pool = mkpool()
+    tree = RadixTree(pool)
+    pids = publish(tree, toks(1, 2, 3))
+    h = tree.match(toks(1, 2, 3))
+    assert h.pids == pids and h.n_tokens == 3 * PT
+    h.unlock()
+    # partial: diverges inside page 3 -> floor to 2 whole pages
+    t = toks(1, 2) + tuple(399 + i for i in range(PT))
+    h2 = tree.match(t)
+    assert h2.pids == pids[:2] and h2.n_tokens == 2 * PT
+    h2.unlock()
+    # miss inside the FIRST page: nothing shareable
+    h3 = tree.match(tuple(98765 + i for i in range(2 * PT)))
+    assert h3.pids == [] and h3.n_tokens == 0
+    for pid in h.pids:
+        pool.release(pid)
+    for pid in h2.pids:
+        pool.release(pid)
+
+
+def test_tree_split_on_divergence():
+    pool = mkpool()
+    tree = RadixTree(pool)
+    publish(tree, toks(1, 2, 3, 4))
+    assert tree.node_count() == 1
+    publish(tree, toks(1, 2, 7, 8))       # diverges at page boundary 2
+    # the 4-page run split into head [1,2] + tails [3,4] and [7,8]
+    assert tree.node_count() == 3
+    assert tree.stats["splits"] == 1
+    assert tree.stats["cached_pages"] == 6
+    ha = tree.match(toks(1, 2, 3, 4))
+    hb = tree.match(toks(1, 2, 7, 8))
+    assert ha.n_tokens == hb.n_tokens == 4 * PT
+    assert ha.pids[:2] == hb.pids[:2]     # shared head pages
+    assert ha.pids[2:] != hb.pids[2:]
+    for h in (ha, hb):
+        h.unlock()
+        for pid in h.pids:
+            pool.release(pid)
+
+
+def test_tree_dedup_reinsert():
+    pool = mkpool()
+    tree = RadixTree(pool)
+    pids = publish(tree, toks(1, 2))
+    # a racing second publisher computed its own pages for the same
+    # tokens: the tree keeps the first set, the dupes just free
+    dupes = pool.alloc(2)
+    added = tree.insert(toks(1, 2), dupes)
+    assert added == 0
+    for pid in dupes:
+        pool.release(pid)
+    h = tree.match(toks(1, 2))
+    assert h.pids == pids
+    h.unlock()
+    for pid in h.pids:
+        pool.release(pid)
+
+
+def test_tree_refcount_drop_reclaims_pages():
+    pool = mkpool()
+    tree = RadixTree(pool)
+    publish(tree, toks(1, 2, 3))
+    assert pool.pages_in_use() == 3       # held by the tree alone
+    freed = tree.evict(100)
+    assert freed == 3
+    assert pool.pages_in_use() == 0
+    assert tree.node_count() == 0
+
+
+def test_tree_eviction_refuses_pinned_nodes():
+    pool = mkpool()
+    tree = RadixTree(pool)
+    publish(tree, toks(1, 2))
+    h = tree.match(toks(1, 2))            # pins the path
+    assert tree.evict(100) == 0           # refused: lock_ref > 0
+    assert pool.pages_in_use() == 2
+    h.unlock()
+    for pid in h.pids:
+        pool.release(pid)
+    assert tree.evict(100) == 2
+    assert pool.pages_in_use() == 0
+
+
+def test_tree_lru_eviction_order():
+    pool = mkpool()
+    tree = RadixTree(pool)
+    publish(tree, toks(1))
+    publish(tree, toks(2))
+    h = tree.match(toks(2))               # refresh 2's recency
+    h.unlock()
+    for pid in h.pids:
+        pool.release(pid)
+    assert tree.evict(1) == 1
+    assert tree.match(toks(1)).pids == []     # 1 was the LRU victim
+    h2 = tree.match(toks(2))
+    assert len(h2.pids) == 1
+    h2.unlock()
+    for pid in h2.pids:
+        pool.release(pid)
+
+
+def test_pool_pressure_reclaims_from_tree():
+    """alloc under capacity pressure evicts unpinned cached pages."""
+    pool = mkpool(capacity=4)
+    tree = RadixTree(pool)
+    publish(tree, toks(1, 2, 3))          # 3 cached pages
+    pids = pool.alloc(3)                  # needs 2 reclaimed
+    assert pool.stats["evict_reclaims"] >= 2
+    assert pool.pages_in_use() <= 4
+    for pid in pids:
+        pool.release(pid)
+
+
+def test_tree_property_random_streams():
+    """Property-style: random page-aligned token streams with shared
+    prefixes vs a naive prefix-dict model — match length and page ids
+    must agree exactly (no eviction in this run)."""
+    rng = np.random.default_rng(42)
+    pool = mkpool()
+    tree = RadixTree(pool)
+    model = {}                            # tokens[:k*PT] -> pids tuple
+    seqs = []
+    for _ in range(60):
+        if seqs and rng.random() < 0.6:
+            base = seqs[rng.integers(len(seqs))]
+            keep = int(rng.integers(0, len(base) // PT + 1)) * PT
+            tail_pages = int(rng.integers(0, 4))
+            tail = tuple(int(t) for t in rng.integers(0, 5,
+                                                      tail_pages * PT))
+            tokens = base[:keep] + tail
+        else:
+            n = int(rng.integers(1, 6)) * PT
+            tokens = tuple(int(t) for t in rng.integers(0, 5, n))
+        if not tokens:
+            continue
+        seqs.append(tokens)
+        # model expectation for the MATCH
+        exp = 0
+        while (exp + 1) * PT <= len(tokens) and \
+                tokens[:(exp + 1) * PT] in model:
+            exp += 1
+        h = tree.match(tokens)
+        assert h.n_tokens == exp * PT, (tokens, h.n_tokens, exp * PT)
+        if exp:
+            assert h.pids == list(model[tokens[:exp * PT]]), tokens
+        h.unlock()
+        for pid in h.pids:
+            pool.release(pid)
+        # publish the full page-aligned prefix (reusing matched pids,
+        # allocating the rest) — the engine's shape
+        n_pages = len(tokens) // PT
+        new = pool.alloc(n_pages - exp)
+        pids = h.pids + new
+        tree.insert(tokens[:n_pages * PT], pids)
+        for k in range(1, n_pages + 1):
+            model.setdefault(tokens[:k * PT], tuple(pids[:k]))
+        for pid in new:
+            pool.release(pid)
+    # invariant: every page the pool holds is owned by the tree now
+    assert pool.pages_in_use() == tree.stats["cached_pages"]
+    tree.evict(10 ** 6)
+    assert pool.pages_in_use() == 0
