@@ -1532,6 +1532,55 @@ def _section_sanitize():
     return {"sanitize": out}
 
 
+def _section_protocheck():
+    """Protocol-checker throughput (ISSUE 19): explicit-state BFS over
+    the four serving-protocol models at full-sweep instance sizes —
+    headline = states explored per second (interning + successor
+    generation + invariant/deadlock/RAG checks, liveness included).
+    Also records the zero-violation contract on the current models and
+    that every seeded pre-fix variant is still caught; either failing
+    zeroes the rate so the drop-guard fires loudly."""
+    from parsec_tpu.analysis import protomodels
+    from parsec_tpu.analysis.protocheck import check
+
+    sweep = {
+        "admission": dict(n_requests=4, window=3, soft=2, pages=3),
+        "kv_lifecycle": {},
+        "wfq_lanes": dict(interleave=8, dmax=4, pmax=4),
+        "termdet": dict(n_tasks=4),
+    }
+    out = {"models": {}}
+    states = transitions = 0
+    elapsed = 0.0
+    clean = True
+    for name in sorted(protomodels.MODELS):
+        rep = check(protomodels.MODELS[name](**sweep.get(name, {})),
+                    bound=2_000_000)
+        out["models"][name] = {
+            "states": rep.states, "transitions": rep.transitions,
+            "elapsed_s": round(rep.elapsed_s, 6), "ok": rep.ok,
+            "truncated": rep.truncated}
+        states += rep.states
+        transitions += rep.transitions
+        elapsed += rep.elapsed_s
+        clean = clean and rep.ok and not rep.truncated
+    caught = 0
+    for name, (mk, rule) in sorted(protomodels.SEEDED.items()):
+        rep = check(mk(), bound=200000)
+        if any(f.rule == rule or f.rule.startswith(rule)
+               for f in rep.errors):
+            caught += 1
+    out["seeded_caught"] = caught
+    out["seeded_total"] = len(protomodels.SEEDED)
+    out["clean"] = clean and caught == len(protomodels.SEEDED)
+    out["states"] = states
+    out["transitions"] = transitions
+    out["elapsed_s"] = round(elapsed, 6)
+    out["states_per_sec"] = (
+        round(states / elapsed, 1) if elapsed > 0 and out["clean"] else 0.0)
+    return {"protocheck": out}
+
+
 SECTIONS = {
     "hostdtd": _section_hostdtd,
     "ptile": _section_ptile,
@@ -1550,6 +1599,7 @@ SECTIONS = {
     "observability": _section_observability,
     "latency": _section_latency,
     "sanitize": _section_sanitize,
+    "protocheck": _section_protocheck,
 }
 
 # result keys each section produces — failures are recorded under these
@@ -1572,6 +1622,7 @@ _SECTION_KEYS = {
     "observability": ("observability",),
     "latency": ("latency",),
     "sanitize": ("sanitize",),
+    "protocheck": ("protocheck",),
 }
 
 # geqrf stacks three programs (per-tile stress + 94-wave fused + the
@@ -1667,7 +1718,13 @@ _GFLOPS_GUARD_KEYS = ("value", "gemm_panel_fused_gflops",
                       # dfsan race sanitizer LIVE (insert manifests +
                       # fold-time replay) — a drop means the sanitizer
                       # got too expensive to leave on in serving soaks
-                      "tasks_per_sec_native_dfsan")
+                      "tasks_per_sec_native_dfsan",
+                      # ISSUE 19: explicit-state checker throughput
+                      # (states/s over the full-sweep model instances);
+                      # the rate is zeroed when any current model
+                      # violates or a seeded bug goes uncaught, so the
+                      # drop-guard doubles as the contract alarm
+                      "protocheck_states_per_sec")
 _LATENCY_GUARD_KEYS = ("eager_1k_p50_us", "rdv_1M_p50_us",
                        "device_64k_p50_us", "bcast_1M_p50_us",
                        # recovery rows ride the same rise-guard: a
@@ -1893,6 +1950,10 @@ def _compact_summary(result):
             "tasks_per_sec_native_dfsan": pick(
                 "taskrate", "tasks_per_sec_native_dfsan"),
             "sanitize_report_count": pick("sanitize", "report_count"),
+            "protocheck_states_per_sec": pick("protocheck",
+                                              "states_per_sec"),
+            "protocheck_seeded_caught": pick("protocheck",
+                                             "seeded_caught"),
             "taskrate_native_ratio": pick("taskrate",
                                           "native_vs_python"),
             "taskrate_stage_us": pick("taskrate", "stage_us_per_task"),

@@ -17,6 +17,19 @@ fully determine its execution order (the PaRSEC correctness claim):
   tracking, and a per-tile version-sequence determinism digest guarding
   the scheduler/release fast paths.
 
+A third half (ISSUE 19) audits the *protocols between* the concurrent
+parties rather than any one DAG:
+
+- **Protocol checker** (:mod:`~parsec_tpu.analysis.protocheck` over
+  :mod:`~parsec_tpu.analysis.protomodels`): SPIN-style explicit-state
+  exploration of the admission/KV-lifecycle/wfq-lane/termdet protocols
+  — invariants, deadlock, circular wait in the resource-allocation
+  graph, and fair-lasso starvation, each with a shortest
+  counterexample trace.  :mod:`~parsec_tpu.analysis.conformance`
+  replays recorded Trace/native-ring event streams through the same
+  models and reports the first non-refining step.  CLI:
+  ``python -m parsec_tpu.analysis protocheck``.
+
 Reference counterparts: jdf_sanity_checks (jdf.c), the grapher/DOT
 tooling (parsec_prof_grapher.c) and the iterators_checker PINS module.
 """
@@ -35,11 +48,17 @@ mca_param.register(
     help="instance-enumeration cap for the lint; larger task spaces "
          "degrade to structural (per-class) checks only")
 
-from .lint import Finding, HazardError, LintReport, lint_taskpool, validate
+from .lint import (Finding, HazardError, LintReport, lint_hot_config,
+                   lint_taskpool, validate)
 from .model import Model, build_model
 from .dfsan import DataflowSanitizer, RaceReport
+from .protocheck import (Action, Liveness, ProtoFinding, ProtoModel,
+                         ProtoReport, check)
+from .conformance import ConformanceReport, load_records, replay
 
 __all__ = [
     "Finding", "HazardError", "LintReport", "lint_taskpool", "validate",
-    "Model", "build_model", "DataflowSanitizer", "RaceReport",
+    "lint_hot_config", "Model", "build_model", "DataflowSanitizer",
+    "RaceReport", "Action", "Liveness", "ProtoFinding", "ProtoModel",
+    "ProtoReport", "check", "ConformanceReport", "load_records", "replay",
 ]

@@ -19,6 +19,21 @@ ptgpp sanity checks rolled into one command):
 
 The default lint pass is purely static — no runtime context, no task
 bodies; only the ``--self-check`` engine-parity arm starts a context.
+
+Since ISSUE 19 the CLI also fronts the protocol checker::
+
+    python -m parsec_tpu.analysis protocheck [model] [--bound N]
+                                             [--trace FILE] [--seeded]
+
+- no model argument: check every registered current-protocol model
+  (analysis/protomodels.py) and fail on any violation — the shipped
+  protocols are the checker's zero-violation contract;
+- ``--seeded``: additionally run the seeded pre-fix variants and FAIL
+  unless each is caught with its expected rule (the checker checking
+  itself, same contract shape as ``--self-check``);
+- ``--trace FILE``: replay a dumped event stream (``Trace.dump_json``
+  or a raw ``to_records`` list) through the conformance passes and
+  report the first non-refining step.
 """
 
 from __future__ import annotations
@@ -58,7 +73,92 @@ def _build_algorithms(nt: int) -> Dict[str, object]:
     return out
 
 
+def _protocheck_main(argv: List[str]) -> int:
+    """``protocheck`` subcommand: model checking + trace conformance."""
+    from . import conformance, protomodels
+    from .protocheck import check
+
+    ap = argparse.ArgumentParser(
+        prog="python -m parsec_tpu.analysis protocheck",
+        description="explicit-state protocol checking over the serving "
+                    "runtime's admission/KV/wfq/termdet protocols")
+    ap.add_argument("model", nargs="?", default="all",
+                    help="protocol model to check: all | "
+                         + " | ".join(sorted(protomodels.MODELS)))
+    ap.add_argument("--bound", type=int, default=20000,
+                    help="state-count bound for the exploration "
+                         "(exceeding it skips liveness and notes "
+                         "TRUNCATED)")
+    ap.add_argument("--seeded", action="store_true",
+                    help="also check the seeded pre-fix variants and "
+                         "fail unless each is caught with its expected "
+                         "rule")
+    ap.add_argument("--trace", default="",
+                    help="replay a dumped Trace event stream (JSON) "
+                         "through the conformance passes")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print counterexample traces even on success "
+                         "paths")
+    args = ap.parse_args(argv)
+
+    rc = 0
+    names = sorted(protomodels.MODELS) if args.model == "all" \
+        else [args.model]
+    for name in names:
+        if name not in protomodels.MODELS:
+            ap.error(f"unknown model {name!r}; have "
+                     f"{', '.join(sorted(protomodels.MODELS))}")
+        report = check(protomodels.MODELS[name](), bound=args.bound)
+        status = "clean" if report.ok else \
+            f"{len(report.errors)} violation(s)"
+        print(f"[protocheck] {report.summary()} — {status}")
+        if report.findings:
+            for f in report.findings:
+                print("\n".join(f"    {ln}"
+                                for ln in str(f).splitlines()))
+        if not report.ok:
+            rc = 1
+
+    if args.seeded:
+        for name, (mk, rule) in sorted(protomodels.SEEDED.items()):
+            report = check(mk(), bound=args.bound)
+            hit = [f for f in report.errors
+                   if f.rule == rule or f.rule.startswith(rule)]
+            if hit:
+                print(f"[protocheck] seeded {name}: caught "
+                      f"({hit[0].rule}, {len(hit[0].trace)}-line "
+                      f"counterexample)")
+                if args.verbose:
+                    print("\n".join(f"    {ln}"
+                                    for ln in str(hit[0]).splitlines()))
+            else:
+                print(f"[protocheck] seeded {name}: NOT caught "
+                      f"(expected {rule}, got "
+                      f"{[f.rule for f in report.errors] or 'nothing'})")
+                rc = 1
+
+    if args.trace:
+        records = conformance.load_records(args.trace)
+        reports = conformance.replay(records)
+        if not reports:
+            print(f"[conformance] {args.trace}: no replayable events "
+                  "(kvpage/admission)")
+        for rep in reports:
+            print(f"[conformance] {rep.summary()}")
+            for m in rep.mismatches:
+                print(f"    {m}")
+            if not rep.ok:
+                rc = 1
+
+    print("OK" if rc == 0 else "FAILED")
+    return rc
+
+
 def main(argv: List[str] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "protocheck":
+        return _protocheck_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m parsec_tpu.analysis",
         description="static dataflow hazard lint over PTG taskpools")
@@ -117,6 +217,21 @@ def main(argv: List[str] = None) -> int:
         nfail, nlines = native_self_check()
         failures += nfail
         lines += nlines
+        # ISSUE 19: the seeded pre-fix protocol models are part of the
+        # same contract — each must be caught with its expected rule
+        from . import protomodels
+        from .protocheck import check as proto_check
+        for pname, (mk, rule) in sorted(protomodels.SEEDED.items()):
+            report = proto_check(mk(), bound=20000)
+            hit = [f for f in report.errors
+                   if f.rule == rule or f.rule.startswith(rule)]
+            if hit:
+                lines.append(f"ok   protocheck {pname}: {hit[0].rule} "
+                             f"({len(hit[0].trace)}-line counterexample)")
+            else:
+                failures += 1
+                lines.append(f"FAIL protocheck {pname}: expected {rule},"
+                             f" got {[f.rule for f in report.errors]}")
         for line in lines:
             print(f"[self-check] {line}")
         if failures:

@@ -1,0 +1,280 @@
+"""Trace-refinement check: replay recorded runtime events through the
+protocol models and report the FIRST non-refining step.
+
+protocheck proves properties of the *models*; this pass keeps the
+models honest against the *implementation*.  The PR 13 observability
+plane already records the ground truth — ``kvpage`` events from
+:class:`~parsec_tpu.serving.kv.KVPagePool` and ``admission``
+admit/retire/reconcile events from the serving runtime, in the Python
+rings and the native engine rings alike — so refinement is a pure
+replay over ``Trace.to_records()`` output: feed each event to the
+matching protocol's transition rules and stop at the first event the
+model's guards cannot explain (index, event, reason).  A clean replay
+certifies the traced run is a behavior of the checked model; the
+upcoming native wfq/admission port inherits this as its refinement
+oracle.
+
+Event vocabulary replayed here:
+
+- ``kvpage`` — phase alloc/retain/release/free/cow/write, object = pid,
+  info.refs = refcount after the op (cross-checked against the replay's
+  own bookkeeping, so a *missing* event is caught as a refs mismatch);
+- ``admission`` — phase admit/retire/reconcile, info.tenant/rows/
+  inflight (depth after), window/soft on admits.  The begin/end park
+  spans PR 13 records are latency annotations, not protocol steps, and
+  are skipped.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+from .lint import ERROR
+
+Record = Dict[str, Any]
+
+
+@dataclass
+class Mismatch:
+    """One non-refining step: the event the model cannot explain."""
+    index: int                    # position in the replayed stream
+    event: Record
+    reason: str
+
+    def __str__(self) -> str:
+        ev = self.event
+        return (f"[{ERROR}] non-refining step at #{self.index}: "
+                f"{ev.get('key')}/{ev.get('phase')} "
+                f"object={ev.get('object')!r} — {self.reason}")
+
+
+@dataclass
+class ConformanceReport:
+    """Replay verdict for one protocol over one event stream."""
+    protocol: str
+    checked: int = 0              # events replayed
+    mismatches: List[Mismatch] = field(default_factory=list)
+    notes: List[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.mismatches
+
+    @property
+    def first(self) -> Optional[Mismatch]:
+        return self.mismatches[0] if self.mismatches else None
+
+    def summary(self) -> str:
+        verdict = ("refines" if self.ok else
+                   f"{len(self.mismatches)} non-refining step(s)")
+        out = f"{self.protocol}: {self.checked} events — {verdict}"
+        if self.notes:
+            out += f" ({'; '.join(self.notes)})"
+        return out
+
+    def __str__(self) -> str:
+        lines = [self.summary()]
+        lines.extend(f"  {m}" for m in self.mismatches)
+        return "\n".join(lines)
+
+
+def check_kvpage(records: Sequence[Record],
+                 require_drained: bool = False) -> ConformanceReport:
+    """Replay ``kvpage`` events through the page-lifecycle rules of the
+    :func:`~.protomodels.kv_lifecycle` model: every op must target a
+    page the refcount state machine says it may, and the recorded
+    refcount-after must equal the replayed one.  ``require_drained``
+    additionally asserts the terminal invariant (pages-in-use == 0
+    once the stream ends) — the no-leak property for runs that release
+    everything before the dump."""
+    rep = ConformanceReport(protocol="kv_lifecycle")
+    live: Dict[Any, int] = {}     # pid -> replayed refcount
+
+    def bad(i: int, ev: Record, reason: str) -> None:
+        rep.mismatches.append(Mismatch(i, ev, reason))
+
+    for i, ev in enumerate(records):
+        if ev.get("key") != "kvpage":
+            continue
+        rep.checked += 1
+        op = ev.get("phase")
+        pid = ev.get("object")
+        info = ev.get("info") or {}
+        refs = info.get("refs")
+        if op == "alloc":
+            if pid in live:
+                bad(i, ev, f"alloc of live page {pid} "
+                    f"(refs={live[pid]})")
+                continue
+            live[pid] = 1
+        elif op == "retain":
+            if pid not in live:
+                bad(i, ev, f"retain of freed page {pid}")
+                continue
+            live[pid] += 1
+            if refs is not None and refs != live[pid]:
+                bad(i, ev, f"refcount drift on retain: recorded "
+                    f"{refs}, replay says {live[pid]} "
+                    f"(a lifecycle event is missing)")
+                live[pid] = refs            # resync: report first drift
+        elif op == "release":
+            if pid not in live:
+                # KVPagePool.release is idempotent on freed pids by
+                # contract — a no-op, not a protocol step
+                continue
+            live[pid] -= 1
+            if refs is not None and refs != live[pid]:
+                bad(i, ev, f"refcount drift on release: recorded "
+                    f"{refs}, replay says {live[pid]}")
+                live[pid] = refs
+            if live[pid] < 0:
+                bad(i, ev, f"refcount underflow on page {pid}")
+                del live[pid]
+        elif op == "free":
+            if pid not in live:
+                bad(i, ev, f"free of already-freed page {pid}")
+                continue
+            if live[pid] > 0:
+                bad(i, ev, f"free of page {pid} with "
+                    f"{live[pid]} live reference(s)")
+            del live[pid]
+        elif op == "cow":
+            # annotation on an already-allocated copy: both ends live
+            if pid not in live:
+                bad(i, ev, f"cow produced unknown page {pid}")
+            src = info.get("src")
+            if src is not None and src not in live:
+                bad(i, ev, f"cow of freed source page {src}")
+        elif op == "write":
+            # THE write-back-after-free oracle (PR 15's spec bug class)
+            if pid not in live:
+                bad(i, ev, f"write-back to freed page {pid} "
+                    "(write-after-free)")
+        else:
+            bad(i, ev, f"unknown kvpage op {op!r}")
+
+    if require_drained and live:
+        rep.notes.append(
+            f"stream ends with {len(live)} page(s) still in use: "
+            f"{sorted(live)[:8]}")
+        rep.mismatches.append(Mismatch(
+            len(records), {"key": "kvpage", "phase": "<end>",
+                           "object": None},
+            f"pages-in-use != 0 at end of stream ({sorted(live)[:8]})"))
+    return rep
+
+
+def check_admission(records: Sequence[Record]) -> ConformanceReport:
+    """Replay ``admission`` admit/retire/reconcile events through the
+    window rules of :func:`~.protomodels.admission_budget`: depths
+    never negative, never above the hard window, and the recorded
+    depth-after always equals the replayed one."""
+    rep = ConformanceReport(protocol="admission_budget")
+    inflight: Dict[str, int] = {}         # tenant -> replayed depth
+    windows: Dict[str, int] = {}
+
+    def bad(i: int, ev: Record, reason: str) -> None:
+        rep.mismatches.append(Mismatch(i, ev, reason))
+
+    for i, ev in enumerate(records):
+        if ev.get("key") != "admission":
+            continue
+        phase = ev.get("phase")
+        if phase not in ("admit", "retire", "reconcile"):
+            continue                      # park spans: latency, not steps
+        rep.checked += 1
+        info = ev.get("info") or {}
+        ten = info.get("tenant", "?")
+        rows = int(info.get("rows", 1))
+        rec_depth = info.get("inflight")
+        if phase == "admit":
+            if "window" in info:
+                windows[ten] = int(info["window"])
+            cur = inflight.get(ten)
+            if cur is None:
+                # stream may open mid-life: adopt the recorded baseline
+                cur = max(int(rec_depth) - rows, 0) \
+                    if rec_depth is not None else 0
+            new = cur + rows
+            w = windows.get(ten)
+            if w is not None and new > w:
+                bad(i, ev, f"admit of {rows} rows puts tenant "
+                    f"{ten!r} at depth {new} > hard window {w}")
+            if rec_depth is not None and int(rec_depth) != new:
+                bad(i, ev, f"depth drift on admit: recorded "
+                    f"{rec_depth}, replay says {new}")
+                new = int(rec_depth)
+            inflight[ten] = new
+        else:                              # retire / reconcile
+            cur = inflight.get(ten)
+            if cur is None:
+                cur = int(rec_depth) + rows if rec_depth is not None \
+                    else rows
+            new = cur - rows
+            if new < 0:
+                bad(i, ev, f"retire of {rows} rows drives tenant "
+                    f"{ten!r} depth negative ({new})")
+                new = 0
+            if rec_depth is not None and int(rec_depth) != new:
+                bad(i, ev, f"depth drift on {phase}: recorded "
+                    f"{rec_depth}, replay says {new}")
+                new = int(rec_depth)
+            inflight[ten] = new
+
+    residual = {t: d for t, d in inflight.items() if d != 0}
+    if residual:
+        rep.notes.append(f"open depths at end of stream: {residual}")
+    return rep
+
+
+#: protocol name -> replay function over a record stream
+PASSES = {
+    "kv_lifecycle": check_kvpage,
+    "admission": check_admission,
+}
+
+
+def replay(records: Sequence[Record],
+           protocols: Optional[Sequence[str]] = None,
+           ) -> List[ConformanceReport]:
+    """Run every requested conformance pass (default: all whose events
+    appear in the stream) and return the reports."""
+    if protocols is None:
+        keys = {ev.get("key") for ev in records}
+        protocols = []
+        if "kvpage" in keys:
+            protocols.append("kv_lifecycle")
+        if "admission" in keys:
+            protocols.append("admission")
+    out = []
+    for name in protocols:
+        if name not in PASSES:
+            raise KeyError(f"unknown conformance pass {name!r}; have "
+                           f"{', '.join(sorted(PASSES))}")
+        out.append(PASSES[name](records))
+    return out
+
+
+def load_records(path: str) -> List[Record]:
+    """Load an event stream dumped from :meth:`Trace.to_records` — a
+    JSON list of record dicts, a dict with an ``events`` list (the
+    ``dump_json`` envelope), or JSONL (one record dict per line)."""
+    with open(path) as fh:
+        text = fh.read()
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:
+        # line-delimited stream: ring dumps and `tee`d traces land here
+        data = [json.loads(line) for line in text.splitlines() if line.strip()]
+    if isinstance(data, dict):
+        for key in ("events", "records", "traceEvents"):
+            if key in data:
+                data = data[key]
+                break
+        else:
+            raise ValueError(f"{path}: no event list found")
+    if not isinstance(data, list):
+        raise ValueError(f"{path}: expected a JSON list of records")
+    return data

@@ -373,4 +373,54 @@ def self_check() -> Tuple[int, list]:
             else:
                 lines.append(f"ok   {name}: to_dot() renders "
                              f"({len(dot)} bytes, hazard edge marked)")
+    nfail, nlines = hot_config_self_check()
+    failures += nfail
+    lines += nlines
+    return failures, lines
+
+
+#: seeded hot-path config-read source — the shape PR 15 actually fixed
+#: in wfq select(): a full registry get once per selected task
+HOT_CONFIG_FIXTURE = '''\
+class BadScheduler:
+    def select(self, es):
+        interleave = int(mca_param.get("serving.kv_prefill_interleave", 4))
+        return self.pick(interleave)
+
+    def _drain(self):
+        while self.live():
+            batch = int(mca_param.get("runtime.release_batch", 8))
+            self.flush(batch)
+
+    def install(self, context):
+        # preamble read outside any hot function or loop: allowed
+        self.quantum = int(mca_param.get("sched.quantum_us", 50))
+'''
+
+
+def hot_config_self_check() -> Tuple[int, list]:
+    """The hot-config-read rule's own contract: the seeded fixture
+    source MUST trip it (both the select() shape and the loop-body
+    shape), and the SHIPPED sched/worker tree must be clean."""
+    from .lint import _scan_hot_config_source, lint_hot_config
+    failures = 0
+    lines = []
+    found = [f for f in _scan_hot_config_source(HOT_CONFIG_FIXTURE,
+                                                "fixture.py")
+             if f.severity == "error"]
+    sites = {f.task.split(" ")[0] for f in found}
+    if {"select", "_drain"} <= sites and len(found) == 2:
+        lines.append(f"ok   hot_config_fixture: {found[0]}")
+    else:
+        failures += 1
+        lines.append(f"FAIL hot_config_fixture: expected select+_drain "
+                     f"flagged (and install clean), got {sites}")
+    shipped = [f for f in lint_hot_config() if f.severity == "error"]
+    if shipped:
+        failures += 1
+        lines.append(f"FAIL hot_config_shipped: sched/worker tree not "
+                     f"clean: {shipped[0]}")
+    else:
+        lines.append("ok   hot_config_shipped: sched/* and worker loop "
+                     "clean (cached_get / preamble reads only)")
     return failures, lines

@@ -367,3 +367,109 @@ def validate(tp, mode: str = "error", max_tasks: int = 0) -> LintReport:
         for f in report.findings:
             warning("analysis", "%s", f)
     return report
+
+
+# ---------------------------------------------------------------------------
+# hot-path config-lookup lint (source-level, AST)
+# ---------------------------------------------------------------------------
+
+#: scheduler entry points that run once per task on every worker — an
+#: uncached registry read here is a cross-worker serialization point
+#: (PR 15 found exactly this in wfq select(): the full mca_param.get
+#: takes the global registry lock and re-resolves the environment)
+_HOT_FUNCS = frozenset({"select", "steal", "try_steal", "schedule",
+                        "pop_front", "pop_back"})
+
+#: mca_param entry points that are SAFE on the hot path
+_CACHED_READS = frozenset({"cached_get"})
+
+
+def _scan_hot_config_source(src: str, filename: str) -> List[Finding]:
+    """AST scan of one source file for uncached ``mca_param.get`` /
+    ``mca_param.registry`` calls on hot paths: anywhere inside a
+    scheduler hot function (``_HOT_FUNCS``), or inside any loop of any
+    other function (the worker-main shape — a one-time read in the
+    preamble is fine, the same read per loop iteration is not)."""
+    import ast
+    findings: List[Finding] = []
+    try:
+        tree = ast.parse(src, filename=filename)
+    except SyntaxError as exc:
+        findings.append(Finding(
+            "hot-config-read", NOTE, filename,
+            message=f"{filename}: unparseable, skipped ({exc})"))
+        return findings
+
+    def is_config_read(call: "ast.Call") -> Optional[str]:
+        fn = call.func
+        if not isinstance(fn, ast.Attribute):
+            return None
+        if fn.attr in _CACHED_READS:
+            return None
+        if fn.attr not in ("get", "registry"):
+            return None
+        base = fn.value
+        if isinstance(base, ast.Name) and base.id == "mca_param":
+            return f"mca_param.{fn.attr}"
+        if isinstance(base, ast.Attribute) and base.attr == "mca_param":
+            return f"mca_param.{fn.attr}"
+        return None
+
+    def scan_func(fn_node, qual: str) -> None:
+        hot_everywhere = fn_node.name in _HOT_FUNCS
+        # (node, loop_depth) walk that does NOT descend into nested
+        # function definitions (they get their own scan_func pass)
+        stack = [(child, 0) for child in fn_node.body]
+        while stack:
+            node, depth = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            d = depth + 1 if isinstance(
+                node, (ast.For, ast.While, ast.AsyncFor)) else depth
+            if isinstance(node, ast.Call):
+                read = is_config_read(node)
+                if read is not None and (hot_everywhere or depth > 0):
+                    where = ("scheduler hot function" if hot_everywhere
+                             else "loop body")
+                    findings.append(Finding(
+                        "hot-config-read", ERROR,
+                        f"{qual} ({filename}:{node.lineno})",
+                        message=f"{filename}:{node.lineno}: {read} in "
+                                f"{where} {qual}() — a full registry "
+                                f"read (global lock + env resolve) "
+                                f"once per task serializes the "
+                                f"workers; hoist it or use "
+                                f"mca_param.cached_get"))
+            for child in ast.iter_child_nodes(node):
+                stack.append((child, d))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scan_func(node, node.name)
+    return findings
+
+
+def lint_hot_config(paths: Optional[List[str]] = None) -> List[Finding]:
+    """Scan the scheduler package and the worker loop (the shipped hot
+    paths) — or an explicit file list — for uncached config reads.
+    The shipped tree is the rule's zero-false-positive contract
+    (enforced by the analysis CLI self-check)."""
+    import glob
+    import os
+    if paths is None:
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = sorted(glob.glob(os.path.join(pkg, "sched", "*.py")))
+        paths.append(os.path.join(pkg, "core", "context.py"))
+    findings: List[Finding] = []
+    for path in paths:
+        try:
+            with open(path) as fh:
+                src = fh.read()
+        except OSError as exc:
+            findings.append(Finding(
+                "hot-config-read", NOTE, path,
+                message=f"{path}: unreadable, skipped ({exc})"))
+            continue
+        findings.extend(
+            _scan_hot_config_source(src, os.path.basename(path)))
+    return findings

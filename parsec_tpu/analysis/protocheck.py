@@ -1,0 +1,471 @@
+"""Explicit-state protocol checker (SPIN's nested-search core, scaled
+to the runtime's protocol sizes).
+
+The dataflow lint (analysis/lint.py) proves properties of ONE taskpool
+DAG; the bugs that actually cost review cycles in PRs 8 and 15 were
+*protocol* bugs between concurrent parties — admission windows vs the
+KV page budget, spec-branch cancellation vs in-flight write-backs,
+prefill-lane cadence vs an adversarial arrival order.  This module is
+the checker for that class, in the style of Holzmann's SPIN: a protocol
+is a guarded-command state machine (:class:`ProtoModel`), the checker
+enumerates every reachable state of a bounded instance by BFS (so
+counterexamples are *shortest*), and reports:
+
+- **invariant** violations — a reachable state where a safety predicate
+  fails (checked per state; ``terminal_invariants`` only on quiesced
+  states, e.g. "pages-in-use == 0 at end of run");
+- **deadlock** — a reachable non-terminal state with no enabled action;
+- **circular-wait** — a cycle in the model's resource-allocation graph
+  (``waits_for``), the lockdep-style acquire/hold analysis that catches
+  budget deadlocks even when a timeout would mask the hang;
+- **starvation** — a fair lasso: a reachable cycle along which a lane
+  stays ``pending`` and no ``progress`` action ever fires, that weak
+  fairness cannot rule out (an action enabled at *every* state of the
+  cycle must fire on it; intermittently-enabled actions may be starved
+  forever — exactly how interleave<=1 starved the prefill lane).
+
+Every finding carries a rendered counterexample trace (init state,
+action per step, violating state) in the ``LintReport`` house style.
+Models live in analysis/protomodels.py; trace-refinement against the
+live engines in analysis/conformance.py.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import (Any, Callable, Dict, Iterable, List, Optional,
+                    Sequence, Set, Tuple)
+
+from .lint import ERROR, NOTE, WARNING  # shared severity vocabulary
+
+State = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class Action:
+    """One guarded command: ``guard(state) -> bool`` and
+    ``effect(state) -> state | [state, ...]`` (the effect receives a
+    private copy and may mutate it; returning a list models internal
+    nondeterminism).  ``fair=True`` declares weak fairness: a run that
+    keeps the action continuously enabled must eventually take it
+    (scheduler/worker steps are fair; environment arrivals are not)."""
+    name: str
+    guard: Callable[[State], bool]
+    effect: Callable[[State], Any]
+    fair: bool = False
+
+
+@dataclass(frozen=True)
+class Liveness:
+    """Starvation-freedom spec: while ``pending(state)`` holds, some
+    action in ``progress`` must eventually fire (under weak fairness
+    of the model's ``fair`` actions)."""
+    name: str
+    pending: Callable[[State], bool]
+    progress: frozenset
+
+
+@dataclass
+class ProtoModel:
+    """A protocol as a guarded-command state machine."""
+    name: str
+    init: Callable[[], Any]                    # state dict or list of them
+    actions: List[Action]
+    invariants: List[Tuple[str, Callable[[State], bool]]] = \
+        field(default_factory=list)
+    terminal: Optional[Callable[[State], bool]] = None
+    terminal_invariants: List[Tuple[str, Callable[[State], bool]]] = \
+        field(default_factory=list)
+    # resource-allocation graph: waits_for(state) -> [(waiter, holder)]
+    waits_for: Optional[Callable[[State], List[Tuple[str, str]]]] = None
+    liveness: List[Liveness] = field(default_factory=list)
+    # optional compact state renderer for counterexample traces
+    render: Optional[Callable[[State], str]] = None
+
+    def render_state(self, s: State) -> str:
+        if self.render is not None:
+            return self.render(s)
+        return " ".join(f"{k}={s[k]!r}" for k in sorted(s))
+
+
+@dataclass
+class ProtoFinding:
+    """One protocol violation with its counterexample trace."""
+    rule: str
+    severity: str
+    model: str
+    message: str
+    trace: List[str] = field(default_factory=list)
+
+    def __str__(self) -> str:
+        head = f"[{self.severity}] {self.rule}: {self.message}"
+        if not self.trace:
+            return head
+        return head + "\n" + "\n".join(f"    {ln}" for ln in self.trace)
+
+
+@dataclass
+class ProtoReport:
+    """All findings of one check() run plus exploration statistics."""
+    model: str
+    findings: List[ProtoFinding] = field(default_factory=list)
+    states: int = 0
+    transitions: int = 0
+    elapsed_s: float = 0.0
+    truncated: bool = False
+    liveness_checked: bool = True
+
+    @property
+    def errors(self) -> List[ProtoFinding]:
+        return [f for f in self.findings if f.severity == ERROR]
+
+    @property
+    def warnings(self) -> List[ProtoFinding]:
+        return [f for f in self.findings if f.severity == WARNING]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def by_rule(self, rule: str) -> List[ProtoFinding]:
+        return [f for f in self.findings if f.rule == rule]
+
+    def summary(self) -> str:
+        parts = [f"{self.model}: {self.states} states",
+                 f"{self.transitions} transitions",
+                 f"{len(self.errors)} errors",
+                 f"{len(self.warnings)} warnings"]
+        if self.truncated:
+            parts.append("TRUNCATED (--bound; liveness skipped)")
+        return "; ".join(parts)
+
+    def __str__(self) -> str:
+        lines = [self.summary()]
+        lines.extend(f"  {f}" for f in self.findings)
+        return "\n".join(lines)
+
+
+def _freeze(v: Any) -> Any:
+    """Canonical hashable form of a state value (dict insertion order
+    and list/set identity must not split equivalent states)."""
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, (set, frozenset)):
+        return tuple(sorted((_freeze(x) for x in v), key=repr))
+    return v
+
+
+def _copy_state(s: State) -> State:
+    out = {}
+    for k, v in s.items():
+        if isinstance(v, list):
+            v = list(v)
+        elif isinstance(v, dict):
+            v = dict(v)
+        elif isinstance(v, set):
+            v = set(v)
+        out[k] = v
+    return out
+
+
+def _rag_cycle(edges: Iterable[Tuple[str, str]]) -> Optional[List[str]]:
+    """First cycle in a waits-for digraph, as the node sequence
+    ``[a, b, ..., a]`` — or None."""
+    adj: Dict[str, List[str]] = {}
+    for u, v in edges:
+        adj.setdefault(u, []).append(v)
+    color: Dict[str, int] = {}            # 0 absent / 1 on stack / 2 done
+    stack: List[str] = []
+
+    def dfs(u: str) -> Optional[List[str]]:
+        color[u] = 1
+        stack.append(u)
+        for v in adj.get(u, ()):
+            c = color.get(v, 0)
+            if c == 1:
+                return stack[stack.index(v):] + [v]
+            if c == 0:
+                cyc = dfs(v)
+                if cyc is not None:
+                    return cyc
+        stack.pop()
+        color[u] = 2
+        return None
+
+    for node in list(adj):
+        if color.get(node, 0) == 0:
+            cyc = dfs(node)
+            if cyc is not None:
+                return cyc
+    return None
+
+
+def _sccs(nodes: Set[int],
+          edges: Sequence[Tuple[int, str, int]]) -> List[List[int]]:
+    """Strongly connected components (iterative Tarjan) of the subgraph
+    on ``nodes`` with the given labeled edges."""
+    adj: Dict[int, List[int]] = {n: [] for n in nodes}
+    for u, _a, v in edges:
+        adj[u].append(v)
+    index: Dict[int, int] = {}
+    low: Dict[int, int] = {}
+    on: Set[int] = set()
+    stack: List[int] = []
+    out: List[List[int]] = []
+    counter = [0]
+    for root in nodes:
+        if root in index:
+            continue
+        work: List[Tuple[int, int]] = [(root, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on.add(node)
+            advanced = False
+            children = adj[node]
+            while pi < len(children):
+                child = children[pi]
+                pi += 1
+                if child not in index:
+                    work[-1] = (node, pi)
+                    work.append((child, 0))
+                    advanced = True
+                    break
+                if child in on:
+                    low[node] = min(low[node], index[child])
+            if advanced:
+                continue
+            work.pop()
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                out.append(comp)
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+    return out
+
+
+class _Search:
+    """One BFS exploration: states, parent pointers (shortest traces),
+    and the labeled transition relation for liveness analysis."""
+
+    def __init__(self, model: ProtoModel, bound: int):
+        self.model = model
+        self.bound = max(int(bound), 1)
+        self.states: List[State] = []
+        self.index: Dict[Any, int] = {}
+        self.parent: List[Optional[Tuple[int, str]]] = []
+        self.edges: List[Tuple[int, str, int]] = []
+        self.truncated = False
+
+    def intern(self, s: State) -> Tuple[Optional[int], bool]:
+        """(index, is_new) — index is None when the state bound is hit."""
+        key = _freeze(s)
+        idx = self.index.get(key)
+        if idx is not None:
+            return idx, False
+        if len(self.states) >= self.bound:
+            self.truncated = True
+            return None, False
+        idx = len(self.states)
+        self.index[key] = idx
+        self.states.append(s)
+        self.parent.append(None)
+        return idx, True
+
+    def trace_to(self, idx: int,
+                 tail: Optional[Sequence[str]] = None) -> List[str]:
+        """Rendered shortest path init -> states[idx] (+ optional tail
+        lines, e.g. the lasso cycle of a starvation witness)."""
+        hops: List[Tuple[str, int]] = []
+        cur: Optional[int] = idx
+        while cur is not None:
+            link = self.parent[cur]
+            if link is None:
+                break
+            pidx, action = link
+            hops.append((action, cur))
+            cur = pidx
+        hops.reverse()
+        rs = self.model.render_state
+        lines = [f"init: {rs(self.states[cur])}"]
+        for action, sidx in hops:
+            lines.append(f"-> {action}: {rs(self.states[sidx])}")
+        if tail:
+            lines.extend(tail)
+        return lines
+
+
+def check(model: ProtoModel, bound: int = 20000,
+          check_liveness: bool = True) -> ProtoReport:
+    """Exhaustively explore ``model`` up to ``bound`` states and return
+    a :class:`ProtoReport`.  One finding per rule (the BFS order makes
+    it a shortest counterexample); exploration continues after a
+    violation so one run surfaces every violated property."""
+    t0 = time.perf_counter()
+    report = ProtoReport(model=model.name)
+    search = _Search(model, bound)
+
+    inits = model.init()
+    if isinstance(inits, dict):
+        inits = [inits]
+    queue: deque = deque()
+    for s in inits:
+        idx, fresh = search.intern(s)
+        if idx is not None and fresh:
+            queue.append(idx)
+
+    seen_rules: Set[str] = set()
+
+    def add(rule: str, severity: str, message: str, idx: int,
+            tail: Optional[Sequence[str]] = None) -> None:
+        if rule in seen_rules:
+            return
+        seen_rules.add(rule)
+        report.findings.append(ProtoFinding(
+            rule=rule, severity=severity, model=model.name,
+            message=message, trace=search.trace_to(idx, tail)))
+
+    while queue:
+        idx = queue.popleft()
+        s = search.states[idx]
+
+        for inv_name, pred in model.invariants:
+            if not pred(s):
+                add(f"invariant:{inv_name}", ERROR,
+                    f"reachable state violates invariant {inv_name!r}",
+                    idx)
+
+        if model.waits_for is not None:
+            cyc = _rag_cycle(model.waits_for(s))
+            if cyc is not None:
+                add("circular-wait", ERROR,
+                    "cycle in the resource-allocation graph: "
+                    + " -> ".join(cyc), idx)
+
+        is_terminal = bool(model.terminal(s)) if model.terminal else False
+        if is_terminal:
+            for inv_name, pred in model.terminal_invariants:
+                if not pred(s):
+                    add(f"terminal-invariant:{inv_name}", ERROR,
+                        f"quiesced state violates {inv_name!r}", idx)
+
+        n_enabled = 0
+        for action in model.actions:
+            if not action.guard(s):
+                continue
+            n_enabled += 1
+            succ = action.effect(_copy_state(s))
+            succs = succ if isinstance(succ, list) else [succ]
+            for ns in succs:
+                j, fresh = search.intern(ns)
+                if j is None:
+                    continue
+                report.transitions += 1
+                if fresh:
+                    search.parent[j] = (idx, action.name)
+                    queue.append(j)
+                search.edges.append((idx, action.name, j))
+
+        if n_enabled == 0 and not is_terminal:
+            add("deadlock", ERROR,
+                "reachable non-terminal state has no enabled action",
+                idx)
+
+    report.states = len(search.states)
+    report.truncated = search.truncated
+
+    if check_liveness and model.liveness and not search.truncated:
+        _check_liveness(model, search, add)
+    report.liveness_checked = (check_liveness and
+                               not search.truncated)
+
+    report.elapsed_s = time.perf_counter() - t0
+    return report
+
+
+def _check_liveness(model: ProtoModel, search: _Search,
+                    add: Callable[..., None]) -> None:
+    """Fair-lasso starvation search: SCCs of the pending subgraph with
+    progress edges removed; a component survives weak fairness only if
+    every fair action enabled at ALL of its states also fires inside
+    it (otherwise fairness forces an escape)."""
+    for spec in model.liveness:
+        pend = {i for i, s in enumerate(search.states)
+                if spec.pending(s)}
+        sub = [(u, a, v) for (u, a, v) in search.edges
+               if u in pend and v in pend and a not in spec.progress]
+        for comp in _sccs(pend, sub):
+            comp_set = set(comp)
+            internal = [(u, a, v) for (u, a, v) in sub
+                        if u in comp_set and v in comp_set]
+            if not internal:
+                continue                       # trivial SCC, no cycle
+            labels = {a for (_u, a, _v) in internal}
+            fair_escape = False
+            for action in model.actions:
+                if not action.fair or action.name in labels:
+                    continue
+                if all(action.guard(search.states[i]) for i in comp):
+                    fair_escape = True         # fairness forces it out
+                    break
+            if fair_escape:
+                continue
+            entry = min(comp)
+            tail = _lasso_tail(model, search, entry, comp_set, internal)
+            add(f"starvation:{spec.name}", ERROR,
+                f"fair cycle keeps {spec.name!r} pending while no "
+                f"progress action ({', '.join(sorted(spec.progress))}) "
+                f"ever fires", entry, tail)
+            break
+
+
+def _lasso_tail(model: ProtoModel, search: _Search, entry: int,
+                comp: Set[int],
+                internal: Sequence[Tuple[int, str, int]]) -> List[str]:
+    """Render one cycle through ``entry`` inside the SCC."""
+    adj: Dict[int, List[Tuple[str, int]]] = {}
+    for u, a, v in internal:
+        adj.setdefault(u, []).append((a, v))
+    prev: Dict[int, Tuple[int, str]] = {}
+    dq: deque = deque([entry])
+    seen = {entry}
+    back: Optional[Tuple[int, str]] = None
+    while dq and back is None:
+        u = dq.popleft()
+        for a, v in adj.get(u, ()):
+            if v == entry:
+                back = (u, a)
+                break
+            if v not in seen:
+                seen.add(v)
+                prev[v] = (u, a)
+                dq.append(v)
+    lines = ["cycle (repeats forever):"]
+    if back is None:
+        return lines                           # defensive; SCC has cycle
+    hops: List[Tuple[str, int]] = []
+    u, a = back
+    hops.append((a, entry))
+    while u != entry:
+        pu, pa = prev[u]
+        hops.append((pa, u))
+        u = pu
+    hops.reverse()
+    rs = model.render_state
+    for action, sidx in hops:
+        lines.append(f"~> {action}: {rs(search.states[sidx])}")
+    return lines

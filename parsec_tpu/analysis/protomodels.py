@@ -1,0 +1,577 @@
+"""Protocol models for analysis/protocheck.py — the four protocols the
+runtime keeps breaking, plus the seeded "pre-fix" variants that MUST be
+caught (the regression contract for the checker itself).
+
+Each model is a bounded, faithful abstraction of the shipped code:
+
+- :func:`admission_budget` — the serving admission window
+  (``_PoolAdmission.admit``/``on_retire``, serving/runtime.py) against
+  the KV page budget (``KVPagePool``, serving/kv.py).  The seeded
+  ``release="end_of_run"`` variant is PR 15's open-loop bug: a client
+  that releases pages only at end of run deadlocks admission against
+  the budget — protocheck reports it both as a deadlock and as a
+  circular wait in the resource-allocation graph.
+- :func:`kv_lifecycle` — page refcount/COW/cancel lifecycle from
+  serving/kv.py + spec.py.  The seeded ``release="immediate"`` variant
+  is the spec write-back-after-free: cancelling a draft and releasing
+  its branch pages before the draft pool drained lets the in-flight
+  write-back land on a freed (possibly reallocated) page.
+- :func:`wfq_lanes` — the per-pool decode/prefill cadence of
+  sched/fair.py, checked against the EXACT :func:`~..sched.fair.
+  lane_choice` the scheduler runs.  The seeded ``broken_starvation``
+  variant is the pre-fix semantics (prefill served only when decode is
+  idle) — a fair lasso starves the prefill lane forever.
+- :func:`termdet_cancel` — idempotent termination detection +
+  ``Taskpool.cancel``: force-termination fires exactly once, the
+  scheduler's drop-drain decrements never push counters negative or
+  re-fire it, and a cancelled pool cannot poison a later ``wait``.
+
+The models are deliberately small (tens to a few thousand states at
+tier-1 bounds): protocol bugs here are ordering bugs, and the SPIN
+lesson is that tiny instances already contain the counterexample.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..sched.fair import lane_choice
+from .protocheck import Action, Liveness, ProtoModel
+
+# --------------------------------------------------------------------------
+# (a) admission window + on_retire + backpressure parking vs the page budget
+# --------------------------------------------------------------------------
+
+#: request lifecycle states in the admission/budget model
+_NEW, _PARKED, _ADMITTED, _RUNNING, _DONE, _REJECTED = (
+    "new", "parked", "admitted", "running", "done", "rejected")
+_SETTLED = (_DONE, _REJECTED)
+
+
+def admission_budget(n_requests: int = 3, window: int = 2, soft: int = 1,
+                     pages: int = 2, per_req: int = 1,
+                     release: str = "on_retire") -> ProtoModel:
+    """Admission window/backpressure vs the KV page budget.
+
+    ``release="on_retire"`` is the shipped protocol: a request's pages
+    return to the budget when it retires.  ``release="end_of_run"`` is
+    the PR 15 open-loop bug: every page is held until ALL requests have
+    settled — the budget drains, later requests wait on pages held by
+    finished requests whose release waits on the later requests.
+
+    Timeouts (``serving.backpressure_timeout_s``) are deliberately NOT
+    modeled: they mask the hang as rejection storms, they do not fix
+    the protocol — the model checks the protocol.
+    """
+    n, w = int(n_requests), int(window)
+
+    def init():
+        return {"req": [_NEW] * n, "inflight": 0,
+                "free": int(pages), "held": [0] * n}
+
+    actions: List[Action] = []
+
+    def mk(i: int) -> None:
+        # admit: inflight at/below the soft threshold admits at once
+        # (_PoolAdmission.admit keys backpressure on the EXISTING depth)
+        actions.append(Action(
+            f"admit(r{i})",
+            lambda s, i=i: s["req"][i] == _NEW and s["inflight"] <= soft,
+            lambda s, i=i: _set(s, i, _ADMITTED, dinflight=1)))
+        # soft window: backpressure park (bounded by the hard window)
+        actions.append(Action(
+            f"park(r{i})",
+            lambda s, i=i: (s["req"][i] == _NEW and s["inflight"] > soft
+                            and s["inflight"] + 1 <= w),
+            lambda s, i=i: _set(s, i, _PARKED)))
+        # hard window: explicit rejection, never unbounded parking
+        actions.append(Action(
+            f"reject(r{i})",
+            lambda s, i=i: (s["req"][i] == _NEW
+                            and s["inflight"] + 1 > w),
+            lambda s, i=i: _set(s, i, _REJECTED)))
+        # on_retire notifies parked waiters; they recheck the soft gate
+        actions.append(Action(
+            f"unpark(r{i})",
+            lambda s, i=i: (s["req"][i] == _PARKED
+                            and s["inflight"] <= soft),
+            lambda s, i=i: _set(s, i, _ADMITTED, dinflight=1),
+            fair=True))
+        # KV page allocation out of the shared budget
+        actions.append(Action(
+            f"alloc(r{i})",
+            lambda s, i=i: (s["req"][i] == _ADMITTED
+                            and s["free"] >= per_req),
+            lambda s, i=i: _alloc(s, i, per_req)))
+        # completion retires the admission rows (on_retire) and — in
+        # the shipped protocol — returns the pages to the budget
+        actions.append(Action(
+            f"finish(r{i})",
+            lambda s, i=i: s["req"][i] == _RUNNING,
+            lambda s, i=i: _finish(s, i, release),
+            fair=True))
+
+    for i in range(n):
+        mk(i)
+
+    if release == "end_of_run":
+        actions.append(Action(
+            "end_of_run_release",
+            lambda s: (all(r in _SETTLED for r in s["req"])
+                       and sum(s["held"]) > 0),
+            _end_run_release))
+
+    def waits_for(s) -> List[Tuple[str, str]]:
+        edges = []
+        starved = s["free"] < per_req
+        holders = [j for j in range(n) if s["held"][j] > 0]
+        for i in range(n):
+            if s["req"][i] == _ADMITTED and starved:
+                for j in holders:
+                    edges.append((f"r{i}", f"r{j}"))
+        if release == "end_of_run":
+            # a holder's pages are released by end-of-run, which waits
+            # on every request that has not yet settled
+            for j in holders:
+                for k in range(n):
+                    if s["req"][k] not in _SETTLED and k != j:
+                        edges.append((f"r{j}", f"r{k}"))
+        return edges
+
+    return ProtoModel(
+        name=f"admission_budget[{release}]",
+        init=init,
+        actions=actions,
+        invariants=[
+            ("page-budget-conserved",
+             lambda s: s["free"] + sum(s["held"]) == pages),
+            ("budget-nonnegative", lambda s: s["free"] >= 0),
+            ("window-respected",
+             lambda s: 0 <= s["inflight"] <= w),
+        ],
+        terminal=lambda s: (all(r in _SETTLED for r in s["req"])
+                            and sum(s["held"]) == 0),
+        terminal_invariants=[
+            ("no-page-leak", lambda s: s["free"] == pages),
+            ("window-drained", lambda s: s["inflight"] == 0),
+        ],
+        waits_for=waits_for,
+        render=lambda s: (f"req={'/'.join(s['req'])} "
+                          f"inflight={s['inflight']} free={s['free']} "
+                          f"held={s['held']}"),
+    )
+
+
+def _set(s, i, st, dinflight=0):
+    s["req"][i] = st
+    s["inflight"] += dinflight
+    return s
+
+
+def _alloc(s, i, per_req):
+    s["free"] -= per_req
+    s["held"][i] += per_req
+    s["req"][i] = _RUNNING
+    return s
+
+
+def _finish(s, i, release):
+    s["req"][i] = _DONE
+    s["inflight"] -= 1                      # on_retire
+    if release == "on_retire":
+        s["free"] += s["held"][i]
+        s["held"][i] = 0
+    return s
+
+
+def _end_run_release(s):
+    s["free"] += sum(s["held"])
+    s["held"] = [0] * len(s["held"])
+    return s
+
+
+# --------------------------------------------------------------------------
+# (b) KV page refcount / COW / cancel lifecycle (serving/kv.py + spec.py)
+# --------------------------------------------------------------------------
+
+def kv_lifecycle(release: str = "after_drain") -> ProtoModel:
+    """Base request + one speculative branch over a 3-page pool.
+
+    The branch COWs the base tail page and retains the shared prefix;
+    the draft pool writes back into its branch page asynchronously.
+    ``release="after_drain"`` is the shipped ``SpecController.release``
+    protocol: branch pages are released only after the draft pool has
+    drained.  ``release="immediate"`` is the seeded pre-fix bug:
+    cancel releases the pages while a write-back is still in flight —
+    it lands on a freed (and possibly reallocated) page.
+
+    Pages: pid 0 = base prefix/tail, pids 1..2 free at init.  State
+    tracks per-pid refcounts and owners, the draft pool phase, and a
+    ``poison`` flag set when a write-back lands on a page the branch
+    no longer owns — the write-back-after-free invariant.
+    """
+    npages = 3
+
+    def init():
+        return {"refs": [1, 0, 0],          # pid -> refcount (0 = free)
+                "owner": ["base", None, None],
+                "base": "running",
+                "draft": "idle",            # idle/running/pending/done
+                "branch": None,             # branch tail pid
+                "cancelling": False,
+                "poison": None}
+
+    def free_pid(s):
+        for pid in range(npages):
+            if s["refs"][pid] == 0:
+                return pid
+        return None
+
+    def spawn(s):
+        pid = free_pid(s)
+        s["refs"][pid] = 1                  # COW copy of the base tail
+        s["owner"][pid] = "branch"
+        s["refs"][0] += 1                   # branch retains the prefix
+        s["branch"] = pid
+        s["draft"] = "running"
+        return s
+
+    def land(s):
+        pid = s["branch"]
+        if s["owner"][pid] != "branch" or s["refs"][pid] <= 0:
+            s["poison"] = pid               # write-back hit a dead page
+        s["draft"] = "running"
+        return s
+
+    def release_branch(s):
+        pid = s["branch"]
+        if s["refs"][pid] > 0:
+            s["refs"][pid] -= 1
+        if s["refs"][pid] == 0:
+            s["owner"][pid] = None
+        s["refs"][0] -= 1                   # drop the prefix retain
+        s["branch"] = None
+        s["cancelling"] = False
+        return s
+
+    actions = [
+        Action("spawn_branch",
+               lambda s: (s["draft"] == "idle" and s["base"] == "running"
+                          and s["branch"] is None
+                          and free_pid(s) is not None),
+               spawn),
+        # the draft issues an async write-back aimed at its branch page
+        Action("draft_write",
+               lambda s: s["draft"] == "running" and s["branch"] is not None,
+               lambda s: _setk(s, draft="pending")),
+        # ... which lands later, after arbitrary interleavings
+        Action("writeback_lands",
+               lambda s: s["draft"] == "pending",
+               land, fair=True),
+    ]
+
+    if release == "after_drain":
+        # shipped protocol: cancel only MARKS; pages released after the
+        # draft pool drained (SpecController.release waits on the pool)
+        actions += [
+            Action("cancel_branch",
+                   lambda s: (s["branch"] is not None
+                              and not s["cancelling"]
+                              and s["draft"] in ("running", "pending")),
+                   lambda s: _setk(s, cancelling=True)),
+            Action("draft_drained",
+                   lambda s: s["cancelling"] and s["draft"] == "running",
+                   lambda s: _setk(s, draft="done"), fair=True),
+            Action("release_after_drain",
+                   lambda s: s["cancelling"] and s["draft"] == "done",
+                   release_branch, fair=True),
+        ]
+    else:
+        # seeded pre-fix bug: release the branch pages NOW, with the
+        # write-back still in flight
+        actions.append(Action(
+            "cancel_release_immediate",
+            lambda s: (s["branch"] is not None
+                       and s["owner"][s["branch"]] == "branch"
+                       and s["draft"] in ("running", "pending")),
+            lambda s: release_branch_keep_tail(s)))
+
+        def release_branch_keep_tail(s):
+            # same page release, but the draft still targets the pid
+            pid = s["branch"]
+            if s["refs"][pid] > 0:
+                s["refs"][pid] -= 1
+            if s["refs"][pid] == 0:
+                s["owner"][pid] = None
+            s["refs"][0] -= 1
+            s["cancelling"] = False
+            # branch pid kept: the in-flight write-back still aims here
+            return s
+
+        # a freed page is immediately reusable by another request —
+        # making the landing write a cross-request corruption
+        def realloc(s):
+            for pid in range(1, npages):
+                if s["refs"][pid] == 0 and s["owner"][pid] is None:
+                    s["refs"][pid] = 1
+                    s["owner"][pid] = "other"
+                    break
+            return s
+
+        actions.append(Action(
+            "realloc_freed_page",
+            lambda s: any(s["refs"][p] == 0 for p in range(1, npages))
+            and s["branch"] is not None and s["owner"][s["branch"]] is None,
+            realloc))
+
+    def branch_resolved(s):
+        if release == "after_drain":
+            return s["branch"] is None
+        # seeded variant: branch pid is kept for the in-flight write;
+        # resolved once the draft has no write pending
+        return s["branch"] is None or (s["owner"][s["branch"]] != "branch"
+                                       and s["draft"] != "pending")
+
+    actions.append(Action(
+        "base_finish",
+        lambda s: (s["base"] == "running" and branch_resolved(s)
+                   and s["draft"] in ("idle", "done", "running")
+                   and not s["cancelling"]),
+        lambda s: _base_finish(s)))
+
+    return ProtoModel(
+        name=f"kv_lifecycle[{release}]",
+        init=init,
+        actions=actions,
+        invariants=[
+            ("no-write-after-free", lambda s: s["poison"] is None),
+            ("refs-nonnegative",
+             lambda s: all(r >= 0 for r in s["refs"])),
+            ("free-has-no-owner",
+             lambda s: all((r > 0) == (o is not None)
+                           for r, o in zip(s["refs"], s["owner"]))),
+        ],
+        terminal=lambda s: (s["base"] == "released"
+                            and s["branch"] is None
+                            and s["draft"] in ("idle", "done")),
+        terminal_invariants=[
+            ("pages-in-use-zero", lambda s: sum(s["refs"]) == 0),
+        ],
+        render=lambda s: (f"refs={s['refs']} owner={s['owner']} "
+                          f"base={s['base']} draft={s['draft']} "
+                          f"branch={s['branch']} "
+                          f"cancelling={s['cancelling']} "
+                          f"poison={s['poison']}"),
+    )
+
+
+def _setk(s, **kw):
+    s.update(kw)
+    return s
+
+
+def _base_finish(s):
+    s["refs"][0] -= 1
+    if s["refs"][0] == 0:
+        s["owner"][0] = None
+    s["base"] = "released"
+    return s
+
+
+# --------------------------------------------------------------------------
+# (c) wfq decode/prefill lane cadence (sched/fair.py)
+# --------------------------------------------------------------------------
+
+def _broken_lane_choice(ndq: int, npq: int, nsel: int,
+                        interleave: int) -> str:
+    """Pre-fix semantics: prefill served only when decode is idle —
+    an open-loop decode arrival stream starves prefill forever."""
+    return "prefill" if not ndq else "decode"
+
+
+def wfq_lanes(interleave: int = 4, dmax: int = 2, pmax: int = 2,
+              choice=lane_choice) -> ProtoModel:
+    """One wfq pool's two lanes under adversarial (unfair) arrivals.
+
+    The serve actions are mutually exclusive and deterministic given
+    the state — the guard IS :func:`parsec_tpu.sched.fair.lane_choice`,
+    the function ``WFQScheduler.select`` executes, so the model cannot
+    drift from the implementation.  Serves are weakly fair (the worker
+    loop runs whenever work is queued); arrivals are not (the client
+    owes the runtime nothing).  Starvation-freedom of BOTH lanes is
+    the property; ``nsel`` is tracked modulo the cadence.
+    """
+    cadence = max(int(interleave), 2)
+
+    def init():
+        return {"dq": 0, "pq": 0, "nsel": 0}
+
+    def serve(s, lane):
+        s["dq" if lane == "decode" else "pq"] -= 1
+        s["nsel"] = (s["nsel"] + 1) % cadence
+        return s
+
+    actions = [
+        Action("arrive_decode",
+               lambda s: s["dq"] < dmax,
+               lambda s: _setk(s, dq=s["dq"] + 1)),
+        Action("arrive_prefill",
+               lambda s: s["pq"] < pmax,
+               lambda s: _setk(s, pq=s["pq"] + 1)),
+        Action("serve_decode",
+               lambda s: (s["dq"] + s["pq"] > 0 and
+                          choice(s["dq"], s["pq"], s["nsel"] + 1,
+                                 interleave) == "decode"),
+               lambda s: serve(s, "decode"), fair=True),
+        Action("serve_prefill",
+               lambda s: (s["dq"] + s["pq"] > 0 and
+                          choice(s["dq"], s["pq"], s["nsel"] + 1,
+                                 interleave) == "prefill"),
+               lambda s: serve(s, "prefill"), fair=True),
+    ]
+
+    return ProtoModel(
+        name=f"wfq_lanes[interleave={interleave}]",
+        init=init,
+        actions=actions,
+        invariants=[
+            ("lanes-nonnegative",
+             lambda s: s["dq"] >= 0 and s["pq"] >= 0),
+        ],
+        # no terminal: an idle pool always accepts arrivals
+        liveness=[
+            Liveness("prefill-lane", lambda s: s["pq"] > 0,
+                     frozenset({"serve_prefill"})),
+            Liveness("decode-lane", lambda s: s["dq"] > 0,
+                     frozenset({"serve_decode"})),
+        ],
+        render=lambda s: (f"dq={s['dq']} pq={s['pq']} "
+                          f"nsel%{cadence}={s['nsel']}"),
+    )
+
+
+# --------------------------------------------------------------------------
+# (d) idempotent termdet + Taskpool.cancel vs a later wait
+# --------------------------------------------------------------------------
+
+def termdet_cancel(n_tasks: int = 2) -> ProtoModel:
+    """Pool A is cancelled mid-flight while pool B runs normally; a
+    context waiter waits on both.  The idempotent-termination contract:
+    force-termination on cancel fires termdet exactly once, the
+    scheduler's drop-drain decrements (``_drop_cancelled_locked``)
+    reconcile the task counter without re-firing it or driving it
+    negative, and the waiter completes — a cancelled pool can neither
+    hang nor poison a later ``wait``.
+    """
+    n = int(n_tasks)
+
+    def init():
+        return {"nA": n, "qA": n, "cancelledA": False, "termA": 0,
+                "nB": 1, "qB": 1, "termB": 0,
+                "waiter": "waiting"}
+
+    def run_a(s):
+        s["qA"] -= 1
+        s["nA"] -= 1
+        if s["nA"] == 0 and s["termA"] == 0:
+            s["termA"] = 1
+        return s
+
+    def cancel_a(s):
+        s["cancelledA"] = True
+        if s["termA"] == 0:                  # force-terminate, once
+            s["termA"] = 1
+        return s
+
+    def drop_a(s):
+        # idempotent contract: drain the counter, never re-terminate
+        s["qA"] -= 1
+        s["nA"] -= 1
+        if s["nA"] == 0 and s["termA"] == 0:
+            s["termA"] = 1
+        return s
+
+    def run_b(s):
+        s["qB"] -= 1
+        s["nB"] -= 1
+        if s["nB"] == 0 and s["termB"] == 0:
+            s["termB"] = 1
+        return s
+
+    actions = [
+        Action("run_A",
+               lambda s: s["qA"] > 0 and not s["cancelledA"],
+               run_a, fair=True),
+        Action("cancel_A",
+               lambda s: not s["cancelledA"],
+               cancel_a),
+        Action("drop_A",
+               lambda s: s["cancelledA"] and s["qA"] > 0,
+               drop_a, fair=True),
+        Action("run_B",
+               lambda s: s["qB"] > 0,
+               run_b, fair=True),
+        Action("wait_returns",
+               lambda s: (s["waiter"] == "waiting" and s["termA"] >= 1
+                          and s["termB"] >= 1 and s["qA"] == 0
+                          and s["qB"] == 0),
+               lambda s: _setk(s, waiter="done"), fair=True),
+    ]
+
+    return ProtoModel(
+        name="termdet_cancel",
+        init=init,
+        actions=actions,
+        invariants=[
+            ("counters-nonnegative",
+             lambda s: s["nA"] >= 0 and s["qA"] >= 0 and s["nB"] >= 0),
+            ("termdet-idempotent",
+             lambda s: s["termA"] <= 1 and s["termB"] <= 1),
+        ],
+        terminal=lambda s: s["waiter"] == "done",
+        terminal_invariants=[
+            ("pools-reconciled",
+             lambda s: s["nA"] == 0 and s["nB"] == 0),
+            ("termdet-fired-once",
+             lambda s: s["termA"] == 1 and s["termB"] == 1),
+        ],
+        render=lambda s: (f"A(n={s['nA']} q={s['qA']} "
+                          f"cancelled={s['cancelledA']} term={s['termA']}) "
+                          f"B(n={s['nB']} term={s['termB']}) "
+                          f"waiter={s['waiter']}"),
+    )
+
+
+# --------------------------------------------------------------------------
+# registries
+# --------------------------------------------------------------------------
+
+#: current-protocol models — the zero-violation contract at tier-1 bounds
+MODELS: Dict[str, object] = {
+    "admission": admission_budget,
+    "kv_lifecycle": kv_lifecycle,
+    "wfq_lanes": wfq_lanes,
+    "termdet": termdet_cancel,
+}
+
+#: seeded pre-fix variants -> (factory, rule prefix protocheck MUST report)
+SEEDED: Dict[str, Tuple[object, str]] = {
+    "budget_deadlock": (
+        lambda: admission_budget(release="end_of_run"), "deadlock"),
+    "budget_circular_wait": (
+        lambda: admission_budget(release="end_of_run"), "circular-wait"),
+    "spec_writeback_after_free": (
+        lambda: kv_lifecycle(release="immediate"),
+        "invariant:no-write-after-free"),
+    "prefill_starvation": (
+        lambda: wfq_lanes(interleave=1, choice=_broken_lane_choice),
+        "starvation:prefill-lane"),
+}
+
+
+def build(name: str, **kw) -> ProtoModel:
+    """Instantiate a registered current-protocol model by name."""
+    if name not in MODELS:
+        raise KeyError(f"unknown protocol model {name!r}; have "
+                       f"{', '.join(sorted(MODELS))}")
+    return MODELS[name](**kw)

@@ -351,6 +351,10 @@ class Trace:
                                               "nbytes": "int"})
         self.add_keyword("admission", info_schema={"rid": "str"})
         self.add_keyword("req", info_schema={"rid": "str"})
+        # KV page lifecycle (alloc/retain/release/free/cow/write) —
+        # consumed by analysis/conformance.py for model replay
+        self.add_keyword("kvpage", info_schema={"pool": "str",
+                                                "refs": "int"})
         context.trace = self
         self.rank = context.my_rank
         from .spans import _RANK_SHIFT

@@ -47,6 +47,28 @@ mca_param.register("serving.kv_prefill_interleave", 4,
                         "no decode preference)")
 
 
+def lane_choice(ndq: int, npq: int, nsel: int, interleave: int) -> str:
+    """Pure per-pool lane-selection semantics of :meth:`select` —
+    which lane ("decode" | "prefill") serves the pool's next slot,
+    given the lane backlogs, the pool's selection counter AFTER its
+    increment, and ``serving.kv_prefill_interleave``.
+
+    Factored out so the protocol models (analysis/protomodels.py) check
+    the EXACT function the scheduler runs: when both lanes are
+    backlogged the prefill lane gets every Nth slot of the pool's
+    service — long prompts make progress, decode keeps its p99 —
+    and ``interleave<=1`` clamps to strict alternation ("no decode
+    preference"), never starvation.
+    """
+    if not ndq:
+        return "prefill"
+    if not npq:
+        return "decode"
+    if nsel % max(interleave, 2) == 0:
+        return "prefill"
+    return "decode"
+
+
 class _PoolQueue:
     __slots__ = ("dq", "pq", "nsel", "vpass", "enqueued", "selected",
                  "last_selected_t")
@@ -154,19 +176,10 @@ class WFQScheduler(Scheduler):
                     if best_q is None:
                         return None
                     best_q.nsel += 1
-                    if not best_q.dq:
-                        task = best_q.pq.popleft()
-                    elif not best_q.pq:
-                        task = best_q.dq.popleft()
-                    elif best_q.nsel % max(interleave, 2) == 0:
-                        # both lanes backlogged: the prefill lane gets
-                        # every Nth slot of the pool's service — long
-                        # prompts make progress, decode keeps its p99.
-                        # interleave<=1 clamps to strict alternation
-                        # ("no decode preference"), never starvation
-                        task = best_q.pq.popleft()
-                    else:
-                        task = best_q.dq.popleft()
+                    lane = lane_choice(len(best_q.dq), len(best_q.pq),
+                                       best_q.nsel, interleave)
+                    task = (best_q.pq if lane == "prefill"
+                            else best_q.dq).popleft()
                     if best_q.vpass > self._vclock:
                         self._vclock = best_q.vpass
                     w = max(float(getattr(best_tp, "fair_weight", 1.0)),

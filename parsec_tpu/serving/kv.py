@@ -141,9 +141,34 @@ class KVPagePool:
         # reclaim callback installed by the radix tree: called (n
         # pages wanted) under pressure; returns pages actually freed
         self._reclaim: Optional[Callable[[int], int]] = None
+        # trace source installed by KVStateLayer: () -> Trace | None.
+        # Page lifecycle ops (alloc/retain/release/free/cow/write) are
+        # recorded as "kvpage" events so analysis/conformance.py can
+        # replay the run through the kv_lifecycle protocol model
+        self._trace_src: Optional[Callable[[], object]] = None
         self.stats = {"allocs": 0, "frees": 0, "cow_copies": 0,
                       "evict_reclaims": 0, "peak_in_use": 0,
                       "exhausted": 0}
+
+    def set_trace_source(self, src: Optional[Callable[[], object]]) -> None:
+        """Install the trace lookup (evaluated per op, so a trace
+        installed after layer creation is still picked up)."""
+        self._trace_src = src
+
+    def _emit(self, op: str, pid: int, refs: Optional[int] = None,
+              src: Optional[int] = None) -> None:
+        fn = self._trace_src
+        if fn is None:
+            return
+        tr = fn()
+        if tr is None:
+            return
+        info = {"pool": self.name}
+        if refs is not None:
+            info["refs"] = refs
+        if src is not None:
+            info["src"] = src
+        tr.event("kvpage", op, object_id=pid, info=info)
 
     # ----------------------------------------------------------- internal
     def _hbm_key(self, pid: int):
@@ -154,6 +179,7 @@ class KVPagePool:
         return ("kvpage", id(self), pid)
 
     def _on_page_write(self, pid: int, value) -> None:
+        self._emit("write", pid)
         hbm = self.hbm
         if hbm is None:
             return
@@ -188,6 +214,7 @@ class KVPagePool:
     def _free_locked(self, pid: int) -> None:
         self._refs.pop(pid, None)
         self._free.append(pid)
+        self._emit("free", pid, refs=0)
         self.stats["frees"] += 1
         self.dc.drop_tile((pid,))
         if self.hbm is not None:
@@ -233,6 +260,7 @@ class KVPagePool:
                 self._refs[pid] = 1
                 self.stats["allocs"] += 1
                 out.append(pid)
+                self._emit("alloc", pid, refs=1)
                 self.dc.write_tile((pid,), self._fresh_page())
             used = self.pages_in_use()
             if used > self.stats["peak_in_use"]:
@@ -244,6 +272,7 @@ class KVPagePool:
             if pid not in self._refs:
                 raise KeyError(f"retain of freed page {pid}")
             self._refs[pid] += n
+            self._emit("retain", pid, refs=self._refs[pid])
 
     def release(self, pid: int) -> None:
         """Drop one reference; the last one returns the page to the
@@ -254,7 +283,9 @@ class KVPagePool:
                 return                # idempotent: already freed
             if refs > 1:
                 self._refs[pid] = refs - 1
+                self._emit("release", pid, refs=refs - 1)
             else:
+                self._emit("release", pid, refs=0)
                 self._free_locked(pid)
 
     def cow(self, pid: int) -> int:
@@ -269,6 +300,7 @@ class KVPagePool:
         self.dc.write_tile((new,), np.array(src, copy=True))
         with self._lock:
             self.stats["cow_copies"] += 1
+        self._emit("cow", new, src=pid)
         return new
 
     def refs(self, pid: int) -> int:
@@ -563,6 +595,10 @@ class KVStateLayer:
                       "spec_cancelled_branches": 0}
         if ctx is not None:
             ctx.kv_state = self
+            # conformance plumbing: page lifecycle events flow into the
+            # context trace (when one is installed) for model replay
+            self.pool.set_trace_source(
+                lambda: getattr(ctx, "trace", None))
 
     # ------------------------------------------------------------ lookup
     def match(self, tokens: Sequence[int]) -> MatchHandle:

@@ -193,11 +193,21 @@ class _PoolAdmission:
             ten.inflight += n
             self.admitted += n
             ten.stats["rows_admitted"] += n
+            inflight_now = ten.inflight
             if park_t0 is not None:
                 # admission-park counter: one of the autoscaler's
                 # scale-up signals (serving/elastic.py) — parks piling
                 # up mean the tenant windows are the bottleneck
                 ten.stats["parked"] += 1
+        tr = self.runtime.ctx.trace
+        if tr is not None:
+            # admission protocol event (analysis/conformance.py replays
+            # these through the admission_budget model): rows admitted,
+            # depth after, and the window the decision was made against
+            tr.event("admission", "admit", object_id=tp.name,
+                     info={"tenant": ten.name, "rows": n,
+                           "inflight": inflight_now,
+                           "window": ten.window, "soft": ten.soft})
         if park_t0 is not None:
             self.runtime._bump("parked")
             self._record_park(tp, ten, park_t0, n)
@@ -230,8 +240,14 @@ class _PoolAdmission:
             self.retired += 1
             ten.inflight -= 1
             ten.stats["rows_retired"] += 1
+            inflight_now = ten.inflight
             if ten._waiters:
                 ten.cv.notify_all()
+        tr = self.runtime.ctx.trace
+        if tr is not None:
+            tr.event("admission", "retire", object_id=_tp.name,
+                     info={"tenant": ten.name, "rows": 1,
+                           "inflight": inflight_now})
 
     def close(self) -> None:
         ten = self.tenant
@@ -242,7 +258,17 @@ class _PoolAdmission:
             residue = self.admitted - self.retired
             if residue > 0:
                 ten.inflight -= residue
+            inflight_now = ten.inflight
             ten.cv.notify_all()
+        if residue > 0:
+            tr = self.runtime.ctx.trace
+            if tr is not None:
+                # end-of-pool residue reconciliation (cancelled pools'
+                # dropped tasks never retire) — replayed as a bulk
+                # retire by the conformance pass
+                tr.event("admission", "reconcile", object_id="close",
+                         info={"tenant": ten.name, "rows": residue,
+                               "inflight": inflight_now})
 
 
 class Submission:

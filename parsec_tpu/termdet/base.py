@@ -30,6 +30,13 @@ class TermdetMonitor:
         self._runtime_actions = 0
         self._state = TermdetState.NOT_READY
         self._on_terminated: Optional[Callable[[], None]] = None
+        # False until set_nb_tasks()/ready() closes the startup window:
+        # Context.add_taskpool publishes the pool to the comm engine
+        # BEFORE the DSL counts local tasks, so a parked remote
+        # activation delivered at registration can execute and COMPLETE
+        # a task ahead of set_nb_tasks — that decrement must carry as a
+        # deficit, not raise
+        self._counted = False
 
     # -- wiring -----------------------------------------------------------
     def monitor(self, on_terminated: Callable[[], None]) -> None:
@@ -46,8 +53,15 @@ class TermdetMonitor:
 
     def set_nb_tasks(self, n: int) -> None:
         with self._lock:
-            self._nb_tasks = n
+            # fold in completions that raced the startup enumeration
+            # (see _counted): n counts ALL local tasks, including any
+            # already completed, so the carried deficit subtracts
+            deficit = self._nb_tasks if self._nb_tasks < 0 else 0
+            self._nb_tasks = n + deficit
+            self._counted = True
             self._rearm_locked()
+            if self._nb_tasks < 0:
+                raise RuntimeError("nb_tasks went negative")
             fire = self._maybe_idle_locked()
         if fire:
             self._fire()
@@ -57,7 +71,7 @@ class TermdetMonitor:
         with self._lock:
             self._nb_tasks += d
             self._rearm_locked()
-            if self._nb_tasks < 0:
+            if self._nb_tasks < 0 and self._counted:
                 raise RuntimeError("nb_tasks went negative")
             fire = self._maybe_idle_locked()
         if fire:
@@ -90,6 +104,7 @@ class TermdetMonitor:
     def ready(self) -> None:
         """Transition NOT_READY → BUSY (taskpool fully constructed)."""
         with self._lock:
+            self._counted = True     # startup window closed either way
             if self._state == TermdetState.NOT_READY:
                 self._state = TermdetState.BUSY
             fire = self._maybe_idle_locked()

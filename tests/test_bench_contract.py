@@ -607,3 +607,32 @@ def test_native_dfsan_guard_fires_on_drop_and_any_report():
     assert bench._compare_captures(
         {"tasks_per_sec_native_dfsan": 390000.0,
          "sanitize_report_count": 0}, prior) == {}
+
+
+def test_protocheck_section_registered():
+    """--section protocheck is a first-class section (ISSUE 19 bench
+    contract): registry, error keys, compact summary, and the guard
+    stay wired — states/s rides the throughput drop-guard, and the
+    section zeroes the rate when a model violates or a seeded bug goes
+    uncaught, so the same guard doubles as the contract alarm."""
+    bench = _load_bench()
+    assert "protocheck" in bench.SECTIONS
+    assert bench._SECTION_KEYS["protocheck"] == ("protocheck",)
+    assert "protocheck_states_per_sec" in bench._GFLOPS_GUARD_KEYS
+    result = _fat_result()
+    result["detail"]["extra_configs"]["protocheck"] = {
+        "states_per_sec": 39479.4, "states": 579, "transitions": 1482,
+        "seeded_caught": 4, "seeded_total": 4, "clean": True}
+    compact = json.loads(bench._compact_summary(result))
+    assert compact["detail"]["protocheck_states_per_sec"] == 39479.4
+    assert compact["detail"]["protocheck_seeded_caught"] == 4
+
+
+def test_protocheck_guard_fires_on_rate_drop():
+    bench = _load_bench()
+    prior = {"protocheck_states_per_sec": 39000.0}
+    out = bench._compare_captures(
+        {"protocheck_states_per_sec": 0.0}, prior)  # contract broke
+    assert "protocheck_states_per_sec" in out["throughput_regression"]
+    assert bench._compare_captures(
+        {"protocheck_states_per_sec": 38000.0}, prior) == {}

@@ -1,0 +1,395 @@
+"""Protocol checker + conformance (ISSUE 19, tier-1).
+
+Four lanes:
+
+- checker engine unit tests on tiny hand-rolled models (invariant
+  counterexamples are shortest, deadlock detection, the weak-fairness
+  filter on starvation lassos);
+- the four shipped protocol models pass CLEAN at tier-1 bounds, and the
+  wfq model is checked against the EXACT ``lane_choice`` the scheduler
+  executes, across interleave settings;
+- the three seeded historical bug shapes (PR 15's end-of-run budget
+  deadlock, the spec write-back-after-free, pre-fix prefill starvation)
+  MUST be flagged with human-readable counterexample traces;
+- conformance: synthetic event streams replayed through the models
+  (first non-refining step pinpointed), plus the live smoke — a traced
+  serving decode run whose ring events replay with ZERO non-refining
+  steps through the admission + KV-refcount models.
+
+The full-bound sweep lives behind ``-m slow``; tier-1 runs the bounded
+instances only (< 30 s total).
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from parsec_tpu.analysis import conformance, protomodels
+from parsec_tpu.analysis.protocheck import (Action, Liveness, ProtoModel,
+                                            check)
+from parsec_tpu.sched.fair import lane_choice
+
+BOUND = 20000
+
+
+# ---------------------------------------------------------------------------
+# checker engine
+# ---------------------------------------------------------------------------
+
+def _counter_model(limit=3, inv=None, terminal=None):
+    return ProtoModel(
+        name="counter",
+        init=lambda: {"x": 0},
+        actions=[Action("inc", lambda s: s["x"] < limit,
+                        lambda s: dict(s, x=s["x"] + 1))],
+        invariants=inv or [],
+        terminal=terminal)
+
+
+def test_invariant_counterexample_is_shortest():
+    rep = check(_counter_model(
+        limit=5, inv=[("x-small", lambda s: s["x"] < 3)]), bound=BOUND)
+    assert not rep.ok
+    [f] = rep.by_rule("invariant:x-small")
+    # BFS: the violating state x=3 is reached in exactly 3 steps
+    assert f.trace[0].startswith("init:")
+    assert len([ln for ln in f.trace if ln.startswith("->")]) == 3
+    assert "x=3" in f.trace[-1]
+
+
+def test_deadlock_detection_and_terminal_suppression():
+    # x==limit has no action: a deadlock unless declared terminal
+    rep = check(_counter_model(limit=2), bound=BOUND)
+    assert [f.rule for f in rep.errors] == ["deadlock"]
+    rep = check(_counter_model(
+        limit=2, terminal=lambda s: s["x"] == 2), bound=BOUND)
+    assert rep.ok and rep.states == 3
+
+
+def test_terminal_invariants_only_checked_on_terminal_states():
+    m = _counter_model(limit=2, terminal=lambda s: s["x"] == 2)
+    m.terminal_invariants = [("x-even", lambda s: s["x"] % 2 == 0)]
+    assert check(m, bound=BOUND).ok
+    m.terminal_invariants = [("x-odd", lambda s: s["x"] % 2 == 1)]
+    rep = check(m, bound=BOUND)
+    assert rep.by_rule("terminal-invariant:x-odd")
+
+
+def test_bound_truncation_is_loud_and_skips_liveness():
+    rep = check(_counter_model(limit=100), bound=10)
+    assert rep.truncated
+    assert not rep.liveness_checked
+    assert "TRUNCATED" in rep.summary()
+
+
+def _lasso_model(fair_escape_everywhere):
+    """Two-state ping/pong staying 'pending' forever; an 'exit' action
+    is weakly fair — enabled at BOTH cycle states (fairness forces the
+    escape: no starvation) or at only one (fairness can be dodged:
+    starvation)."""
+    return ProtoModel(
+        name="lasso",
+        init=lambda: {"p": 0, "out": False},
+        actions=[
+            Action("ping", lambda s: not s["out"] and s["p"] == 0,
+                   lambda s: dict(s, p=1)),
+            Action("pong", lambda s: not s["out"] and s["p"] == 1,
+                   lambda s: dict(s, p=0)),
+            Action("exit",
+                   lambda s: not s["out"] and (
+                       fair_escape_everywhere or s["p"] == 0),
+                   lambda s: dict(s, out=True), fair=True),
+        ],
+        terminal=lambda s: s["out"],
+        liveness=[Liveness("escape", lambda s: not s["out"],
+                           frozenset({"exit"}))])
+
+
+def test_weak_fairness_filter_on_starvation_lassos():
+    # enabled at every cycle state -> fairness forces the exit: clean
+    assert check(_lasso_model(True), bound=BOUND).ok
+    # intermittently enabled -> a fair run can still starve: flagged
+    rep = check(_lasso_model(False), bound=BOUND)
+    [f] = rep.by_rule("starvation:escape")
+    assert any("cycle (repeats forever):" in ln for ln in f.trace)
+
+
+# ---------------------------------------------------------------------------
+# shipped protocol models: the zero-violation contract
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(protomodels.MODELS))
+def test_current_models_clean_at_tier1_bounds(name):
+    rep = check(protomodels.MODELS[name](), bound=BOUND)
+    assert rep.ok, f"{name}:\n{rep}"
+    assert not rep.truncated
+    assert rep.states > 1
+
+
+@pytest.mark.parametrize("interleave", [0, 1, 2, 4, 8])
+def test_wfq_lanes_starvation_free_across_interleave(interleave):
+    """Starvation-freedom of BOTH lanes at every cadence setting,
+    including the interleave<=1 strict-alternation clamp."""
+    rep = check(protomodels.wfq_lanes(interleave=interleave),
+                bound=BOUND)
+    assert rep.ok, f"interleave={interleave}:\n{rep}"
+
+
+def test_wfq_model_checks_the_scheduler_own_semantics():
+    """The model's serve guards call the EXACT lane_choice function
+    WFQScheduler.select() executes — the model cannot drift."""
+    import inspect
+    src = inspect.getsource(protomodels.wfq_lanes)
+    assert "choice=lane_choice" in src
+    assert protomodels.lane_choice is lane_choice
+    # and the pure function pins the documented semantics
+    assert lane_choice(0, 3, 1, 4) == "prefill"      # decode idle
+    assert lane_choice(3, 0, 4, 4) == "decode"       # prefill idle
+    assert lane_choice(3, 3, 4, 4) == "prefill"      # every Nth slot
+    assert lane_choice(3, 3, 3, 4) == "decode"
+    assert lane_choice(3, 3, 2, 1) == "prefill"      # <=1 clamps to 2
+    assert lane_choice(3, 3, 1, 0) == "decode"
+
+
+# ---------------------------------------------------------------------------
+# seeded historical bugs: protocheck MUST flag each with a counterexample
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(protomodels.SEEDED))
+def test_seeded_prefix_bugs_are_caught(name):
+    mk, rule = protomodels.SEEDED[name]
+    rep = check(mk(), bound=BOUND)
+    hits = [f for f in rep.errors
+            if f.rule == rule or f.rule.startswith(rule)]
+    assert hits, (f"{name}: expected {rule}, got "
+                  f"{[f.rule for f in rep.errors]}")
+    # human-readable counterexample: init line + action steps
+    f = hits[0]
+    assert f.trace and f.trace[0].startswith("init:")
+    assert any(ln.startswith(("->", "~>")) for ln in f.trace)
+
+
+def test_budget_deadlock_counterexample_shape():
+    """PR 15's bug verbatim: with end-of-run-only release, finished
+    requests hold pages, a later admitted request waits on them, and
+    the release waits on the later request — deadlock AND a cycle in
+    the resource-allocation graph."""
+    rep = check(protomodels.admission_budget(release="end_of_run"),
+                bound=BOUND)
+    dead = rep.by_rule("deadlock")
+    cyc = rep.by_rule("circular-wait")
+    assert dead and cyc
+    assert "->" in cyc[0].message          # the rendered wait cycle
+    # the deadlock trace walks through finished-but-holding requests
+    assert any("done" in ln and "held" in ln for ln in dead[0].trace)
+
+
+def test_writeback_after_free_names_the_page():
+    rep = check(protomodels.kv_lifecycle(release="immediate"),
+                bound=BOUND)
+    [f] = rep.by_rule("invariant:no-write-after-free")
+    assert any("cancel_release_immediate" in ln for ln in f.trace)
+    assert any("writeback_lands" in ln for ln in f.trace)
+    # and ONLY the write-after-free fires — the variant is not sloppy
+    assert {x.rule for x in rep.errors} == {"invariant:no-write-after-free"}
+
+
+def test_prefill_starvation_is_a_fair_lasso():
+    rep = check(protomodels.wfq_lanes(
+        interleave=1, choice=protomodels._broken_lane_choice),
+        bound=BOUND)
+    [f] = rep.by_rule("starvation:prefill-lane")
+    cycle = [ln for ln in f.trace if ln.startswith("~>")]
+    assert cycle and all("serve_prefill" not in ln for ln in cycle)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", sorted(protomodels.MODELS))
+def test_full_bound_sweep(name):
+    """Bigger instances behind the slow marker — the full-bound lane."""
+    kw = {}
+    if name == "admission":
+        kw = dict(n_requests=4, window=3, soft=2, pages=3)
+    elif name == "wfq_lanes":
+        kw = dict(interleave=8, dmax=4, pmax=4)
+    elif name == "termdet":
+        kw = dict(n_tasks=4)
+    rep = check(protomodels.MODELS[name](**kw), bound=2_000_000)
+    assert rep.ok, f"{name}:\n{rep}"
+    assert not rep.truncated
+
+
+# ---------------------------------------------------------------------------
+# conformance: synthetic streams
+# ---------------------------------------------------------------------------
+
+def _kv(phase, pid, refs=None, src=None):
+    info = {"pool": "kvtest"}
+    if refs is not None:
+        info["refs"] = refs
+    if src is not None:
+        info["src"] = src
+    return {"key": "kvpage", "phase": phase, "t": 0.0, "stream": -1,
+            "object": pid, "info": info}
+
+
+def test_conformance_kvpage_clean_stream():
+    rep = conformance.check_kvpage([
+        _kv("alloc", 0, 1), _kv("write", 0), _kv("retain", 0, 2),
+        _kv("cow", 1, None, src=0),      # cow annotation needs alloc 1st
+    ][:3] + [
+        _kv("alloc", 1, 1), _kv("cow", 1, None, src=0),
+        _kv("write", 1), _kv("release", 1, 0), _kv("free", 1, 0),
+        _kv("release", 0, 1), _kv("release", 0, 0), _kv("free", 0, 0),
+        _kv("release", 7),               # idempotent-on-freed: a no-op
+    ], require_drained=True)
+    assert rep.ok, str(rep)
+
+
+def test_conformance_flags_write_after_free_at_first_step():
+    events = [_kv("alloc", 0, 1), _kv("release", 0, 0),
+              _kv("free", 0, 0), _kv("write", 0), _kv("write", 0)]
+    rep = conformance.check_kvpage(events)
+    assert not rep.ok
+    assert rep.first.index == 3          # the FIRST non-refining step
+    assert "write-after-free" in rep.first.reason
+
+
+def test_conformance_flags_refcount_drift_as_missing_event():
+    # recorded refs disagree with replay -> an event went missing
+    rep = conformance.check_kvpage(
+        [_kv("alloc", 0, 1), _kv("retain", 0, 3)])
+    assert not rep.ok and "drift" in rep.first.reason
+
+
+def _adm(phase, tenant, rows, inflight, window=None, soft=None):
+    info = {"tenant": tenant, "rows": rows, "inflight": inflight}
+    if window is not None:
+        info.update(window=window, soft=soft)
+    return {"key": "admission", "phase": phase, "t": 0.0, "stream": -1,
+            "object": "tp", "info": info}
+
+
+def test_conformance_admission_clean_and_violations():
+    clean = [_adm("admit", "A", 2, 2, window=4, soft=2),
+             _adm("admit", "A", 2, 4, window=4, soft=2),
+             _adm("retire", "A", 1, 3), _adm("retire", "A", 1, 2),
+             _adm("reconcile", "A", 2, 0)]
+    assert conformance.check_admission(clean).ok
+    over = [_adm("admit", "A", 3, 3, window=4, soft=2),
+            _adm("admit", "A", 3, 6, window=4, soft=2)]
+    rep = conformance.check_admission(over)
+    assert not rep.ok and "hard window" in rep.first.reason
+    under = [_adm("admit", "A", 1, 1, window=4, soft=2),
+             _adm("retire", "A", 1, 0), _adm("retire", "A", 1, -1)]
+    rep = conformance.check_admission(under)
+    assert not rep.ok and "negative" in rep.first.reason
+
+
+def test_replay_autoselects_protocols():
+    reports = conformance.replay(
+        [_kv("alloc", 0, 1), _adm("admit", "A", 1, 1, window=4, soft=2)])
+    assert {r.protocol for r in reports} == {"kv_lifecycle",
+                                             "admission_budget"}
+    assert conformance.replay([{"key": "task", "phase": "begin"}]) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _run_cli(*args, timeout=240):
+    return subprocess.run(
+        [sys.executable, "-m", "parsec_tpu.analysis", *args],
+        capture_output=True, text=True, timeout=timeout,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"})
+
+
+def test_cli_protocheck_clean_and_seeded():
+    proc = _run_cli("protocheck", "--seeded", "--bound", "20000")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = proc.stdout
+    for name in protomodels.MODELS:
+        assert "[protocheck]" in out
+    assert out.count("— clean") >= len(protomodels.MODELS)
+    for name in protomodels.SEEDED:
+        assert f"seeded {name}: caught" in out, out
+    assert out.rstrip().endswith("OK")
+
+
+def test_cli_protocheck_single_model_and_trace(tmp_path):
+    stream = tmp_path / "trace.json"
+    stream.write_text(json.dumps({"events": [
+        _kv("alloc", 0, 1), _kv("write", 0), _kv("release", 0, 0),
+        _kv("free", 0, 0)]}))
+    proc = _run_cli("protocheck", "termdet", "--trace", str(stream))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "termdet_cancel" in proc.stdout
+    assert "refines" in proc.stdout
+
+
+def test_cli_protocheck_trace_rejects_bad_stream(tmp_path):
+    stream = tmp_path / "bad.json"
+    stream.write_text(json.dumps([
+        _kv("alloc", 0, 1), _kv("free", 0, 0)]))   # free with refs=1
+    proc = _run_cli("protocheck", "termdet", "--trace", str(stream))
+    assert proc.returncode == 1
+    assert "non-refining" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# live conformance smoke: traced serving decode run refines the models
+# ---------------------------------------------------------------------------
+
+def test_serving_trace_refines_models():
+    """The ISSUE 19 closing loop: run the serving decode smoke with
+    tracing ON and replay the captured ring events through the
+    admission + KV-refcount models — zero non-refining steps."""
+    import parsec_tpu as parsec
+    from parsec_tpu import serving
+    from parsec_tpu.profiling.trace import Trace
+    from parsec_tpu.serving.decode import DecodeConfig, DecodeEngine
+    from parsec_tpu.serving.kv import KVStateLayer
+
+    PT = 8
+    SYS = tuple(range(1000, 1000 + 4 * PT))
+    c = parsec.init(nb_cores=4, scheduler="wfq")
+    serving.enable(c)
+    tr = Trace().install(c)
+    c.start()
+    try:
+        cfg = DecodeConfig()
+        layer = KVStateLayer(c, cfg.d_model, page_tokens=PT)
+        eA = DecodeEngine(c, "cfA", cfg=cfg, tenant="confA",
+                          kv_layer=layer).start()
+        eB = DecodeEngine(c, "cfB", cfg=cfg, tenant="confB",
+                          kv_layer=layer).start()
+        eA.request(1, 4, tokens=SYS + (7, 8, 9))
+        for _ in eA.drain(timeout=60.0):
+            pass
+        eA.request(2, 4, tokens=SYS + (7, 8, 9))
+        eB.request(3, 4, tokens=SYS + (11, 12))
+        for eng in (eA, eB):
+            for _ in eng.drain(timeout=60.0):
+                pass
+        eA.close()
+        eB.close()
+        records = tr.to_records()
+    finally:
+        parsec.fini(c)
+
+    assert tr.dropped() == 0             # a truncated capture proves nothing
+    keys = {ev["key"] for ev in records}
+    assert "kvpage" in keys and "admission" in keys
+    reports = conformance.replay(records)
+    assert {r.protocol for r in reports} == {"kv_lifecycle",
+                                             "admission_budget"}
+    for rep in reports:
+        assert rep.ok, str(rep)
+        assert rep.checked > 0
+    # pages still held at the end belong to the radix prefix cache (a
+    # cache is not a leak); every lifecycle step was still refining
+    kv = next(r for r in reports if r.protocol == "kv_lifecycle")
+    assert kv.checked >= 10
